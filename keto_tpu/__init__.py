@@ -1,0 +1,17 @@
+"""keto-tpu: a TPU-native Zanzibar-style relationship-based access control framework.
+
+Re-designed from scratch with the capabilities of ory/keto (reference mounted at
+/root/reference): relation tuples ``namespace:object#relation@subject``, a
+``Check`` API, an ``Expand`` API, tuple read/write APIs over REST + gRPC with a
+read/write port split, namespaces, a CLI, and migrations.
+
+The hot path — the reference's recursive, one-SQL-query-per-step subject-set
+expansion (reference internal/check/engine.go:33-95) — is reframed here as
+batched sparse graph reachability: tuples are interned into edge/CSR arrays
+resident in TPU HBM and batches of check queries are answered by a vectorized
+JAX frontier-closure kernel (keto_tpu/graph/).
+"""
+
+from keto_tpu.version import __version__
+
+__all__ = ["__version__"]

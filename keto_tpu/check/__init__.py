@@ -1,0 +1,3 @@
+from keto_tpu.check.engine import CheckEngine
+
+__all__ = ["CheckEngine"]

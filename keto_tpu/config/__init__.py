@@ -1,0 +1,28 @@
+from keto_tpu.config.provider import (
+    Config,
+    NamespaceWatcher,
+    load_namespaces_from_uri,
+    parse_namespace_file,
+    KEY_DSN,
+    KEY_NAMESPACES,
+    KEY_READ_API_HOST,
+    KEY_READ_API_PORT,
+    KEY_WRITE_API_HOST,
+    KEY_WRITE_API_PORT,
+)
+from keto_tpu.config.schema import CONFIG_SCHEMA, NAMESPACE_SCHEMA
+
+__all__ = [
+    "Config",
+    "NamespaceWatcher",
+    "load_namespaces_from_uri",
+    "parse_namespace_file",
+    "CONFIG_SCHEMA",
+    "NAMESPACE_SCHEMA",
+    "KEY_DSN",
+    "KEY_NAMESPACES",
+    "KEY_READ_API_HOST",
+    "KEY_READ_API_PORT",
+    "KEY_WRITE_API_HOST",
+    "KEY_WRITE_API_PORT",
+]

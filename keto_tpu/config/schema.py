@@ -1,0 +1,99 @@
+"""Embedded JSON schema for the server configuration.
+
+The reference validates configuration against an embedded JSON schema
+(reference internal/driver/config/provider.go:24-25,
+.schema/config.schema.json). This schema covers the keys this framework
+implements; unknown top-level keys are rejected to catch typos early.
+"""
+
+NAMESPACE_SCHEMA = {
+    "$id": "keto-tpu/namespace.schema.json",
+    "type": "object",
+    "properties": {
+        "$schema": {"type": "string"},
+        "name": {"type": "string"},
+        "id": {"type": "integer", "minimum": 0},
+        "config": {"type": "object"},
+    },
+    "additionalProperties": False,
+    "required": ["name", "id"],
+}
+
+CONFIG_SCHEMA = {
+    "$id": "keto-tpu/config.schema.json",
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "title": "keto-tpu configuration",
+    "type": "object",
+    "properties": {
+        "dsn": {
+            "type": "string",
+            "description": "Data source name: 'memory', 'sqlite://<path>', or 'sqlite://:memory:'.",
+        },
+        "serve": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "read": {
+                    "type": "object",
+                    "additionalProperties": False,
+                    "properties": {
+                        "host": {"type": "string", "default": ""},
+                        "port": {"type": "integer", "default": 4466},
+                    },
+                },
+                "write": {
+                    "type": "object",
+                    "additionalProperties": False,
+                    "properties": {
+                        "host": {"type": "string", "default": ""},
+                        "port": {"type": "integer", "default": 4467},
+                    },
+                },
+            },
+        },
+        "namespaces": {
+            "oneOf": [
+                {"type": "array", "items": NAMESPACE_SCHEMA},
+                {"type": "string", "description": "file:// URI of a namespace file or directory"},
+            ]
+        },
+        "engine": {
+            "type": "object",
+            "additionalProperties": False,
+            "description": "TPU check-engine tuning; no reference analog (the reference engine has no knobs).",
+            "properties": {
+                "backend": {"type": "string", "enum": ["tpu", "oracle", "auto"], "default": "auto"},
+                "batch_size": {"type": "integer", "default": 4096},
+                "reach_capacity": {"type": "integer", "default": 512},
+                "max_degree": {"type": "integer", "default": 32},
+                "batch_window_ms": {"type": "number", "default": 1.0},
+            },
+        },
+        "limit": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {"max_read_depth": {"type": "integer", "default": 5}},
+        },
+        "log": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "level": {
+                    "type": "string",
+                    "enum": ["trace", "debug", "info", "warning", "error", "fatal"],
+                    "default": "info",
+                },
+                "format": {"type": "string", "enum": ["text", "json"], "default": "text"},
+            },
+        },
+        "tracing": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "provider": {"type": "string", "enum": ["", "log"], "default": ""},
+            },
+        },
+        "profiling": {"type": "string", "enum": ["", "cpu", "mem"], "default": ""},
+    },
+    "additionalProperties": False,
+}

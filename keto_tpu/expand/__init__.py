@@ -1,0 +1,4 @@
+from keto_tpu.expand.engine import ExpandEngine
+from keto_tpu.expand.tree import LEAF, UNION, EXCLUSION, INTERSECTION, Tree
+
+__all__ = ["ExpandEngine", "Tree", "LEAF", "UNION", "EXCLUSION", "INTERSECTION"]

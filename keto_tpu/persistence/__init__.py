@@ -1,0 +1,10 @@
+"""Persistence contracts.
+
+Mirrors reference internal/persistence/definitions.go:15-34: a ``Persister``
+is a tuple ``Manager`` bound to one network (tenant) ID, plus migration
+control for SQL-backed stores.
+"""
+
+from keto_tpu.persistence.memory import MemoryPersister, InternalRow
+
+__all__ = ["MemoryPersister", "InternalRow"]

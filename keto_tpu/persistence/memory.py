@@ -1,0 +1,236 @@
+"""In-memory tuple store.
+
+Implements the ``Manager`` contract (reference
+internal/relationtuple/definitions.go:28-33) with the exact semantics of the
+reference SQL persister (internal/persistence/sql/relationtuples.go):
+
+- rows carry a network ID; a persister instance is scoped to one network and
+  never sees other networks' rows (reference persister.go:94-96);
+- namespaces are stored as their config-assigned int32 IDs and resolved back
+  through the namespace manager on read (relationtuples.go:43-80);
+- writes validate namespaces (both the tuple's and a subject-set subject's)
+  against the namespace manager (relationtuples.go:82-126);
+- duplicate inserts create additional rows (the SQL PK is a random shard_id,
+  relationtuples.go:135-138), deletes remove *all* matching rows;
+- list order mirrors the reference's ORDER BY (relationtuples.go:215) with
+  SQLite NULL-first semantics, ties broken by commit order;
+- pagination tokens are 1-based page numbers, "" = first page / no more pages
+  (persister.go:106-134).
+
+The store keeps columnar-friendly internal rows so the TPU snapshot builder
+(keto_tpu/graph/) can ingest them without per-tuple Python overhead.
+"""
+
+from __future__ import annotations
+
+import bisect
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.relationtuple.manager import Manager
+from keto_tpu.relationtuple.model import RelationQuery, RelationTuple, SubjectID, SubjectSet
+from keto_tpu.x.errors import ErrMalformedPageToken, ErrNilSubject
+from keto_tpu.x.pagination import (
+    DEFAULT_PAGE_SIZE,
+    PaginationOptionSetter,
+    get_pagination_options,
+)
+
+
+@dataclass(frozen=True)
+class InternalRow:
+    """One stored tuple with interned namespace IDs."""
+
+    namespace_id: int
+    object: str
+    relation: str
+    subject_id: Optional[str]  # exactly one of subject_id / subject_set_* is set
+    sset_namespace_id: Optional[int]
+    sset_object: Optional[str]
+    sset_relation: Optional[str]
+    seq: int  # commit order (the reference's commit_time)
+
+    def sort_key(self):
+        # ORDER BY namespace_id, object, relation, subject_id,
+        #   subject_set_namespace_id, subject_set_object, subject_set_relation,
+        #   commit_time — with NULLs first (SQLite dialect).
+        def null_first(v):
+            return (0, "") if v is None else (1, v)
+
+        def null_first_int(v):
+            return (0, 0) if v is None else (1, v)
+
+        return (
+            self.namespace_id,
+            self.object,
+            self.relation,
+            null_first(self.subject_id),
+            null_first_int(self.sset_namespace_id),
+            null_first(self.sset_object),
+            null_first(self.sset_relation),
+            self.seq,
+        )
+
+
+class _SharedState:
+    """Rows shared across per-network persister views."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.rows: dict[str, list[InternalRow]] = {}  # nid -> rows
+        self.seq = itertools.count()
+        self.watermark = 0
+
+
+class MemoryPersister(Manager):
+    def __init__(
+        self,
+        namespace_manager_source,
+        network_id: str = "default",
+        _shared: Optional[_SharedState] = None,
+    ):
+        """``namespace_manager_source`` is a zero-arg callable returning the
+        current namespace.Manager (hot-reload safe) or a Manager instance."""
+        if isinstance(namespace_manager_source, namespace_pkg.Manager):
+            self._nm = lambda: namespace_manager_source
+        else:
+            self._nm = namespace_manager_source
+        self.network_id = network_id
+        self._shared = _shared or _SharedState()
+
+    def with_network(self, network_id: str) -> "MemoryPersister":
+        """A second view over the same physical store bound to another
+        network — the analog of two server deployments sharing one database
+        (reference internal/relationtuple/manager_isolation.go:39-116)."""
+        return MemoryPersister(self._nm, network_id, self._shared)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _rows(self) -> list[InternalRow]:
+        return self._shared.rows.setdefault(self.network_id, [])
+
+    def _to_row(self, rt: RelationTuple) -> InternalRow:
+        nm = self._nm()
+        ns = nm.get_namespace_by_name(rt.namespace)
+        if rt.subject is None:
+            raise ErrNilSubject()
+        if isinstance(rt.subject, SubjectID):
+            return InternalRow(ns.id, rt.object, rt.relation, rt.subject.id, None, None, None, next(self._shared.seq))
+        sns = nm.get_namespace_by_name(rt.subject.namespace)
+        return InternalRow(
+            ns.id, rt.object, rt.relation, None, sns.id, rt.subject.object, rt.subject.relation, next(self._shared.seq)
+        )
+
+    def _to_tuple(self, row: InternalRow) -> RelationTuple:
+        nm = self._nm()
+        ns = nm.get_namespace_by_config_id(row.namespace_id)
+        if row.subject_id is not None:
+            subject: object = SubjectID(id=row.subject_id)
+        else:
+            sns = nm.get_namespace_by_config_id(row.sset_namespace_id)
+            subject = SubjectSet(namespace=sns.name, object=row.sset_object, relation=row.sset_relation)
+        return RelationTuple(namespace=ns.name, object=row.object, relation=row.relation, subject=subject)
+
+    def _compile_query(self, query: RelationQuery):
+        """Resolve namespace names up front (unknown → ErrNamespaceUnknown,
+        matching reference relationtuples.go:224-235 which resolves before
+        filtering) and return a row predicate."""
+        nm = self._nm()
+        ns_id = nm.get_namespace_by_name(query.namespace).id if query.namespace != "" else None
+        sub = query.subject
+        sub_id = None
+        sset_key = None
+        if isinstance(sub, SubjectID):
+            sub_id = sub.id
+        elif isinstance(sub, SubjectSet):
+            sset_key = (nm.get_namespace_by_name(sub.namespace).id, sub.object, sub.relation)
+
+        def matches(row: InternalRow) -> bool:
+            if query.relation != "" and row.relation != query.relation:
+                return False
+            if query.object != "" and row.object != query.object:
+                return False
+            if ns_id is not None and row.namespace_id != ns_id:
+                return False
+            if sub_id is not None and row.subject_id != sub_id:
+                return False
+            if sset_key is not None and (
+                (row.sset_namespace_id, row.sset_object, row.sset_relation) != sset_key
+            ):
+                return False
+            return True
+
+        return matches
+
+    # -- Manager -------------------------------------------------------------
+
+    def get_relation_tuples(
+        self, query: RelationQuery, *options: PaginationOptionSetter
+    ) -> tuple[list[RelationTuple], str]:
+        opts = get_pagination_options(*options)
+        per_page = opts.size or DEFAULT_PAGE_SIZE
+        if opts.token == "":
+            page = 1
+        else:
+            if not opts.token.isdigit():
+                raise ErrMalformedPageToken()
+            page = max(int(opts.token), 1)
+
+        with self._shared.lock:
+            # rows are kept sorted at mutation time, so a page request is a
+            # single filtering pass (the engines' page loops would otherwise
+            # pay a re-sort per page)
+            matches = self._compile_query(query)
+            matched = [r for r in self._rows() if matches(r)]
+            total_pages = -(-len(matched) // per_page)  # ceil
+            start = (page - 1) * per_page
+            page_rows = matched[start : start + per_page]
+            next_token = "" if page >= total_pages else str(page + 1)
+            return [self._to_tuple(r) for r in page_rows], next_token
+
+    def write_relation_tuples(self, *tuples: RelationTuple) -> None:
+        self.transact_relation_tuples(tuples, ())
+
+    def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
+        self.transact_relation_tuples((), tuples)
+
+    def transact_relation_tuples(
+        self, insert: Sequence[RelationTuple], delete: Sequence[RelationTuple]
+    ) -> None:
+        """Atomic: namespace validation happens for the whole batch before any
+        mutation, so a failing insert/delete leaves the store untouched
+        (rollback semantics of reference relationtuples.go:271-278)."""
+        with self._shared.lock:
+            new_rows = [self._to_row(rt) for rt in insert]
+            delete_keys = []
+            for rt in delete:
+                row = self._to_row(rt)
+                delete_keys.append(
+                    (row.namespace_id, row.object, row.relation, row.subject_id, row.sset_namespace_id, row.sset_object, row.sset_relation)
+                )
+            rows = self._rows()
+            for r in new_rows:
+                bisect.insort(rows, r, key=InternalRow.sort_key)
+            if delete_keys:
+                keyset = set(delete_keys)
+                self._shared.rows[self.network_id] = [
+                    r
+                    for r in rows
+                    if (r.namespace_id, r.object, r.relation, r.subject_id, r.sset_namespace_id, r.sset_object, r.sset_relation)
+                    not in keyset
+                ]
+            self._shared.watermark += 1
+
+    def watermark(self) -> int:
+        with self._shared.lock:
+            return self._shared.watermark
+
+    # -- snapshot support ----------------------------------------------------
+
+    def snapshot_rows(self) -> tuple[list[InternalRow], int]:
+        """Consistent (rows, watermark) view for the TPU graph builder."""
+        with self._shared.lock:
+            return list(self._rows()), self._shared.watermark

@@ -1,0 +1,20 @@
+from keto_tpu.relationtuple.model import (
+    RelationQuery,
+    RelationTuple,
+    Subject,
+    SubjectID,
+    SubjectSet,
+    subject_from_string,
+)
+from keto_tpu.relationtuple.manager import Manager, ManagerWrapper
+
+__all__ = [
+    "RelationQuery",
+    "RelationTuple",
+    "Subject",
+    "SubjectID",
+    "SubjectSet",
+    "subject_from_string",
+    "Manager",
+    "ManagerWrapper",
+]

@@ -1,0 +1,74 @@
+"""Tuple-manager contract.
+
+Mirrors the reference's ``relationtuple.Manager`` interface
+(reference internal/relationtuple/definitions.go:28-33): paginated query,
+write, delete, and an atomic insert+delete transaction. Engines depend only
+on this contract, so any store (in-memory, SQLite, ...) plugs in underneath
+both the oracle engines and the TPU snapshot builder.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterable, Optional, Sequence
+
+from keto_tpu.relationtuple.model import RelationQuery, RelationTuple
+from keto_tpu.x.pagination import PaginationOptionSetter, get_pagination_options
+
+
+class Manager(abc.ABC):
+    @abc.abstractmethod
+    def get_relation_tuples(
+        self, query: RelationQuery, *options: PaginationOptionSetter
+    ) -> tuple[list[RelationTuple], str]:
+        """Return (tuples, next_page_token); "" token means last page."""
+
+    @abc.abstractmethod
+    def write_relation_tuples(self, *tuples: RelationTuple) -> None: ...
+
+    @abc.abstractmethod
+    def delete_relation_tuples(self, *tuples: RelationTuple) -> None: ...
+
+    @abc.abstractmethod
+    def transact_relation_tuples(
+        self, insert: Sequence[RelationTuple], delete: Sequence[RelationTuple]
+    ) -> None:
+        """Atomically apply inserts then deletes; all-or-nothing."""
+
+    def watermark(self) -> int:
+        """Monotonic write counter, used by the TPU engine to detect staleness
+        of its device-resident graph snapshot (the real implementation of what
+        the reference stubs as "snaptoken", reference
+        internal/check/handler.go:162)."""
+        return 0
+
+
+class ManagerWrapper(Manager):
+    """Test spy recording requested page tokens, used to assert the engines'
+    pagination behavior. Reference definitions.go:645-683."""
+
+    def __init__(self, manager: Manager, *page_opts: PaginationOptionSetter):
+        self.manager = manager
+        self.page_opts = list(page_opts)
+        self.requested_pages: list[str] = []
+
+    def get_relation_tuples(
+        self, query: RelationQuery, *options: PaginationOptionSetter
+    ) -> tuple[list[RelationTuple], str]:
+        opts = get_pagination_options(*options)
+        self.requested_pages.append(opts.token)
+        return self.manager.get_relation_tuples(query, *(self.page_opts + list(options)))
+
+    def write_relation_tuples(self, *tuples: RelationTuple) -> None:
+        self.manager.write_relation_tuples(*tuples)
+
+    def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
+        self.manager.delete_relation_tuples(*tuples)
+
+    def transact_relation_tuples(
+        self, insert: Sequence[RelationTuple], delete: Sequence[RelationTuple]
+    ) -> None:
+        self.manager.transact_relation_tuples(insert, delete)
+
+    def watermark(self) -> int:
+        return self.manager.watermark()

@@ -1,0 +1,7 @@
+"""Framework version.
+
+The reference exposes its version over the gRPC VersionService
+(reference proto/ory/keto/acl/v1alpha1/version.proto:15-19) and `keto version`.
+"""
+
+__version__ = "0.1.0"

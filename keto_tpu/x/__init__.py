@@ -1,0 +1,32 @@
+from keto_tpu.x.errors import (
+    KetoError,
+    ErrBadRequest,
+    ErrNotFound,
+    ErrInternalServerError,
+    ErrMalformedInput,
+    ErrNilSubject,
+    ErrDuplicateSubject,
+    ErrDroppedSubjectKey,
+    ErrIncompleteSubject,
+    ErrNamespaceUnknown,
+    ErrMalformedPageToken,
+)
+from keto_tpu.x.pagination import PaginationOptions, with_token, with_size, get_pagination_options
+
+__all__ = [
+    "KetoError",
+    "ErrBadRequest",
+    "ErrNotFound",
+    "ErrInternalServerError",
+    "ErrMalformedInput",
+    "ErrNilSubject",
+    "ErrDuplicateSubject",
+    "ErrDroppedSubjectKey",
+    "ErrIncompleteSubject",
+    "ErrNamespaceUnknown",
+    "ErrMalformedPageToken",
+    "PaginationOptions",
+    "with_token",
+    "with_size",
+    "get_pagination_options",
+]

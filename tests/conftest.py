@@ -1,0 +1,33 @@
+"""Test bootstrap.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
+exercised without TPU hardware (the analog of the reference's dockertest
+database matrix, reference internal/x/dbx/dsn_testutils.go:22-78). The env
+must be set before JAX is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.persistence.memory import MemoryPersister
+
+
+@pytest.fixture
+def make_persister():
+    """Factory: persister over a fresh store with the given namespaces."""
+
+    def factory(namespaces, network_id="default"):
+        nss = [
+            namespace_pkg.Namespace(id=n[1], name=n[0]) if isinstance(n, tuple) else n
+            for n in namespaces
+        ]
+        return MemoryPersister(namespace_pkg.MemoryManager(nss), network_id=network_id)
+
+    return factory

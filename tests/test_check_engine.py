@@ -1,0 +1,172 @@
+"""Oracle check-engine semantics.
+
+Case-for-case port of reference internal/check/engine_test.go:29-490. These
+cases double as the contract for the TPU engine: test_tpu_check.py runs the
+same scenarios (and fuzzed graphs) through both engines.
+"""
+
+import pytest
+
+from keto_tpu.check import CheckEngine
+from keto_tpu.relationtuple import (
+    ManagerWrapper,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+)
+from keto_tpu.x.pagination import with_size
+
+
+def T(ns, obj, rel, sub):
+    return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+
+def test_direct_inclusion(make_persister):
+    # engine_test.go:30-48
+    p = make_persister([("test", 1)])
+    rel = T("test", "object", "access", SubjectID("user"))
+    p.write_relation_tuples(rel)
+    assert CheckEngine(p).subject_is_allowed(rel)
+
+
+def test_indirect_inclusion_level_1(make_persister):
+    # engine_test.go:50-89
+    p = make_persister([("under the sofa", 1)])
+    p.write_relation_tuples(
+        T("under the sofa", "dust", "have to remove", SubjectSet("under the sofa", "dust", "producer")),
+        T("under the sofa", "dust", "producer", SubjectID("Mark")),
+    )
+    assert CheckEngine(p).subject_is_allowed(
+        T("under the sofa", "dust", "have to remove", SubjectID("Mark"))
+    )
+
+
+def test_direct_exclusion(make_persister):
+    # engine_test.go:91-117
+    p = make_persister([("object-namespace", 10)])
+    p.write_relation_tuples(T("object-namespace", "object-id", "relation", SubjectID("user-id")))
+    assert not CheckEngine(p).subject_is_allowed(
+        T("object-namespace", "object-id", "relation", SubjectID("not user-id"))
+    )
+
+
+def test_wrong_object_id(make_persister):
+    # engine_test.go:119-149 — note the empty-string namespace is configured
+    p = make_persister([("", 1)])
+    p.write_relation_tuples(
+        T("", "object", "access", SubjectSet("", "object", "owner")),
+        T("", "not object", "owner", SubjectID("user")),
+    )
+    assert not CheckEngine(p).subject_is_allowed(T("", "object", "access", SubjectID("user")))
+
+
+def test_wrong_relation_name(make_persister):
+    # engine_test.go:151-187
+    p = make_persister([("diary", 1)])
+    entry = "entry for 6. Nov 2020"
+    p.write_relation_tuples(
+        T("diary", entry, "read", SubjectSet("diary", entry, "author")),
+        T("diary", entry, "not author", SubjectID("your mother")),
+    )
+    assert not CheckEngine(p).subject_is_allowed(
+        T("diary", entry, "read", SubjectID("your mother"))
+    )
+
+
+def test_indirect_inclusion_level_2(make_persister):
+    # engine_test.go:189-255
+    sn, on = "some namespace", "all organizations"
+    p = make_persister([(sn, 1), (on, 2)])
+    user = SubjectID("some user")
+    p.write_relation_tuples(
+        T(sn, "some object", "write", SubjectSet(sn, "some object", "owner")),
+        T(sn, "some object", "owner", SubjectSet(on, "some organization", "member")),
+        T(on, "some organization", "member", user),
+    )
+    e = CheckEngine(p)
+    assert e.subject_is_allowed(T(sn, "some object", "write", user))
+    assert e.subject_is_allowed(T(on, "some organization", "member", user))
+
+
+def test_rejects_transitive_relation(make_persister):
+    # engine_test.go:257-295: a subject set with the empty ("...") relation is
+    # a valid edge but must NOT grant transitive access without a rewrite.
+    p = make_persister([("", 2)])
+    p.write_relation_tuples(
+        T("", "file", "parent", SubjectSet("", "directory", "")),
+        T("", "directory", "access", SubjectID("user")),
+    )
+    assert not CheckEngine(p).subject_is_allowed(T("", "file", "access", SubjectID("user")))
+
+
+def test_subject_id_next_to_subject_set(make_persister):
+    # engine_test.go:297-348
+    p = make_persister([("namesp", 1)])
+    p.write_relation_tuples(
+        T("namesp", "obj", "owner", SubjectID("u1")),
+        T("namesp", "obj", "owner", SubjectSet("namesp", "org", "member")),
+        T("namesp", "org", "member", SubjectID("u2")),
+    )
+    e = CheckEngine(p)
+    assert e.subject_is_allowed(T("namesp", "obj", "owner", SubjectID("u1")))
+    assert e.subject_is_allowed(T("namesp", "obj", "owner", SubjectID("u2")))
+
+
+def test_paginates(make_persister):
+    # engine_test.go:350-394: with page size 2 and 4 direct tuples, finding
+    # u1/u2 takes one page request, u3/u4 two. Asserted via the ManagerWrapper
+    # spy exactly like reference definitions.go:645-683.
+    p = make_persister([("namesp", 1)])
+    users = ["u1", "u2", "u3", "u4"]
+    for u in users:
+        p.write_relation_tuples(T("namesp", "obj", "access", SubjectID(u)))
+
+    spy = ManagerWrapper(p, with_size(2))
+    e = CheckEngine(spy)
+    for i, u in enumerate(users):
+        assert e.subject_is_allowed(T("namesp", "obj", "access", SubjectID(u)))
+        assert len(spy.requested_pages) == (2 if i >= 2 else 1)
+        spy.requested_pages.clear()
+
+
+def test_wide_tuple_graph(make_persister):
+    # engine_test.go:396-436
+    p = make_persister([("namesp", 1)])
+    users, orgs = ["u1", "u2", "u3", "u4"], ["o1", "o2"]
+    for org in orgs:
+        p.write_relation_tuples(T("namesp", "obj", "access", SubjectSet("namesp", org, "member")))
+    for i, u in enumerate(users):
+        p.write_relation_tuples(T("namesp", orgs[i % 2], "member", SubjectID(u)))
+    e = CheckEngine(p)
+    for u in users:
+        assert e.subject_is_allowed(T("namesp", "obj", "access", SubjectID(u)))
+
+
+def test_circular_tuples_terminate(make_persister):
+    # engine_test.go:438-489
+    p = make_persister([("munich transport", 0)])
+    ns = "munich transport"
+    stations = ["Sendlinger Tor", "Odeonsplatz", "Central Station"]
+    for a, b in zip(stations, stations[1:] + stations[:1]):
+        p.write_relation_tuples(T(ns, a, "connected", SubjectSet(ns, b, "connected")))
+    assert not CheckEngine(p).subject_is_allowed(
+        T(ns, stations[0], "connected", SubjectID(stations[2]))
+    )
+
+
+def test_unknown_namespace_is_denied_not_error(make_persister):
+    # engine.go:76-77: herodot.ErrNotFound → allowed=false
+    p = make_persister([("known", 1)])
+    assert not CheckEngine(p).subject_is_allowed(
+        T("unknown", "obj", "rel", SubjectID("user"))
+    )
+
+
+def test_subject_set_as_requested_subject(make_persister):
+    # matching happens on traversed tuple subjects, so a subject-set subject
+    # is found iff some tuple carries it (engine.go:46-49)
+    p = make_persister([("n", 1)])
+    p.write_relation_tuples(T("n", "obj", "read", SubjectSet("n", "group", "member")))
+    e = CheckEngine(p)
+    assert e.subject_is_allowed(T("n", "obj", "read", SubjectSet("n", "group", "member")))
+    assert not e.subject_is_allowed(T("n", "obj", "read", SubjectSet("n", "group", "other")))

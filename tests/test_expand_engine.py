@@ -1,0 +1,112 @@
+"""Expand-engine semantics (reference internal/expand/engine_test.go)."""
+
+from keto_tpu.check import CheckEngine
+from keto_tpu.expand import ExpandEngine, LEAF, UNION, Tree
+from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+
+
+def T(ns, obj, rel, sub):
+    return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+
+def test_expand_id_subject_is_leaf(make_persister):
+    p = make_persister([("n", 1)])
+    tree = ExpandEngine(p).build_tree(SubjectID("user"), 100)
+    assert tree.type == LEAF and tree.subject == SubjectID("user")
+
+
+def test_expand_union_of_members(make_persister):
+    p = make_persister([("n", 1)])
+    users = ["u1", "u2", "u3"]
+    for u in users:
+        p.write_relation_tuples(T("n", "obj", "access", SubjectID(u)))
+    tree = ExpandEngine(p).build_tree(SubjectSet("n", "obj", "access"), 100)
+    assert tree.type == UNION
+    assert {str(c.subject) for c in tree.children} == set(users)
+    assert all(c.type == LEAF for c in tree.children)
+
+
+def test_expand_nested(make_persister):
+    p = make_persister([("n", 1)])
+    p.write_relation_tuples(
+        T("n", "obj", "access", SubjectSet("n", "org", "member")),
+        T("n", "org", "member", SubjectID("u1")),
+        T("n", "org", "member", SubjectID("u2")),
+    )
+    tree = ExpandEngine(p).build_tree(SubjectSet("n", "obj", "access"), 100)
+    assert tree.type == UNION
+    assert len(tree.children) == 1
+    org = tree.children[0]
+    assert org.type == UNION and org.subject == SubjectSet("n", "org", "member")
+    assert {str(c.subject) for c in org.children} == {"u1", "u2"}
+
+
+def test_expand_depth_limit_truncates_to_leaf(make_persister):
+    p = make_persister([("n", 1)])
+    p.write_relation_tuples(
+        T("n", "obj", "access", SubjectSet("n", "org", "member")),
+        T("n", "org", "member", SubjectID("u1")),
+    )
+    tree = ExpandEngine(p).build_tree(SubjectSet("n", "obj", "access"), 2)
+    # depth 2: root union + child set truncated to leaf (engine.go:68-71)
+    assert tree.type == UNION
+    assert tree.children[0].type == LEAF
+    assert tree.children[0].subject == SubjectSet("n", "org", "member")
+
+
+def test_expand_depth_zero_is_none(make_persister):
+    p = make_persister([("n", 1)])
+    assert ExpandEngine(p).build_tree(SubjectSet("n", "obj", "rel"), 0) is None
+
+
+def test_expand_empty_set_is_none(make_persister):
+    p = make_persister([("n", 1)])
+    assert ExpandEngine(p).build_tree(SubjectSet("n", "obj", "rel"), 10) is None
+
+
+def test_expand_cycle_terminates(make_persister):
+    p = make_persister([("n", 1)])
+    p.write_relation_tuples(
+        T("n", "a", "r", SubjectSet("n", "b", "r")),
+        T("n", "b", "r", SubjectSet("n", "a", "r")),
+    )
+    tree = ExpandEngine(p).build_tree(SubjectSet("n", "a", "r"), 100)
+    # b's expansion sees a already-visited → child of b for the back-edge
+    # becomes a plain leaf (engine.go:79-84)
+    assert tree.type == UNION
+    b = tree.children[0]
+    assert b.subject == SubjectSet("n", "b", "r")
+    assert b.children[0].type == LEAF and b.children[0].subject == SubjectSet("n", "a", "r")
+
+
+def test_tree_json_roundtrip(make_persister):
+    p = make_persister([("n", 1)])
+    p.write_relation_tuples(
+        T("n", "obj", "access", SubjectSet("n", "org", "member")),
+        T("n", "org", "member", SubjectID("u1")),
+    )
+    tree = ExpandEngine(p).build_tree(SubjectSet("n", "obj", "access"), 100)
+    assert Tree.from_json(tree.to_json()).equals(tree)
+
+
+def test_expand_agrees_with_check(make_persister):
+    """Every subject-id leaf of a full expansion must be allowed by check."""
+    p = make_persister([("n", 1)])
+    p.write_relation_tuples(
+        T("n", "obj", "access", SubjectSet("n", "org", "member")),
+        T("n", "obj", "access", SubjectID("direct")),
+        T("n", "org", "member", SubjectID("u1")),
+    )
+    tree = ExpandEngine(p).build_tree(SubjectSet("n", "obj", "access"), 100)
+    e = CheckEngine(p)
+
+    def leaves(t):
+        if t.type == LEAF and isinstance(t.subject, SubjectID):
+            yield t.subject
+        for c in t.children:
+            yield from leaves(c)
+
+    found = list(leaves(tree))
+    assert {s.id for s in found} == {"direct", "u1"}
+    for s in found:
+        assert e.subject_is_allowed(T("n", "obj", "access", s))

@@ -1,0 +1,222 @@
+"""Manager contract suite.
+
+Port of the reference's reusable persister test suites:
+``relationtuple.ManagerTest`` (reference
+internal/relationtuple/manager_requirements.go:19-447 — write/get/delete/
+transact, pagination, rollback) and ``relationtuple.IsolationTest``
+(manager_isolation.go:39-116 — network-ID isolation). The suite is
+parameterized over every store backend, mirroring how the reference drives it
+for every DSN (internal/persistence/sql/full_test.go:52-70).
+"""
+
+import pytest
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.persistence.memory import MemoryPersister
+from keto_tpu.relationtuple import RelationQuery, RelationTuple, SubjectID, SubjectSet
+from keto_tpu.x.errors import ErrMalformedPageToken, ErrNamespaceUnknown, ErrNotFound
+from keto_tpu.x.pagination import with_size, with_token
+
+NAMESPACES = [namespace_pkg.Namespace(id=1, name="ns1"), namespace_pkg.Namespace(id=2, name="ns2")]
+
+
+def make_memory(network_id="default"):
+    return MemoryPersister(namespace_pkg.MemoryManager(NAMESPACES), network_id=network_id)
+
+
+BACKENDS = {"memory": make_memory}
+
+
+def register_backend(name, factory):
+    """Other store backends (e.g. SQLite) join the matrix here."""
+    BACKENDS[name] = factory
+
+
+try:  # SQLite backend registers itself if present
+    from keto_tpu.persistence.sqlite import SqlitePersister
+
+    def make_sqlite(network_id="default"):
+        return SqlitePersister(
+            "sqlite://:memory:", namespace_pkg.MemoryManager(NAMESPACES), network_id=network_id, auto_migrate=True
+        )
+
+    BACKENDS["sqlite"] = make_sqlite
+except ImportError:
+    pass
+
+
+@pytest.fixture(params=sorted(BACKENDS))
+def persister(request):
+    return BACKENDS[request.param]()
+
+
+def T(ns, obj, rel, sub):
+    return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+
+def test_write_and_get(persister):
+    rt = T("ns1", "obj", "rel", SubjectID("user"))
+    persister.write_relation_tuples(rt)
+    got, token = persister.get_relation_tuples(RelationQuery(namespace="ns1"))
+    assert got == [rt] and token == ""
+
+
+def test_get_filters(persister):
+    rts = [
+        T("ns1", "obj", "rel", SubjectID("user")),
+        T("ns1", "obj", "other", SubjectID("user")),
+        T("ns1", "obj2", "rel", SubjectID("user2")),
+        T("ns2", "obj", "rel", SubjectSet("ns1", "obj", "rel")),
+    ]
+    persister.write_relation_tuples(*rts)
+
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1"))
+    assert len(got) == 3
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1", object="obj"))
+    assert len(got) == 2
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1", object="obj", relation="rel"))
+    assert got == [rts[0]]
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1", subject_id="user"))
+    assert len(got) == 2
+    got, _ = persister.get_relation_tuples(
+        RelationQuery(namespace="ns2", subject_set=SubjectSet("ns1", "obj", "rel"))
+    )
+    assert got == [rts[3]]
+
+
+def test_subject_filter_distinguishes_id_and_set(persister):
+    """A subject-id that spells like a set must not match the set filter
+    (the reference's explicit NULL checks, relationtuples.go:151-176)."""
+    persister.write_relation_tuples(
+        T("ns1", "o", "r", SubjectID("ns1:obj#rel")),
+        T("ns1", "o", "r", SubjectSet("ns1", "obj", "rel")),
+    )
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1", subject_id="ns1:obj#rel"))
+    assert len(got) == 1 and isinstance(got[0].subject, SubjectID)
+    got, _ = persister.get_relation_tuples(
+        RelationQuery(namespace="ns1", subject_set=SubjectSet("ns1", "obj", "rel"))
+    )
+    assert len(got) == 1 and isinstance(got[0].subject, SubjectSet)
+
+
+def test_unknown_namespace_raises_not_found(persister):
+    with pytest.raises(ErrNotFound):
+        persister.get_relation_tuples(RelationQuery(namespace="nope"))
+    with pytest.raises(ErrNamespaceUnknown):
+        persister.write_relation_tuples(T("nope", "o", "r", SubjectID("u")))
+    with pytest.raises(ErrNamespaceUnknown):
+        # subject-set namespaces are validated too (relationtuples.go:92-96)
+        persister.write_relation_tuples(T("ns1", "o", "r", SubjectSet("nope", "o", "r")))
+
+
+def test_delete(persister):
+    keep = T("ns1", "obj", "rel", SubjectID("keep"))
+    drop = T("ns1", "obj", "rel", SubjectID("drop"))
+    persister.write_relation_tuples(keep, drop)
+    persister.delete_relation_tuples(drop)
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1"))
+    assert got == [keep]
+
+
+def test_delete_removes_duplicates(persister):
+    rt = T("ns1", "obj", "rel", SubjectID("u"))
+    persister.write_relation_tuples(rt)
+    persister.write_relation_tuples(rt)
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1"))
+    assert len(got) == 2  # duplicate inserts are distinct rows
+    persister.delete_relation_tuples(rt)
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1"))
+    assert got == []
+
+
+def test_transact(persister):
+    old = T("ns1", "obj", "rel", SubjectID("old"))
+    new = T("ns1", "obj", "rel", SubjectID("new"))
+    persister.write_relation_tuples(old)
+    persister.transact_relation_tuples([new], [old])
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1"))
+    assert got == [new]
+
+
+def test_transact_rollback(persister):
+    """A bad tuple anywhere in the transaction leaves the store untouched
+    (reference manager_requirements.go:399-445)."""
+    good = T("ns1", "obj", "rel", SubjectID("good"))
+    bad = T("unknown-namespace", "obj", "rel", SubjectID("bad"))
+    with pytest.raises(ErrNamespaceUnknown):
+        persister.transact_relation_tuples([good, bad], [])
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1"))
+    assert got == []
+
+    persister.write_relation_tuples(good)
+    with pytest.raises(ErrNamespaceUnknown):
+        persister.transact_relation_tuples([], [good, bad])
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1"))
+    assert got == [good]
+
+
+def test_pagination(persister):
+    rts = [T("ns1", "obj", "rel", SubjectID(f"u{i:03d}")) for i in range(10)]
+    persister.write_relation_tuples(*rts)
+
+    seen = []
+    token = ""
+    pages = 0
+    while True:
+        got, token = persister.get_relation_tuples(
+            RelationQuery(namespace="ns1"), with_size(3), with_token(token)
+        )
+        seen.extend(got)
+        pages += 1
+        if token == "":
+            break
+    assert pages == 4
+    assert sorted(s.subject.id for s in seen) == [f"u{i:03d}" for i in range(10)]
+    # no overlap
+    assert len({str(s) for s in seen}) == 10
+
+
+def test_pagination_is_stable(persister):
+    rts = [T("ns1", f"obj{i:02d}", "rel", SubjectID("u")) for i in range(7)]
+    persister.write_relation_tuples(*rts)
+    all_at_once, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1"), with_size(100))
+    paged = []
+    token = ""
+    while True:
+        got, token = persister.get_relation_tuples(
+            RelationQuery(namespace="ns1"), with_size(2), with_token(token)
+        )
+        paged.extend(got)
+        if token == "":
+            break
+    assert paged == all_at_once
+
+
+def test_malformed_page_token(persister):
+    with pytest.raises(ErrMalformedPageToken):
+        persister.get_relation_tuples(RelationQuery(namespace="ns1"), with_token("not-a-number"))
+
+
+def test_empty_store_returns_empty_token(persister):
+    got, token = persister.get_relation_tuples(RelationQuery(namespace="ns1"))
+    assert got == [] and token == ""
+
+
+def test_network_isolation(persister):
+    """Two persisters differing only in network ID must not see each other's
+    tuples (reference manager_isolation.go:39-116)."""
+    other = persister.with_network("other-network")
+    rt_a = T("ns1", "obj", "rel", SubjectID("a"))
+    rt_b = T("ns1", "obj", "rel", SubjectID("b"))
+    persister.write_relation_tuples(rt_a)
+    other.write_relation_tuples(rt_b)
+
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1"))
+    assert got == [rt_a]
+    got, _ = other.get_relation_tuples(RelationQuery(namespace="ns1"))
+    assert got == [rt_b]
+
+    # deletes are scoped too
+    other.delete_relation_tuples(rt_a)
+    got, _ = persister.get_relation_tuples(RelationQuery(namespace="ns1"))
+    assert got == [rt_a]

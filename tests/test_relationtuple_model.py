@@ -1,0 +1,135 @@
+"""Tuple model: string grammar, JSON, and URL-query codecs.
+
+Mirrors the parsing semantics of reference
+internal/relationtuple/definitions.go (see docstrings in
+keto_tpu/relationtuple/model.py for the file:line map).
+"""
+
+import pytest
+
+from keto_tpu.relationtuple import (
+    RelationQuery,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+    subject_from_string,
+)
+from keto_tpu.x.errors import (
+    ErrDroppedSubjectKey,
+    ErrDuplicateSubject,
+    ErrIncompleteSubject,
+    ErrMalformedInput,
+    ErrNilSubject,
+)
+
+
+class TestSubjectParsing:
+    def test_subject_id(self):
+        assert subject_from_string("user") == SubjectID(id="user")
+
+    def test_subject_set(self):
+        assert subject_from_string("ns:obj#rel") == SubjectSet("ns", "obj", "rel")
+
+    def test_empty_relation_subject_set(self):
+        # "..."-style any-relation sets have an empty relation; they are valid
+        # (reference engine_test.go:271-273)
+        assert subject_from_string("ns:obj#") == SubjectSet("ns", "obj", "")
+
+    @pytest.mark.parametrize("bad", ["a#b#c", "no-colon#rel", "a:b:c#rel"])
+    def test_malformed_subject_set(self, bad):
+        with pytest.raises(ErrMalformedInput):
+            subject_from_string(bad)
+
+    def test_roundtrip_strings(self):
+        for s in ["user", "ns:obj#rel", "n:o#"]:
+            assert str(subject_from_string(s)) == s
+
+
+class TestTupleString:
+    def test_parse_subject_id(self):
+        rt = RelationTuple.from_string("ns:obj#rel@user")
+        assert rt == RelationTuple("ns", "obj", "rel", SubjectID("user"))
+
+    def test_parse_subject_set_with_parens(self):
+        rt = RelationTuple.from_string("ns:obj#rel@(ns2:obj2#rel2)")
+        assert rt.subject == SubjectSet("ns2", "obj2", "rel2")
+
+    def test_parse_subject_set_without_parens(self):
+        rt = RelationTuple.from_string("ns:obj#rel@ns2:obj2#rel2")
+        assert rt.subject == SubjectSet("ns2", "obj2", "rel2")
+
+    @pytest.mark.parametrize("bad", ["no-separators", "ns:obj", "ns:obj#rel"])
+    def test_malformed(self, bad):
+        with pytest.raises(ErrMalformedInput):
+            RelationTuple.from_string(bad)
+
+    def test_str_roundtrip(self):
+        for s in ["ns:obj#rel@user", "ns:obj#rel@ns2:obj2#rel2"]:
+            assert str(RelationTuple.from_string(s)) == s
+
+
+class TestJSONCodec:
+    def test_subject_id_roundtrip(self):
+        rt = RelationTuple("n", "o", "r", SubjectID("u"))
+        assert RelationTuple.from_json(rt.to_json()) == rt
+        assert rt.to_json() == {"namespace": "n", "object": "o", "relation": "r", "subject_id": "u"}
+
+    def test_subject_set_roundtrip(self):
+        rt = RelationTuple("n", "o", "r", SubjectSet("n2", "o2", "r2"))
+        assert RelationTuple.from_json(rt.to_json()) == rt
+
+    def test_both_subjects_rejected(self):
+        with pytest.raises(ErrDuplicateSubject):
+            RelationTuple.from_json(
+                {
+                    "namespace": "n",
+                    "object": "o",
+                    "relation": "r",
+                    "subject_id": "u",
+                    "subject_set": {"namespace": "a", "object": "b", "relation": "c"},
+                }
+            )
+
+    def test_no_subject_rejected(self):
+        with pytest.raises(ErrNilSubject):
+            RelationTuple.from_json({"namespace": "n", "object": "o", "relation": "r"})
+
+
+class TestURLQueryCodec:
+    def test_tuple_roundtrip_subject_id(self):
+        rt = RelationTuple("n", "o", "r", SubjectID("u"))
+        assert RelationTuple.from_url_query(rt.to_url_query()) == rt
+
+    def test_tuple_roundtrip_subject_set(self):
+        rt = RelationTuple("n", "o", "r", SubjectSet("n2", "o2", "r2"))
+        assert RelationTuple.from_url_query(rt.to_url_query()) == rt
+
+    def test_dropped_subject_key(self):
+        with pytest.raises(ErrDroppedSubjectKey):
+            RelationQuery.from_url_query("namespace=n&subject=u")
+
+    def test_incomplete_subject_set(self):
+        with pytest.raises(ErrIncompleteSubject):
+            RelationQuery.from_url_query("namespace=n&subject_set.namespace=a")
+
+    def test_duplicate_subject(self):
+        q = (
+            "subject_id=u&subject_set.namespace=a"
+            "&subject_set.object=b&subject_set.relation=c"
+        )
+        with pytest.raises(ErrDuplicateSubject):
+            RelationQuery.from_url_query(q)
+
+    def test_query_without_subject_ok(self):
+        q = RelationQuery.from_url_query("namespace=n&object=o&relation=r")
+        assert q.subject is None
+        assert (q.namespace, q.object, q.relation) == ("n", "o", "r")
+
+    def test_tuple_requires_subject(self):
+        with pytest.raises(ErrNilSubject):
+            RelationTuple.from_url_query("namespace=n&object=o&relation=r")
+
+    def test_empty_values_preserved(self):
+        # empty relation in a subject set must survive the roundtrip
+        rt = RelationTuple("n", "o", "r", SubjectSet("n2", "o2", ""))
+        assert RelationTuple.from_url_query(rt.to_url_query()) == rt
