@@ -1,0 +1,292 @@
+"""Batched check engine: multi-source bit-packed BFS on TPU.
+
+Where the reference answers one ``Check`` by a recursive traversal issuing
+one SQL query per subject-set node per page (reference
+internal/check/engine.go:33-95), this engine answers **thousands of checks
+in one device program**:
+
+- up to 32·W queries are packed into a ``uint32[n_nodes+1, W]`` reached
+  bitmap ``R`` — bit ``q%32`` of word ``q//32`` in row ``v`` means "query q
+  has reached node v";
+- one BFS step is a **pull**: ``P[v] = OR over in-neighbors s of R[s]``,
+  computed per degree bucket as a gather + OR-reduction
+  (see keto_tpu/graph/snapshot.py for the layout rationale);
+- ``lax.while_loop`` iterates to the reachability fixpoint (the analog of
+  the reference's visited-set cycle guard — monotone bitmaps make cycles
+  terminate for free);
+- the answer for query q is the target-row bit of ``A = ⋃ pulls``, i.e.
+  "reached via ≥ 1 edge", reproducing the reference's rule that a subject
+  only matches via an actual tuple, never by being the queried set itself.
+
+Decision parity with the reference engine:
+- unknown namespace → denied, not an error (engine.go:76-77): host
+  resolution of a literal unknown namespace contributes no start nodes and
+  the query's answer bit can never be set;
+- empty namespace/object/relation fields wildcard the expansion exactly like
+  the reference's tuple query (relationtuples.go:218-235) — a wildcard
+  pattern resolves to *all* matching set nodes as BFS sources
+  (GraphSnapshot.resolve_starts);
+- pagination transparency: BFS has no pages, and reachability is
+  independent of the reference's page-at-a-time visit order;
+- the ``...``/empty-relation subtlety (engine_test.go:257-295): an empty
+  relation wildcards only the *expansion* of that subject set; it never
+  fabricates a transitive grant because matching stays literal.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.graph.snapshot import WILDCARD, GraphSnapshot, build_snapshot
+from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+from keto_tpu.x.errors import ErrNamespaceUnknown
+
+# batch widths (in 32-query words) the engine compiles for; a request is
+# padded up to the smallest fitting width so jit caches stay small
+_WORD_WIDTHS = (1, 8, 32, 128)
+# cap on the [rows, chunk, W] gather intermediate per bucket
+_DEGREE_CHUNK = 1024
+
+
+def _pull(
+    bucket_nbrs: Sequence[jnp.ndarray], bucket_valid_rows: Sequence[int], R: jnp.ndarray
+) -> jnp.ndarray:
+    """One BFS pull step. R: uint32[n_nodes+1, W] → uint32[n_nodes, W].
+
+    Buckets are contiguous in device-id order, so concatenating per-bucket
+    OR-reductions yields the full next-reached array with no scatter.
+    """
+    W = R.shape[1]
+    outs = []
+    for nbrs, n_valid in zip(bucket_nbrs, bucket_valid_rows):
+        n_pad, cap = nbrs.shape
+        if cap == 0:
+            outs.append(jnp.zeros((n_valid, W), jnp.uint32))
+            continue
+        acc = None
+        for c0 in range(0, cap, _DEGREE_CHUNK):
+            gathered = R[nbrs[:, c0 : c0 + _DEGREE_CHUNK]]  # [n_pad, chunk, W]
+            part = lax.reduce(gathered, np.uint32(0), lax.bitwise_or, (1,))
+            acc = part if acc is None else acc | part
+        outs.append(acc[:n_valid])
+    return jnp.concatenate(outs, axis=0)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "valid_rows", "it_cap"))
+def _check_kernel(
+    bucket_nbrs: tuple[jnp.ndarray, ...],
+    start_rows: jnp.ndarray,  # int32[SP] node device ids (padding → n_nodes)
+    start_words: jnp.ndarray,  # int32[SP] query word index
+    start_masks: jnp.ndarray,  # uint32[SP] query bit mask (padding → 0)
+    targets: jnp.ndarray,  # int32[B], n_nodes = unresolved
+    *,
+    n_nodes: int,
+    valid_rows: tuple[int, ...],
+    it_cap: int,
+) -> jnp.ndarray:
+    B = targets.shape[0]
+    W = B // 32
+    q = jnp.arange(B)
+    words = q // 32
+    bits = (q % 32).astype(jnp.uint32)
+    # per (row, word) slot, masks from distinct queries occupy distinct bits
+    # and per-query start lists are deduplicated on host, so scatter-add
+    # never carries — add on disjoint bits is bitwise OR
+    R0 = (
+        jnp.zeros((n_nodes + 1, W), jnp.uint32)
+        .at[start_rows, start_words]
+        .add(start_masks, mode="drop")
+    )
+    A0 = jnp.zeros((n_nodes, W), jnp.uint32)
+    zero_row = jnp.zeros((1, W), jnp.uint32)
+
+    def cond(carry):
+        _, _, changed, it = carry
+        return changed & (it < it_cap)
+
+    def body(carry):
+        R, A, _, it = carry
+        P = _pull(bucket_nbrs, valid_rows, R)
+        top = R[:n_nodes] | P
+        changed = jnp.any(top != R[:n_nodes])
+        return jnp.concatenate([top, zero_row], axis=0), A | P, changed, it + 1
+
+    _, A, _, _ = lax.while_loop(cond, body, (R0, A0, jnp.bool_(True), jnp.int32(0)))
+
+    Apad = jnp.concatenate([A, zero_row], axis=0)
+    hit = (Apad[targets, words] >> bits) & jnp.uint32(1)
+    return hit == 1
+
+
+def _ceil_pow2(x: int) -> int:
+    return 1 if x <= 1 else 1 << (int(x) - 1).bit_length()
+
+
+class TpuCheckEngine:
+    """Drop-in check engine answering batched queries on the device graph.
+
+    ``store`` must expose ``snapshot_rows() -> (rows, watermark)`` and
+    ``watermark()`` (keto_tpu/persistence/memory.py); ``namespaces`` is a
+    namespace.Manager or a zero-arg callable returning the current one
+    (hot-reload safe). This object is the TPU implementation behind the
+    registry's ``PermissionEngine()`` seam (reference
+    internal/driver/registry_default.go:158-163).
+    """
+
+    def __init__(
+        self,
+        store,
+        namespaces,
+        *,
+        it_cap: int = 4096,
+        max_batch: int = 32 * _WORD_WIDTHS[-1],
+    ):
+        self._store = store
+        if isinstance(namespaces, namespace_pkg.Manager):
+            self._nm: Callable[[], namespace_pkg.Manager] = lambda: namespaces
+        else:
+            self._nm = namespaces
+        self._it_cap = it_cap
+        self._max_batch = max_batch
+        self._lock = threading.Lock()
+        self._snapshot: Optional[GraphSnapshot] = None
+
+    # -- snapshot lifecycle --------------------------------------------------
+
+    def snapshot(self) -> GraphSnapshot:
+        """Current device snapshot, rebuilt iff the store moved past the
+        snapshot's watermark (double-buffered: checks against the old
+        snapshot finish while the new one is prepared)."""
+        snap = self._snapshot
+        wm = self._store.watermark()
+        if snap is not None and snap.snapshot_id == wm:
+            return snap
+        with self._lock:
+            snap = self._snapshot
+            wm = self._store.watermark()
+            if snap is not None and snap.snapshot_id == wm:
+                return snap
+            rows, wm = self._store.snapshot_rows()
+            wild_ns_ids = frozenset(
+                n.id for n in self._nm().namespaces() if n.name == ""
+            )
+            snap = build_snapshot(rows, wm, wild_ns_ids)
+            snap.device_buckets = tuple(jax.device_put(b.nbrs) for b in snap.buckets)
+            self._snapshot = snap
+            return snap
+
+    # -- resolution ----------------------------------------------------------
+
+    def _resolve_ns(self, name: str) -> Optional[int]:
+        """Namespace name → id; "" wildcards (never resolved, like reference
+        relationtuples.go:230-235); unknown → None (denied)."""
+        if name == "":
+            return WILDCARD
+        try:
+            return self._nm().get_namespace_by_name(name).id
+        except ErrNamespaceUnknown:
+            return None
+
+    def _resolve(
+        self, snap: GraphSnapshot, rt: RelationTuple
+    ) -> tuple[np.ndarray, int]:
+        """(start device ids, target device id); phantom target = n_nodes."""
+        miss = snap.n_nodes
+        none = np.zeros(0, np.int64)
+        ns_id = self._resolve_ns(rt.namespace)
+        if ns_id is None:
+            return none, miss  # unknown namespace → denied (engine.go:76-77)
+        starts = snap.resolve_starts(ns_id, rt.object, rt.relation)
+        if starts.size == 0:
+            return none, miss
+        if isinstance(rt.subject, SubjectID):
+            target = snap.resolve_leaf(rt.subject.id)
+        elif isinstance(rt.subject, SubjectSet):
+            sns_id = self._resolve_ns(rt.subject.namespace)
+            if sns_id is None:
+                return none, miss
+            if sns_id == WILDCARD:
+                # subjects are matched literally; an empty subject namespace
+                # can only equal a stored subject in a namespace named ""
+                wild = [i for i in snap.wild_ns_ids]
+                target = (
+                    snap.resolve_set(wild[0], rt.subject.object, rt.subject.relation)
+                    if wild
+                    else None
+                )
+            else:
+                target = snap.resolve_set(sns_id, rt.subject.object, rt.subject.relation)
+        else:
+            return none, miss
+        if target is None:
+            return starts, miss  # live BFS, but the bit can never match
+        return starts, target
+
+    # -- public API ----------------------------------------------------------
+
+    def batch_check(self, tuples: Sequence[RelationTuple]) -> list[bool]:
+        snap = self.snapshot()
+        if snap.n_nodes == 0 or snap.n_edges == 0 or not tuples:
+            return [False] * len(tuples)
+
+        out: list[bool] = []
+        for off in range(0, len(tuples), self._max_batch):
+            chunk = tuples[off : off + self._max_batch]
+            out.extend(self._device_batch(snap, chunk))
+        return out
+
+    def _device_batch(
+        self, snap: GraphSnapshot, tuples: Sequence[RelationTuple]
+    ) -> list[bool]:
+        nq = len(tuples)
+        W = next(w for w in _WORD_WIDTHS if 32 * w >= nq)
+        B = 32 * W
+        targets = np.full(B, snap.n_nodes, dtype=np.int32)
+        rows_l: list[np.ndarray] = []
+        words_l: list[np.ndarray] = []
+        masks_l: list[np.ndarray] = []
+        any_live = False
+        for i, rt in enumerate(tuples):
+            starts, t = self._resolve(snap, rt)
+            targets[i] = t
+            if starts.size:
+                any_live = True
+                rows_l.append(starts)
+                words_l.append(np.full(starts.size, i // 32, np.int32))
+                masks_l.append(np.full(starts.size, np.uint32(1) << np.uint32(i % 32)))
+        if not any_live:
+            return [False] * nq
+
+        rows = np.concatenate(rows_l).astype(np.int32)
+        words = np.concatenate(words_l)
+        masks = np.concatenate(masks_l)
+        sp = _ceil_pow2(max(rows.size, 32))
+        pad = sp - rows.size
+        rows = np.concatenate([rows, np.full(pad, snap.n_nodes, np.int32)])
+        words = np.concatenate([words, np.zeros(pad, np.int32)])
+        masks = np.concatenate([masks, np.zeros(pad, np.uint32)])
+
+        allowed = _check_kernel(
+            snap.device_buckets,
+            jnp.asarray(rows),
+            jnp.asarray(words),
+            jnp.asarray(masks),
+            jnp.asarray(targets),
+            n_nodes=snap.n_nodes,
+            valid_rows=tuple(b.n for b in snap.buckets),
+            it_cap=self._it_cap,
+        )
+        return [bool(x) for x in np.asarray(allowed)[:nq]]
+
+    def subject_is_allowed(self, requested: RelationTuple) -> bool:
+        """Single-query convenience with the oracle engine's signature
+        (reference internal/check/engine.go:93-95)."""
+        return self.batch_check([requested])[0]

@@ -1,0 +1,22 @@
+"""Tuple-graph machinery for the TPU check engine.
+
+The reference answers ``Check`` by a recursive traversal that issues one SQL
+query per subject-set node per page (reference internal/check/engine.go:33-95).
+Here the tuple set is interned into a static int32 node/edge graph snapshot
+(``keto_tpu.graph.interner``, ``keto_tpu.graph.snapshot``) laid out for
+gather-only breadth-first reachability on TPU
+(``keto_tpu.check.tpu_engine``).
+"""
+
+from keto_tpu.graph.interner import InternedGraph, intern_rows, LEAF_KIND, SET_KIND
+from keto_tpu.graph.snapshot import GraphSnapshot, WILDCARD, build_snapshot
+
+__all__ = [
+    "InternedGraph",
+    "intern_rows",
+    "GraphSnapshot",
+    "build_snapshot",
+    "WILDCARD",
+    "LEAF_KIND",
+    "SET_KIND",
+]

@@ -1,0 +1,227 @@
+"""Device-friendly graph snapshot: bucketed reverse-ELL adjacency.
+
+The TPU check kernel (keto_tpu/check/tpu_engine.py) runs breadth-first
+reachability as a **pull**: per step, every node ORs the reached-bitmaps of
+its *in*-neighbors. A pull step is gather-only — TPUs gather well but
+serialize scatters with colliding indices, so the layout makes the inner
+loop pure gathers + OR-reductions:
+
+- nodes are **renumbered** so nodes with similar in-degree are contiguous
+  ("device ids"), grouped into power-of-two degree buckets;
+- each bucket stores a dense ``[rows, degree]`` int32 matrix of in-neighbor
+  device ids (ELL format), padded with a sentinel id ``n_nodes`` that points
+  at a phantom all-zero bitmap row;
+- bucket row counts are padded to powers of two so a snapshot rebuild after
+  tuple writes usually keeps the same array shapes and hits the jit cache.
+
+Because buckets are contiguous in device-id order, the pull output is the
+concatenation of per-bucket OR-reductions — no scatter anywhere.
+
+This layout replaces the reference's covering SQL index as the check hot
+path's data structure (reference
+internal/persistence/sql/migrations/sql/20210623162417000003_relationtuple.postgres.up.sql:1-9).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, FrozenSet, Iterable, Optional
+
+import numpy as np
+
+from keto_tpu.graph.interner import InternedGraph, intern_rows
+
+#: namespace sentinel meaning "wildcard" in a resolved query pattern
+WILDCARD = -1
+
+
+def _ceil_pow2(x: int) -> int:
+    return 1 if x <= 1 else 1 << (int(x) - 1).bit_length()
+
+
+@dataclass
+class Bucket:
+    """One in-degree bucket: ``nbrs[i, j]`` is the device id of the j-th
+    in-neighbor of device node ``offset + i`` (sentinel ``n_nodes`` when
+    padding)."""
+
+    offset: int  # device id of the first row
+    n: int  # valid rows (bucket membership)
+    nbrs: np.ndarray  # int32 [n_padded, degree_capacity]
+
+
+@dataclass
+class GraphSnapshot:
+    """An immutable device-layout view of the tuple set at one watermark.
+
+    The watermark doubles as the snapshot id — the real implementation of
+    what the reference stubs as "snaptoken" (reference
+    internal/check/handler.go:162).
+    """
+
+    snapshot_id: int
+    num_sets: int
+    num_leaves: int
+    buckets: list[Bucket]
+    set_dev: dict[tuple[int, str, str], int]  # (ns_id, obj, rel) → device id
+    leaf_dev: dict[str, int]  # subject-id string → device id
+    # set-node key fields aligned with *raw* set index, for wildcard matching
+    key_ns: np.ndarray
+    key_obj: np.ndarray
+    key_rel: np.ndarray
+    obj_codes: dict[str, int]
+    rel_codes: dict[str, int]
+    set_raw2dev: np.ndarray  # int64 [num_sets]
+    wild_ns_ids: FrozenSet[int] = frozenset()
+    # forward CSR over device ids, host-side (expand assist, debugging)
+    fwd_indptr: Optional[np.ndarray] = None  # int64 [n_nodes+1]
+    fwd_indices: Optional[np.ndarray] = None  # int32 [E]
+    device_buckets: Any = None  # jnp arrays, populated lazily by the engine
+    _pattern_cache: dict = field(default_factory=dict)
+    _cache_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    @property
+    def n_nodes(self) -> int:
+        return self.num_sets + self.num_leaves
+
+    @property
+    def n_edges(self) -> int:
+        return 0 if self.fwd_indices is None else int(self.fwd_indices.shape[0])
+
+    def resolve_set(self, ns_id: int, obj: str, rel: str) -> Optional[int]:
+        return self.set_dev.get((ns_id, obj, rel))
+
+    def resolve_leaf(self, subject_id: str) -> Optional[int]:
+        return self.leaf_dev.get(subject_id)
+
+    def resolve_starts(self, ns_id: int, obj: str, rel: str) -> np.ndarray:
+        """Device ids of the set nodes a check starting at ``(ns, obj, rel)``
+        expands — the graph analog of the reference's wildcarding tuple query
+        (reference internal/persistence/sql/relationtuples.go:218-235).
+
+        ``ns_id == WILDCARD`` (empty namespace name) wildcards the namespace;
+        empty ``obj``/``rel`` wildcard those fields. A fully literal pattern
+        resolves to at most one node. For wildcard patterns, every node key
+        matching the pattern is a start: the union of their out-edges is
+        exactly the subjects of the pattern's matching tuples (a matching
+        key's query is always a sub-query of the pattern's).
+        """
+        ns_wild = ns_id == WILDCARD or ns_id in self.wild_ns_ids
+        if not ns_wild and obj != "" and rel != "":
+            dev = self.set_dev.get((ns_id, obj, rel))
+            return np.asarray([] if dev is None else [dev], np.int64)
+
+        key = (WILDCARD if ns_wild else ns_id, obj if obj != "" else None, rel if rel != "" else None)
+        with self._cache_lock:
+            hit = self._pattern_cache.get(key)
+        if hit is not None:
+            return hit
+        m = np.ones(self.num_sets, bool)
+        if not ns_wild:
+            m &= self.key_ns == ns_id
+        if obj != "":
+            code = self.obj_codes.get(obj)
+            m &= (self.key_obj == code) if code is not None else False
+        if rel != "":
+            code = self.rel_codes.get(rel)
+            m &= (self.key_rel == code) if code is not None else False
+        starts = self.set_raw2dev[np.nonzero(m)[0]]
+        with self._cache_lock:
+            self._pattern_cache[key] = starts
+        return starts
+
+
+def build_snapshot(
+    rows: Iterable, watermark: int, wild_ns_ids: FrozenSet[int] = frozenset()
+) -> GraphSnapshot:
+    """Intern rows and lay out the bucketed reverse-ELL adjacency.
+
+    ``wild_ns_ids``: ids of configured namespaces whose *name* is the empty
+    string — their set nodes expand with a wildcarded namespace.
+    """
+    g: InternedGraph = intern_rows(rows, wild_ns_ids)
+    src_raw, dst_raw = g.src, g.dst
+    n = g.num_nodes
+
+    if n == 0:
+        return GraphSnapshot(
+            snapshot_id=watermark,
+            num_sets=0,
+            num_leaves=0,
+            buckets=[],
+            set_dev={},
+            leaf_dev={},
+            key_ns=np.zeros(0, np.int64),
+            key_obj=np.zeros(0, np.int64),
+            key_rel=np.zeros(0, np.int64),
+            obj_codes={},
+            rel_codes={},
+            set_raw2dev=np.zeros(0, np.int64),
+            wild_ns_ids=wild_ns_ids,
+            fwd_indptr=np.zeros(1, np.int64),
+            fwd_indices=np.zeros(0, np.int32),
+        )
+
+    in_deg = np.bincount(dst_raw, minlength=n)
+    # bucket key: 0 for nodes without in-edges, else ceil-log2(degree) + 1
+    with np.errstate(divide="ignore"):
+        bucket_key = np.where(
+            in_deg == 0, 0, np.ceil(np.log2(np.maximum(in_deg, 1))).astype(np.int64) + 1
+        )
+    bucket_key[in_deg == 1] = 1
+
+    # renumber: device order sorts by (bucket, raw id); raw2dev inverts it
+    dev_order = np.lexsort((np.arange(n), bucket_key))
+    raw2dev = np.empty(n, dtype=np.int64)
+    raw2dev[dev_order] = np.arange(n)
+
+    # group edges by destination device id; cumcount gives the column slot
+    dst_dev = raw2dev[dst_raw]
+    src_dev = raw2dev[src_raw]
+    order = np.argsort(dst_dev, kind="stable")
+    dst_sorted = dst_dev[order]
+    src_sorted = src_dev[order].astype(np.int32)
+    starts = np.searchsorted(dst_sorted, np.arange(n))
+    cumcount = np.arange(dst_sorted.shape[0]) - starts[dst_sorted]
+
+    key_by_dev = bucket_key[dev_order]
+    buckets: list[Bucket] = []
+    sentinel = np.int32(n)
+    for key in np.unique(key_by_dev):
+        members = np.nonzero(key_by_dev == key)[0]  # contiguous by construction
+        offset, n_rows = int(members[0]), int(members.shape[0])
+        cap = 0 if key == 0 else 1 << (int(key) - 1)
+        n_pad = _ceil_pow2(n_rows)
+        nbrs = np.full((n_pad, cap), sentinel, dtype=np.int32)
+        if cap:
+            edge_mask = (dst_sorted >= offset) & (dst_sorted < offset + n_rows)
+            nbrs[dst_sorted[edge_mask] - offset, cumcount[edge_mask]] = src_sorted[edge_mask]
+        buckets.append(Bucket(offset=offset, n=n_rows, nbrs=nbrs))
+
+    # host-side forward CSR (device ids), for expand assist & introspection
+    forder = np.argsort(src_dev, kind="stable")
+    fsrc = src_dev[forder]
+    findices = dst_dev[forder].astype(np.int32)
+    findptr = np.searchsorted(fsrc, np.arange(n + 1))
+
+    set_dev = {key: int(raw2dev[raw]) for key, raw in g.set_ids.items()}
+    leaf_dev = {key: int(raw2dev[raw + g.num_sets]) for key, raw in g.leaf_ids.items()}
+
+    return GraphSnapshot(
+        snapshot_id=watermark,
+        num_sets=g.num_sets,
+        num_leaves=g.num_leaves,
+        buckets=buckets,
+        set_dev=set_dev,
+        leaf_dev=leaf_dev,
+        key_ns=g.key_ns,
+        key_obj=g.key_obj,
+        key_rel=g.key_rel,
+        obj_codes=g.obj_codes,
+        rel_codes=g.rel_codes,
+        set_raw2dev=raw2dev[: g.num_sets],
+        wild_ns_ids=wild_ns_ids,
+        fwd_indptr=findptr,
+        fwd_indices=findices,
+    )

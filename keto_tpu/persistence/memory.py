@@ -101,6 +101,12 @@ class MemoryPersister(Manager):
         self.network_id = network_id
         self._shared = _shared or _SharedState()
 
+    @property
+    def namespaces(self):
+        """Zero-arg callable returning the current namespace manager — the
+        namespace source handed to engines built over this store."""
+        return self._nm
+
     def with_network(self, network_id: str) -> "MemoryPersister":
         """A second view over the same physical store bound to another
         network — the analog of two server deployments sharing one database
