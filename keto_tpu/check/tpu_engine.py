@@ -80,8 +80,7 @@ def _pull(
     return jnp.concatenate(outs, axis=0)
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "valid_rows", "it_cap"))
-def _check_kernel(
+def check_step(
     bucket_nbrs: tuple[jnp.ndarray, ...],
     start_rows: jnp.ndarray,  # int32[SP] node device ids (padding → n_nodes)
     start_words: jnp.ndarray,  # int32[SP] query word index
@@ -91,6 +90,7 @@ def _check_kernel(
     n_nodes: int,
     valid_rows: tuple[int, ...],
     it_cap: int,
+    bitmap_sharding=None,  # NamedSharding for the [rows, words] bitmaps
 ) -> jnp.ndarray:
     B = targets.shape[0]
     W = B // 32
@@ -106,6 +106,12 @@ def _check_kernel(
         .add(start_masks, mode="drop")
     )
     A0 = jnp.zeros((n_nodes, W), jnp.uint32)
+    if bitmap_sharding is not None:
+        # "data" shards words (embarrassingly parallel); "graph" shards rows
+        # and lets the SPMD partitioner insert the per-step all-gather the
+        # pull's cross-shard row gathers need
+        R0 = lax.with_sharding_constraint(R0, bitmap_sharding)
+        A0 = lax.with_sharding_constraint(A0, bitmap_sharding)
     zero_row = jnp.zeros((1, W), jnp.uint32)
 
     def cond(carry):
@@ -124,6 +130,13 @@ def _check_kernel(
     Apad = jnp.concatenate([A, zero_row], axis=0)
     hit = (Apad[targets, words] >> bits) & jnp.uint32(1)
     return hit == 1
+
+
+#: jitted entrypoint used by the engine; ``check_step`` stays un-jitted for
+#: ahead-of-time compile checks (__graft_entry__.py)
+_check_kernel = partial(
+    jax.jit, static_argnames=("n_nodes", "valid_rows", "it_cap", "bitmap_sharding")
+)(check_step)
 
 
 def _ceil_pow2(x: int) -> int:
@@ -148,6 +161,8 @@ class TpuCheckEngine:
         *,
         it_cap: int = 4096,
         max_batch: int = 32 * _WORD_WIDTHS[-1],
+        mesh=None,
+        shard_rows: bool = False,
     ):
         self._store = store
         if isinstance(namespaces, namespace_pkg.Manager):
@@ -156,6 +171,18 @@ class TpuCheckEngine:
             self._nm = namespaces
         self._it_cap = it_cap
         self._max_batch = max_batch
+        self._mesh = mesh
+        self._shard_rows = shard_rows
+        self._bitmap_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from keto_tpu.parallel.mesh import DATA_AXIS, GRAPH_AXIS
+
+            row_axis = GRAPH_AXIS if shard_rows else None
+            self._bitmap_sharding = NamedSharding(mesh, P(row_axis, DATA_AXIS))
+            self._bucket_sharding = NamedSharding(mesh, P(GRAPH_AXIS, None))
+            self._replicated = NamedSharding(mesh, P(None, None))
         self._lock = threading.Lock()
         self._snapshot: Optional[GraphSnapshot] = None
 
@@ -179,7 +206,19 @@ class TpuCheckEngine:
                 n.id for n in self._nm().namespaces() if n.name == ""
             )
             snap = build_snapshot(rows, wm, wild_ns_ids)
-            snap.device_buckets = tuple(jax.device_put(b.nbrs) for b in snap.buckets)
+            if self._mesh is None:
+                snap.device_buckets = tuple(jax.device_put(b.nbrs) for b in snap.buckets)
+            else:
+                graph_size = self._mesh.shape.get("graph", 1)
+                snap.device_buckets = tuple(
+                    jax.device_put(
+                        b.nbrs,
+                        self._bucket_sharding
+                        if b.nbrs.shape[0] % graph_size == 0
+                        else self._replicated,
+                    )
+                    for b in snap.buckets
+                )
             self._snapshot = snap
             return snap
 
@@ -283,6 +322,7 @@ class TpuCheckEngine:
             n_nodes=snap.n_nodes,
             valid_rows=tuple(b.n for b in snap.buckets),
             it_cap=self._it_cap,
+            bitmap_sharding=self._bitmap_sharding,
         )
         return [bool(x) for x in np.asarray(allowed)[:nq]]
 

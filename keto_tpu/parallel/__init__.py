@@ -1,0 +1,22 @@
+"""Device-mesh parallelism for the TPU permission framework.
+
+The reference scales by running stateless Go replicas against one SQL
+database (reference internal/driver/daemon.go:62-69, SURVEY §2.3); the TPU
+build scales inside the pod over a 2-D ``jax.sharding.Mesh``:
+
+- axis ``"data"`` — batch parallelism: the bit-packed query words of the
+  check bitmap are sharded across devices; every device runs BFS over the
+  whole graph for its slice of queries with zero cross-device traffic (the
+  DP analog of one-goroutine-per-request);
+- axis ``"graph"`` — graph parallelism: bucket rows and reached-bitmap rows
+  are sharded across devices; XLA's SPMD partitioner inserts the all-gather
+  of the reached bitmap each pull step needs (the TP analog — per
+  BASELINE.json config 5, a 50M-tuple graph spans 4 chips).
+
+Collectives ride ICI; nothing here speaks NCCL/MPI — the host serving plane
+stays on gRPC/REST over DCN (SURVEY §2.3 table).
+"""
+
+from keto_tpu.parallel.mesh import DATA_AXIS, GRAPH_AXIS, make_mesh
+
+__all__ = ["make_mesh", "DATA_AXIS", "GRAPH_AXIS"]

@@ -1,0 +1,34 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+GRAPH_AXIS = "graph"
+DATA_AXIS = "data"
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None,
+    graph: int = 1,
+    data: Optional[int] = None,
+) -> Mesh:
+    """A ``(graph, data)`` mesh over ``devices`` (default: all local devices).
+
+    ``graph`` devices shard the graph's node rows; the rest shard query
+    words. ``data=None`` uses every remaining device.
+    """
+    devices = list(jax.devices() if devices is None else devices)
+    if data is None:
+        if len(devices) % graph:
+            raise ValueError(f"{len(devices)} devices not divisible by graph={graph}")
+        data = len(devices) // graph
+    n = graph * data
+    if n > len(devices):
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(graph, data)
+    return Mesh(grid, (GRAPH_AXIS, DATA_AXIS))

@@ -8,10 +8,16 @@ must be set before JAX is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# force CPU even when the ambient environment pins JAX_PLATFORMS / a
+# sitecustomize registers a TPU plugin: tests need the virtual 8-device
+# mesh; real-chip runs happen via bench.py
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
