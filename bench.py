@@ -1,0 +1,198 @@
+"""Real-chip benchmark: batched Check throughput vs the reference algorithm.
+
+Workload (BASELINE.json config 3 shape): an RBAC permission graph with
+3-level group nesting — users ∈ leaf groups ∈ mid groups ∈ top groups,
+documents granting "view" to a group — at ~1M tuples, answering 100k check
+queries (half grants, half denials).
+
+Baseline: the reference's recursive check algorithm (keto_tpu/check/engine.py
+is a faithful re-implementation of reference internal/check/engine.go:33-95)
+run against the same in-memory store. That is *generous* to the reference —
+its real deployment pays one SQL round-trip per traversal step per page
+(SURVEY §3.2); here it pays a dict lookup. Reference publishes no numbers of
+its own (docs/docs/performance.mdx:58-59, BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": "check_throughput", "value": N, "unit": "checks/s",
+   "vs_baseline": ratio, ...detail fields}
+
+Env knobs: BENCH_TUPLES (~1e6), BENCH_CHECKS (1e5), BENCH_ORACLE_SAMPLE (2000).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_workload(rng, n_tuples):
+    """Returns (rows-as-tuples list for the persister, check queries, expected)."""
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    def T(ns, obj, rel, sub):
+        return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+    # proportions chosen so totals scale linearly with n_tuples
+    n_users = max(100, n_tuples // 10)
+    n_leaf = max(20, n_tuples // 125)
+    n_mid = max(5, n_leaf // 5)
+    n_top = max(2, n_mid // 4)
+
+    tuples = []
+    membership = {}  # user → set of leaf groups (for expected answers)
+    for u in range(n_users):
+        for _ in range(rng.choice((1, 1, 2))):
+            g = rng.randrange(n_leaf)
+            membership.setdefault(u, set()).add(g)
+            tuples.append(T("groups", f"leaf-{g}", "member", SubjectID(f"user-{u}")))
+
+    leaf_parent = {}
+    for g in range(n_leaf):
+        parent = rng.randrange(n_mid)
+        leaf_parent[g] = parent
+        tuples.append(
+            T("groups", f"mid-{parent}", "member", SubjectSet("groups", f"leaf-{g}", "member"))
+        )
+    mid_parent = {}
+    for m in range(n_mid):
+        parent = rng.randrange(n_top)
+        mid_parent[m] = parent
+        tuples.append(
+            T("groups", f"top-{parent}", "member", SubjectSet("groups", f"mid-{m}", "member"))
+        )
+
+    doc_grant = {}
+    d = 0
+    while len(tuples) < n_tuples:
+        kind, idx = rng.choice((("leaf", n_leaf), ("mid", n_mid), ("top", n_top)))
+        g = rng.randrange(idx)
+        doc_grant[d] = (kind, g)
+        tuples.append(
+            T("docs", f"doc-{d}", "view", SubjectSet("groups", f"{kind}-{g}", "member"))
+        )
+        d += 1
+
+    def user_reaches(u, kind, g):
+        leaves = membership.get(u, set())
+        if kind == "leaf":
+            return g in leaves
+        mids = {leaf_parent[l] for l in leaves}
+        if kind == "mid":
+            return g in mids
+        return g in {mid_parent[m] for m in mids}
+
+    return tuples, doc_grant, membership, user_reaches, n_users, T
+
+
+def make_queries(rng, n_checks, doc_grant, n_users, user_reaches, T):
+    from keto_tpu.relationtuple.model import SubjectID
+
+    docs = list(doc_grant)
+    queries, expected = [], []
+    for _ in range(n_checks):
+        d = rng.choice(docs)
+        u = rng.randrange(n_users)
+        kind, g = doc_grant[d]
+        queries.append(T("docs", f"doc-{d}", "view", SubjectID(f"user-{u}")))
+        expected.append(user_reaches(u, kind, g))
+    return queries, expected
+
+
+def main():
+    n_tuples = int(os.environ.get("BENCH_TUPLES", 1_000_000))
+    n_checks = int(os.environ.get("BENCH_CHECKS", 100_000))
+    oracle_sample = int(os.environ.get("BENCH_ORACLE_SAMPLE", 2_000))
+    rng = random.Random(42)
+
+    import jax
+
+    from keto_tpu import namespace as namespace_pkg
+    from keto_tpu.check import CheckEngine
+    from keto_tpu.check.tpu_engine import TpuCheckEngine
+    from keto_tpu.persistence.memory import MemoryPersister
+
+    log(f"devices: {jax.devices()}")
+    t0 = time.perf_counter()
+    tuples, doc_grant, membership, user_reaches, n_users, T = build_workload(rng, n_tuples)
+    log(f"workload: {len(tuples)} tuples in {time.perf_counter()-t0:.1f}s")
+
+    nm = namespace_pkg.MemoryManager(
+        [namespace_pkg.Namespace(id=1, name="groups"), namespace_pkg.Namespace(id=2, name="docs")]
+    )
+    store = MemoryPersister(nm)
+    t0 = time.perf_counter()
+    store.write_relation_tuples(*tuples)
+    ingest_s = time.perf_counter() - t0
+    log(f"ingest: {ingest_s:.1f}s")
+
+    engine = TpuCheckEngine(store, store.namespaces)
+    t0 = time.perf_counter()
+    snap = engine.snapshot()
+    snapshot_s = time.perf_counter() - t0
+    log(f"snapshot: {snap.n_nodes} nodes, {snap.n_edges} edges in {snapshot_s:.1f}s")
+
+    queries, expected = make_queries(rng, n_checks, doc_grant, n_users, user_reaches, T)
+
+    # warmup (compile) on a full-width batch
+    t0 = time.perf_counter()
+    engine.batch_check(queries[: engine._max_batch])
+    log(f"warmup/compile: {time.perf_counter()-t0:.1f}s")
+
+    t0 = time.perf_counter()
+    got = engine.batch_check(queries)
+    tpu_s = time.perf_counter() - t0
+    tpu_qps = n_checks / tpu_s
+
+    n_wrong = sum(g != e for g, e in zip(got, expected))
+    if n_wrong:
+        log(f"CORRECTNESS FAILURE: {n_wrong}/{n_checks} mismatches vs analytic expectation")
+
+    # oracle baseline on a subsample
+    oracle = CheckEngine(store)
+    sample = queries[:oracle_sample]
+    t0 = time.perf_counter()
+    oracle_got = [oracle.subject_is_allowed(q) for q in sample]
+    oracle_s = time.perf_counter() - t0
+    oracle_qps = len(sample) / oracle_s
+    oracle_wrong = sum(g != e for g, e in zip(oracle_got, expected[: len(sample)]))
+    mismatch_vs_oracle = sum(g != o for g, o in zip(got[: len(sample)], oracle_got))
+    log(
+        f"tpu: {tpu_qps:,.0f} checks/s ({tpu_s*1e3:.1f} ms for {n_checks}); "
+        f"oracle: {oracle_qps:,.0f} checks/s; oracle_wrong={oracle_wrong} "
+        f"tpu_vs_oracle_mismatch={mismatch_vs_oracle}"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "check_throughput",
+                "value": round(tpu_qps, 1),
+                "unit": "checks/s",
+                "vs_baseline": round(tpu_qps / oracle_qps, 2),
+                "detail": {
+                    "tuples": len(tuples),
+                    "checks": n_checks,
+                    "nodes": snap.n_nodes,
+                    "edges": snap.n_edges,
+                    "tpu_batch_ms_total": round(tpu_s * 1e3, 1),
+                    "snapshot_build_s": round(snapshot_s, 2),
+                    "ingest_s": round(ingest_s, 2),
+                    "oracle_checks_per_s": round(oracle_qps, 1),
+                    "correct_vs_expected": n_wrong == 0,
+                    "tpu_oracle_mismatches": mismatch_vs_oracle,
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -51,7 +51,7 @@ from keto_tpu.x.errors import ErrNamespaceUnknown
 
 # batch widths (in 32-query words) the engine compiles for; a request is
 # padded up to the smallest fitting width so jit caches stay small
-_WORD_WIDTHS = (1, 8, 32, 128)
+_WORD_WIDTHS = (1, 8, 64, 256)
 # cap on the [rows, chunk, W] gather intermediate per bucket
 _DEGREE_CHUNK = 1024
 
@@ -59,25 +59,26 @@ _DEGREE_CHUNK = 1024
 def _pull(
     bucket_nbrs: Sequence[jnp.ndarray], bucket_valid_rows: Sequence[int], R: jnp.ndarray
 ) -> jnp.ndarray:
-    """One BFS pull step. R: uint32[n_nodes+1, W] → uint32[n_nodes, W].
+    """One BFS pull step over the live (in-edged) rows.
 
-    Buckets are contiguous in device-id order, so concatenating per-bucket
-    OR-reductions yields the full next-reached array with no scatter.
+    R: uint32[n_nodes+1, W] → uint32[n_live, W]. Zero-in-degree nodes sort
+    last in device order (their rows never change after initialization), so
+    the pull only produces the live prefix. Buckets are contiguous in
+    device-id order — concatenating per-bucket OR-reductions yields the
+    prefix with no scatter.
     """
-    W = R.shape[1]
     outs = []
     for nbrs, n_valid in zip(bucket_nbrs, bucket_valid_rows):
         n_pad, cap = nbrs.shape
         if cap == 0:
-            outs.append(jnp.zeros((n_valid, W), jnp.uint32))
-            continue
+            continue  # zero-in-degree tail: not part of the live prefix
         acc = None
         for c0 in range(0, cap, _DEGREE_CHUNK):
             gathered = R[nbrs[:, c0 : c0 + _DEGREE_CHUNK]]  # [n_pad, chunk, W]
             part = lax.reduce(gathered, np.uint32(0), lax.bitwise_or, (1,))
-            acc = part if acc is None else acc | part
+            acc = part if acc is None else lax.bitwise_or(acc, part)
         outs.append(acc[:n_valid])
-    return jnp.concatenate(outs, axis=0)
+    return jnp.concatenate(outs, axis=0) if outs else R[:0]
 
 
 def check_step(
@@ -90,10 +91,12 @@ def check_step(
     n_nodes: int,
     valid_rows: tuple[int, ...],
     it_cap: int,
+    block_iters: int = 8,
     bitmap_sharding=None,  # NamedSharding for the [rows, words] bitmaps
-) -> jnp.ndarray:
+) -> tuple[jnp.ndarray, jnp.ndarray]:
     B = targets.shape[0]
     W = B // 32
+    n_live = sum(n for (nb, n) in zip(bucket_nbrs, valid_rows) if nb.shape[1] > 0)
     q = jnp.arange(B)
     words = q // 32
     bits = (q % 32).astype(jnp.uint32)
@@ -105,42 +108,100 @@ def check_step(
         .at[start_rows, start_words]
         .add(start_masks, mode="drop")
     )
-    A0 = jnp.zeros((n_nodes, W), jnp.uint32)
     if bitmap_sharding is not None:
         # "data" shards words (embarrassingly parallel); "graph" shards rows
         # and lets the SPMD partitioner insert the per-step all-gather the
         # pull's cross-shard row gathers need
         R0 = lax.with_sharding_constraint(R0, bitmap_sharding)
-        A0 = lax.with_sharding_constraint(A0, bitmap_sharding)
-    zero_row = jnp.zeros((1, W), jnp.uint32)
+    # rows past n_live (zero-in-degree nodes + the phantom sentinel) never
+    # change — only the live prefix is carried through the loop
+    static_tail = R0[n_live:]
 
-    def cond(carry):
-        _, _, changed, it = carry
-        return changed & (it < it_cap)
+    def step(live):
+        R = jnp.concatenate([live, static_tail], axis=0)
+        nxt = lax.bitwise_or(_pull(bucket_nbrs, valid_rows, R), live)
+        return nxt, jnp.any(nxt != live)
 
-    def body(carry):
-        R, A, _, it = carry
-        P = _pull(bucket_nbrs, valid_rows, R)
-        top = R[:n_nodes] | P
-        changed = jnp.any(top != R[:n_nodes])
-        return jnp.concatenate([top, zero_row], axis=0), A | P, changed, it + 1
+    # The while cond is the only point the runtime must observe a device
+    # value, which costs a full round trip on tunneled devices — so each
+    # while iteration runs a *block* of pulls, each skipped via lax.cond
+    # once the fixpoint is reached (monotone bitmaps: converged stays
+    # converged). Steady state: one observation per batch.
+    def block(carry):
+        def one(_, st):
+            live, changed, it = st
+            nxt, ch = lax.cond(
+                changed, step, lambda l: (l, jnp.bool_(False)), live
+            )
+            return nxt, ch, it + changed.astype(jnp.int32)
+        return lax.fori_loop(0, block_iters, one, carry)
 
-    _, A, _, _ = lax.while_loop(cond, body, (R0, A0, jnp.bool_(True), jnp.int32(0)))
+    live, _, iters = lax.while_loop(
+        lambda c: c[1] & (c[2] < it_cap), block, (R0[:n_live], jnp.bool_(True), jnp.int32(0))
+    )
 
-    Apad = jnp.concatenate([A, zero_row], axis=0)
-    hit = (Apad[targets, words] >> bits) & jnp.uint32(1)
-    return hit == 1
+    # answers require "reached via ≥ 1 edge": one more pull of the fixpoint,
+    # without the OR of start bits; unreachable rows (no in-edges) stay zero
+    R_fix = jnp.concatenate([live, static_tail], axis=0)
+    A = jnp.concatenate(
+        [_pull(bucket_nbrs, valid_rows, R_fix), jnp.zeros((n_nodes + 1 - n_live, W), jnp.uint32)],
+        axis=0,
+    )
+    hit = (A[targets, words] >> bits) & jnp.uint32(1)
+    return hit == 1, iters
 
 
 #: jitted entrypoint used by the engine; ``check_step`` stays un-jitted for
 #: ahead-of-time compile checks (__graft_entry__.py)
 _check_kernel = partial(
-    jax.jit, static_argnames=("n_nodes", "valid_rows", "it_cap", "bitmap_sharding")
+    jax.jit,
+    static_argnames=("n_nodes", "valid_rows", "it_cap", "block_iters", "bitmap_sharding"),
 )(check_step)
 
 
 def _ceil_pow2(x: int) -> int:
     return 1 if x <= 1 else 1 << (int(x) - 1).bit_length()
+
+
+def pack_batch(
+    snap: GraphSnapshot,
+    resolved: Sequence[tuple[np.ndarray, int]],
+    force_W: Optional[int] = None,
+):
+    """Pack resolved queries into kernel arguments.
+
+    ``resolved`` holds per-query ``(start device ids, target device id)``
+    from ``TpuCheckEngine._resolve``. Returns ``(rows, words, masks,
+    targets)`` numpy arrays, or None when no query has a start node (the
+    whole batch is a guaranteed deny).
+    """
+    nq = len(resolved)
+    W = force_W or next(w for w in _WORD_WIDTHS if 32 * w >= nq)
+    B = 32 * W
+    targets = np.full(B, snap.n_nodes, dtype=np.int32)
+    rows_l: list[np.ndarray] = []
+    words_l: list[np.ndarray] = []
+    masks_l: list[np.ndarray] = []
+    for i, (starts, t) in enumerate(resolved):
+        targets[i] = t
+        if starts.size:
+            rows_l.append(starts)
+            words_l.append(np.full(starts.size, i // 32, np.int32))
+            masks_l.append(np.full(starts.size, np.uint32(1) << np.uint32(i % 32)))
+    if not rows_l:
+        return None
+
+    rows = np.concatenate(rows_l).astype(np.int32)
+    words = np.concatenate(words_l)
+    masks = np.concatenate(masks_l)
+    # SP == B in steady state (chunking bounds entries at max_batch); only a
+    # single query with a huge wildcard fan-out exceeds it
+    sp = B if rows.size <= B else _ceil_pow2(rows.size)
+    pad = sp - rows.size
+    rows = np.concatenate([rows, np.full(pad, snap.n_nodes, np.int32)])
+    words = np.concatenate([words, np.zeros(pad, np.int32)])
+    masks = np.concatenate([masks, np.zeros(pad, np.uint32)])
+    return rows, words, masks, targets
 
 
 class TpuCheckEngine:
@@ -171,6 +232,11 @@ class TpuCheckEngine:
             self._nm = namespaces
         self._it_cap = it_cap
         self._max_batch = max_batch
+        # pulls per convergence observation, adapted to the workload's
+        # traversal depth from the iteration counts kernels report back
+        self._block_iters = 8
+        # concurrently in-flight chunks (bounds device bitmap workspaces)
+        self._dispatch_window = 16
         self._mesh = mesh
         self._shard_rows = shard_rows
         self._bitmap_sharding = None
@@ -276,44 +342,63 @@ class TpuCheckEngine:
         if snap.n_nodes == 0 or snap.n_edges == 0 or not tuples:
             return [False] * len(tuples)
 
+        # resolve on host first, then pack chunks so that the start-entry
+        # array stays at its padded size B — chunk geometry (W, SP) is then
+        # constant across calls and every chunk hits the same jit cache entry
+        resolved = [self._resolve(snap, rt) for rt in tuples]
+
+        chunks: list[list[tuple[np.ndarray, int]]] = []
+        cur: list[tuple[np.ndarray, int]] = []
+        cur_entries = 0
+        cap = self._max_batch
+        for starts, t in resolved:
+            n = int(starts.size)
+            if cur and (len(cur) >= cap or cur_entries + n > cap):
+                chunks.append(cur)
+                cur, cur_entries = [], 0
+            cur.append((starts, t))
+            cur_entries += n
+        if cur:
+            chunks.append(cur)
+
+        # one multi-chunk request keeps a single kernel shape: every chunk
+        # pads to the width fitting the largest one rather than compiling
+        # narrower variants for tails
+        force_W = None
+        if len(chunks) > 1:
+            biggest = max(len(c) for c in chunks)
+            force_W = next(w for w in _WORD_WIDTHS if 32 * w >= biggest)
+
+        # dispatch every chunk asynchronously (windowed so in-flight bitmap
+        # workspaces stay within HBM), then fetch results in pipelined
+        # device_gets — per-fetch latency dominates on tunneled devices, and
+        # concurrent fetches overlap
         out: list[bool] = []
-        for off in range(0, len(tuples), self._max_batch):
-            chunk = tuples[off : off + self._max_batch]
-            out.extend(self._device_batch(snap, chunk))
+        max_iters = 0
+        for woff in range(0, len(chunks), self._dispatch_window):
+            wave = chunks[woff : woff + self._dispatch_window]
+            pending = [(self._device_batch(snap, c, force_W), len(c)) for c in wave]
+            fetched = jax.device_get([d for d, _ in pending])
+            for (arr, iters), (_, nq) in zip(fetched, pending):
+                out.extend(bool(x) for x in arr[:nq])
+                max_iters = max(max_iters, int(iters))
+        # adapt the pull-block size so the next batch converges within one
+        # convergence observation (clamped to powers of two ≤ 32)
+        self._block_iters = max(2, min(32, _ceil_pow2(max_iters + 1)))
         return out
 
     def _device_batch(
-        self, snap: GraphSnapshot, tuples: Sequence[RelationTuple]
-    ) -> list[bool]:
-        nq = len(tuples)
-        W = next(w for w in _WORD_WIDTHS if 32 * w >= nq)
-        B = 32 * W
-        targets = np.full(B, snap.n_nodes, dtype=np.int32)
-        rows_l: list[np.ndarray] = []
-        words_l: list[np.ndarray] = []
-        masks_l: list[np.ndarray] = []
-        any_live = False
-        for i, rt in enumerate(tuples):
-            starts, t = self._resolve(snap, rt)
-            targets[i] = t
-            if starts.size:
-                any_live = True
-                rows_l.append(starts)
-                words_l.append(np.full(starts.size, i // 32, np.int32))
-                masks_l.append(np.full(starts.size, np.uint32(1) << np.uint32(i % 32)))
-        if not any_live:
-            return [False] * nq
-
-        rows = np.concatenate(rows_l).astype(np.int32)
-        words = np.concatenate(words_l)
-        masks = np.concatenate(masks_l)
-        sp = _ceil_pow2(max(rows.size, 32))
-        pad = sp - rows.size
-        rows = np.concatenate([rows, np.full(pad, snap.n_nodes, np.int32)])
-        words = np.concatenate([words, np.zeros(pad, np.int32)])
-        masks = np.concatenate([masks, np.zeros(pad, np.uint32)])
-
-        allowed = _check_kernel(
+        self,
+        snap: GraphSnapshot,
+        resolved: list[tuple[np.ndarray, int]],
+        force_W: Optional[int] = None,
+    ):
+        packed = pack_batch(snap, resolved, force_W)
+        if packed is None:
+            W = force_W or next(w for w in _WORD_WIDTHS if 32 * w >= len(resolved))
+            return np.zeros(32 * W, dtype=bool), np.int32(0)
+        rows, words, masks, targets = packed
+        return _check_kernel(
             snap.device_buckets,
             jnp.asarray(rows),
             jnp.asarray(words),
@@ -322,9 +407,9 @@ class TpuCheckEngine:
             n_nodes=snap.n_nodes,
             valid_rows=tuple(b.n for b in snap.buckets),
             it_cap=self._it_cap,
+            block_iters=self._block_iters,
             bitmap_sharding=self._bitmap_sharding,
         )
-        return [bool(x) for x in np.asarray(allowed)[:nq]]
 
     def subject_is_allowed(self, requested: RelationTuple) -> bool:
         """Single-query convenience with the oracle engine's signature

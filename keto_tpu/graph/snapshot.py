@@ -89,6 +89,7 @@ class GraphSnapshot:
     def n_edges(self) -> int:
         return 0 if self.fwd_indices is None else int(self.fwd_indices.shape[0])
 
+
     def resolve_set(self, ns_id: int, obj: str, rel: str) -> Optional[int]:
         return self.set_dev.get((ns_id, obj, rel))
 
@@ -164,10 +165,12 @@ def build_snapshot(
         )
 
     in_deg = np.bincount(dst_raw, minlength=n)
-    # bucket key: 0 for nodes without in-edges, else ceil-log2(degree) + 1
+    # bucket key: ceil-log2(degree) + 1; nodes without in-edges sort LAST
+    # (key 63) — their bitmap rows never change, so the kernel iterates only
+    # the prefix of rows that can (see tpu_engine.check_step)
     with np.errstate(divide="ignore"):
         bucket_key = np.where(
-            in_deg == 0, 0, np.ceil(np.log2(np.maximum(in_deg, 1))).astype(np.int64) + 1
+            in_deg == 0, 63, np.ceil(np.log2(np.maximum(in_deg, 1))).astype(np.int64) + 1
         )
     bucket_key[in_deg == 1] = 1
 
@@ -191,7 +194,7 @@ def build_snapshot(
     for key in np.unique(key_by_dev):
         members = np.nonzero(key_by_dev == key)[0]  # contiguous by construction
         offset, n_rows = int(members[0]), int(members.shape[0])
-        cap = 0 if key == 0 else 1 << (int(key) - 1)
+        cap = 0 if key == 63 else 1 << (int(key) - 1)
         n_pad = _ceil_pow2(n_rows)
         nbrs = np.full((n_pad, cap), sentinel, dtype=np.int32)
         if cap:

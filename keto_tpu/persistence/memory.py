@@ -83,6 +83,12 @@ class _SharedState:
         self.rows: dict[str, list[InternalRow]] = {}  # nid -> rows
         self.seq = itertools.count()
         self.watermark = 0
+        # (nid, ns_id, obj, rel) → sorted row sublist; the in-memory analog
+        # of the reference's covering index (reference
+        # …20210623162417000003_relationtuple.postgres.up.sql:1-9), serving
+        # the engines' fully-literal traversal queries without a scan.
+        # Rebuilt lazily after writes.
+        self.lhs_index: Optional[dict[tuple, list[InternalRow]]] = None
 
 
 class MemoryPersister(Manager):
@@ -171,6 +177,22 @@ class MemoryPersister(Manager):
 
         return matches
 
+    def _index_lookup(self, query: RelationQuery) -> list[InternalRow]:
+        """Rows to filter: the LHS-index bucket for a fully-literal
+        (namespace, object, relation) query, else the full row list. Must be
+        called under the shared lock."""
+        if query.namespace == "" or query.object == "" or query.relation == "":
+            return self._rows()
+        idx = self._shared.lhs_index
+        if idx is None:
+            idx = {}
+            for nid, rows in self._shared.rows.items():
+                for r in rows:
+                    idx.setdefault((nid, r.namespace_id, r.object, r.relation), []).append(r)
+            self._shared.lhs_index = idx
+        ns_id = self._nm().get_namespace_by_name(query.namespace).id
+        return idx.get((self.network_id, ns_id, query.object, query.relation), [])
+
     # -- Manager -------------------------------------------------------------
 
     def get_relation_tuples(
@@ -188,9 +210,11 @@ class MemoryPersister(Manager):
         with self._shared.lock:
             # rows are kept sorted at mutation time, so a page request is a
             # single filtering pass (the engines' page loops would otherwise
-            # pay a re-sort per page)
+            # pay a re-sort per page); fully-literal queries go through the
+            # LHS index instead of a scan
+            candidates = self._index_lookup(query)
             matches = self._compile_query(query)
-            matched = [r for r in self._rows() if matches(r)]
+            matched = [r for r in candidates if matches(r)]
             total_pages = -(-len(matched) // per_page)  # ceil
             start = (page - 1) * per_page
             page_rows = matched[start : start + per_page]
@@ -233,6 +257,7 @@ class MemoryPersister(Manager):
                     if (r.namespace_id, r.object, r.relation, r.subject_id, r.sset_namespace_id, r.sset_object, r.sset_relation)
                     not in keyset
                 ]
+            self._shared.lhs_index = None
             self._shared.watermark += 1
 
     def watermark(self) -> int:
