@@ -47,23 +47,27 @@ def build_workload(rng, n_tuples):
 
     tuples = []
     membership = {}  # user → set of leaf groups (for expected answers)
+    leaf_users = {}  # leaf group → users (for constructing grant queries)
     for u in range(n_users):
         for _ in range(rng.choice((1, 1, 2))):
             g = rng.randrange(n_leaf)
             membership.setdefault(u, set()).add(g)
+            leaf_users.setdefault(g, []).append(u)
             tuples.append(T("groups", f"leaf-{g}", "member", SubjectID(f"user-{u}")))
 
-    leaf_parent = {}
+    leaf_parent, mid_leaves = {}, {}
     for g in range(n_leaf):
         parent = rng.randrange(n_mid)
         leaf_parent[g] = parent
+        mid_leaves.setdefault(parent, []).append(g)
         tuples.append(
             T("groups", f"mid-{parent}", "member", SubjectSet("groups", f"leaf-{g}", "member"))
         )
-    mid_parent = {}
+    mid_parent, top_mids = {}, {}
     for m in range(n_mid):
         parent = rng.randrange(n_top)
         mid_parent[m] = parent
+        top_mids.setdefault(parent, []).append(m)
         tuples.append(
             T("groups", f"top-{parent}", "member", SubjectSet("groups", f"mid-{m}", "member"))
         )
@@ -88,18 +92,38 @@ def build_workload(rng, n_tuples):
             return g in mids
         return g in {mid_parent[m] for m in mids}
 
-    return tuples, doc_grant, membership, user_reaches, n_users, T
+    def member_of(kind, g, rng):
+        """A user transitively inside group (kind, g), or None if empty."""
+        if kind == "top":
+            mids = top_mids.get(g)
+            if not mids:
+                return None
+            kind, g = "mid", rng.choice(mids)
+        if kind == "mid":
+            leaves = mid_leaves.get(g)
+            if not leaves:
+                return None
+            g = rng.choice(leaves)
+        users = leaf_users.get(g)
+        return rng.choice(users) if users else None
+
+    return tuples, doc_grant, membership, user_reaches, member_of, n_users, T
 
 
-def make_queries(rng, n_checks, doc_grant, n_users, user_reaches, T):
+def make_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T):
+    """Half the queries target users constructed to hold the grant, half are
+    uniform random (almost always denials) — so the analytic expectations
+    exercise both decisions."""
     from keto_tpu.relationtuple.model import SubjectID
 
     docs = list(doc_grant)
     queries, expected = [], []
-    for _ in range(n_checks):
+    for i in range(n_checks):
         d = rng.choice(docs)
-        u = rng.randrange(n_users)
         kind, g = doc_grant[d]
+        u = member_of(kind, g, rng) if i % 2 == 0 else None
+        if u is None:
+            u = rng.randrange(n_users)
         queries.append(T("docs", f"doc-{d}", "view", SubjectID(f"user-{u}")))
         expected.append(user_reaches(u, kind, g))
     return queries, expected
@@ -120,7 +144,7 @@ def main():
 
     log(f"devices: {jax.devices()}")
     t0 = time.perf_counter()
-    tuples, doc_grant, membership, user_reaches, n_users, T = build_workload(rng, n_tuples)
+    tuples, doc_grant, membership, user_reaches, member_of, n_users, T = build_workload(rng, n_tuples)
     log(f"workload: {len(tuples)} tuples in {time.perf_counter()-t0:.1f}s")
 
     nm = namespace_pkg.MemoryManager(
@@ -138,7 +162,7 @@ def main():
     snapshot_s = time.perf_counter() - t0
     log(f"snapshot: {snap.n_nodes} nodes, {snap.n_edges} edges in {snapshot_s:.1f}s")
 
-    queries, expected = make_queries(rng, n_checks, doc_grant, n_users, user_reaches, T)
+    queries, expected = make_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T)
 
     # warmup (compile) on a full-width batch
     t0 = time.perf_counter()

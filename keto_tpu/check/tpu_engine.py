@@ -194,9 +194,14 @@ def pack_batch(
     rows = np.concatenate(rows_l).astype(np.int32)
     words = np.concatenate(words_l)
     masks = np.concatenate(masks_l)
-    # SP == B in steady state (chunking bounds entries at max_batch); only a
-    # single query with a huge wildcard fan-out exceeds it
-    sp = B if rows.size <= B else _ceil_pow2(rows.size)
+    # keep the kernel's start-array geometry to a handful of shapes: SP == B
+    # when entries fit; multi-start chunks share the max-batch size (the
+    # chunker caps entries there); only a single query with a larger
+    # wildcard fan-out grows past it
+    if rows.size <= B:
+        sp = B
+    else:
+        sp = max(_ceil_pow2(rows.size), 32 * _WORD_WIDTHS[-1])
     pad = sp - rows.size
     rows = np.concatenate([rows, np.full(pad, snap.n_nodes, np.int32)])
     words = np.concatenate([words, np.zeros(pad, np.int32)])

@@ -1,0 +1,14 @@
+"""Command-line interface.
+
+Mirrors the reference's cobra command tree (reference cmd/root.go:46-66):
+``serve``, ``check``, ``expand``, ``relation-tuple
+{parse,create,delete,get}``, ``namespace validate``, ``migrate
+{up,down,status}``, ``status``, ``version``. Client commands talk gRPC to a
+running server through ``--read-remote`` / ``--write-remote`` (env
+``KETO_READ_REMOTE`` / ``KETO_WRITE_REMOTE``), exactly like the reference's
+cmd/client (reference cmd/client/grpc_client.go:41-58).
+"""
+
+from keto_tpu.cmd.root import cli, main
+
+__all__ = ["cli", "main"]

@@ -1,0 +1,4 @@
+from keto_tpu.cmd import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""gRPC client plumbing for the CLI.
+
+The analog of reference cmd/client/grpc_client.go:41-58: insecure channels
+to the read (:4466) / write (:4467) remotes with a 3 s connection timeout,
+resolved from flags or the ``KETO_READ_REMOTE`` / ``KETO_WRITE_REMOTE``
+environment.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+import grpc
+
+DEFAULT_READ_REMOTE = "127.0.0.1:4466"
+DEFAULT_WRITE_REMOTE = "127.0.0.1:4467"
+CONNECT_TIMEOUT_S = 3.0
+
+
+def read_remote(flag_value: Optional[str]) -> str:
+    return flag_value or os.environ.get("KETO_READ_REMOTE") or DEFAULT_READ_REMOTE
+
+
+def write_remote(flag_value: Optional[str]) -> str:
+    return flag_value or os.environ.get("KETO_WRITE_REMOTE") or DEFAULT_WRITE_REMOTE
+
+
+@contextmanager
+def conn(target: str) -> Iterator[grpc.Channel]:
+    channel = grpc.insecure_channel(target)
+    try:
+        grpc.channel_ready_future(channel).result(timeout=CONNECT_TIMEOUT_S)
+    except grpc.FutureTimeoutError:
+        channel.close()
+        raise SystemExit(f"could not connect to {target} within {CONNECT_TIMEOUT_S}s")
+    try:
+        yield channel
+    finally:
+        channel.close()
+
+
+def unary(channel: grpc.Channel, method: str, request, response_cls):
+    """One unary call with hand-rolled (de)serialization — the runtime image
+    has no grpc codegen plugin, so there are no generated stubs."""
+    return channel.unary_unary(
+        method,
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=response_cls.FromString,
+    )(request)

@@ -1,0 +1,12 @@
+"""Driver: dependency-injection registry, batching, and the serve loop.
+
+The analog of the reference's ``internal/driver`` package: a registry of
+lazily constructed singletons that every component hangs off (reference
+internal/driver/registry_default.go:56-79), a factory from config, and the
+daemon that serves the read and write APIs (reference
+internal/driver/daemon.go:62-69).
+"""
+
+from keto_tpu.driver.registry import Registry
+
+__all__ = ["Registry"]

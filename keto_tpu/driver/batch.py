@@ -1,0 +1,95 @@
+"""Request-coalescing check batcher.
+
+The reference serves one goroutine per request, each paying its own
+traversal (reference internal/driver/daemon.go:62-69). On TPU the economics
+invert: one device program answers thousands of checks, so concurrent
+single-check requests are *coalesced* — a caller enqueues its tuple and
+blocks on a future; a collector thread drains the queue up to
+``batch_size`` or ``window_ms`` (whichever first) and dispatches one
+``batch_check``. This is the serving-plane analog of the data-parallel axis
+(SURVEY §2.3: request concurrency → batch parallelism).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+from keto_tpu.relationtuple.model import RelationTuple
+
+
+class CheckBatcher:
+    def __init__(self, engine, batch_size: int = 4096, window_ms: float = 1.0):
+        """``engine`` needs ``batch_check(list[RelationTuple]) -> list[bool]``."""
+        self._engine = engine
+        self._batch_size = batch_size
+        self._window_s = window_ms / 1e3
+        self._queue: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread:
+            return
+        self._thread = threading.Thread(target=self._loop, name="check-batcher", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._queue.put(None)  # wake the collector
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- API -----------------------------------------------------------------
+
+    def check(self, tuple_: RelationTuple, timeout: Optional[float] = 30.0) -> bool:
+        """Blocking single check, transparently batched with concurrent
+        callers."""
+        fut: Future = Future()
+        self._queue.put((tuple_, fut))
+        return fut.result(timeout=timeout)
+
+    def check_batch(self, tuples: Sequence[RelationTuple]) -> list[bool]:
+        """Pre-batched requests skip the queue entirely."""
+        return self._engine.batch_check(list(tuples))
+
+    # -- collector -----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            item = self._queue.get()
+            if item is None:
+                continue
+            batch = [item]
+            deadline = threading.Event()
+            # drain whatever arrives within the window, up to batch_size
+            timer = threading.Timer(self._window_s, deadline.set)
+            timer.start()
+            try:
+                while len(batch) < self._batch_size and not deadline.is_set():
+                    try:
+                        nxt = self._queue.get(timeout=self._window_s / 10)
+                    except queue.Empty:
+                        continue
+                    if nxt is None:
+                        break
+                    batch.append(nxt)
+            finally:
+                timer.cancel()
+
+            tuples = [t for t, _ in batch]
+            try:
+                results = self._engine.batch_check(tuples)
+            except Exception as e:  # engine failure → every caller sees it
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            for (_, fut), allowed in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(allowed)

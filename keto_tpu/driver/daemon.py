@@ -1,0 +1,79 @@
+"""Serve loop: read + write APIs, each multiplexing REST and gRPC on one port.
+
+The analog of the reference's ``ServeAll`` (reference
+internal/driver/daemon.go:62-159): the read API (default :4466) serves
+check/expand/list over both protocols, the write API (default :4467) serves
+tuple mutations, and each public port is a sniffing mux in front of loopback
+REST and gRPC backends (keto_tpu/servers/mux.py). Graceful shutdown stops
+the muxes first, then drains the backends.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from keto_tpu.servers.grpc_api import build_grpc_server
+from keto_tpu.servers.mux import PortMux
+from keto_tpu.servers.rest import READ, WRITE, RestServer
+
+
+@dataclass
+class _RoleServers:
+    rest: RestServer
+    grpc_server: object
+    mux: PortMux
+
+    @property
+    def port(self) -> int:
+        return self.mux.port
+
+
+class Daemon:
+    """Owns both roles' server stacks."""
+
+    def __init__(self, registry):
+        self.registry = registry
+        self._roles: dict[str, _RoleServers] = {}
+
+    def _start_role(self, role: str, host: str, port: int) -> _RoleServers:
+        rest = RestServer(self.registry, role, host="127.0.0.1", port=0)
+        rest.start()
+        grpc_server, grpc_port = build_grpc_server(self.registry, role)
+        grpc_server.start()
+        mux = PortMux(host, port, rest_port=rest.port, grpc_port=grpc_port)
+        mux.start()
+        self.registry.logger().info(
+            "serving %s API on :%d (REST+gRPC multiplexed)", role, mux.port
+        )
+        return _RoleServers(rest=rest, grpc_server=grpc_server, mux=mux)
+
+    def serve_all(self, block: bool = True) -> None:
+        cfg = self.registry.config()
+        read_host, read_port = cfg.read_api_address()
+        write_host, write_port = cfg.write_api_address()
+        self._roles[READ] = self._start_role(READ, read_host, read_port)
+        self._roles[WRITE] = self._start_role(WRITE, write_host, write_port)
+        if block:
+            try:
+                threading.Event().wait()
+            except KeyboardInterrupt:
+                self.shutdown()
+
+    @property
+    def read_port(self) -> int:
+        return self._roles[READ].port
+
+    @property
+    def write_port(self) -> int:
+        return self._roles[WRITE].port
+
+    def shutdown(self) -> None:
+        for role in self._roles.values():
+            role.mux.stop()
+        for role in self._roles.values():
+            role.rest.stop()
+            role.grpc_server.stop(grace=2)
+        self._roles.clear()
+        self.registry.close()

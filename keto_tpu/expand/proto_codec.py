@@ -1,0 +1,40 @@
+"""Tree ↔ protobuf codecs (reference internal/expand/tree.go:165-216)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ory.keto.acl.v1alpha1 import expand_service_pb2
+
+from keto_tpu.expand.tree import EXCLUSION, INTERSECTION, LEAF, UNION, Tree
+from keto_tpu.relationtuple.proto_codec import subject_from_proto, subject_to_proto
+
+_TYPE_TO_PROTO = {
+    UNION: expand_service_pb2.NODE_TYPE_UNION,
+    EXCLUSION: expand_service_pb2.NODE_TYPE_EXCLUSION,
+    INTERSECTION: expand_service_pb2.NODE_TYPE_INTERSECTION,
+    LEAF: expand_service_pb2.NODE_TYPE_LEAF,
+}
+_TYPE_FROM_PROTO = {v: k for k, v in _TYPE_TO_PROTO.items()}
+
+
+def tree_to_proto(tree: Optional[Tree]) -> Optional[expand_service_pb2.SubjectTree]:
+    if tree is None:
+        return None
+    node = expand_service_pb2.SubjectTree(
+        node_type=_TYPE_TO_PROTO[tree.type], subject=subject_to_proto(tree.subject)
+    )
+    if tree.type != LEAF:
+        node.children.extend(tree_to_proto(c) for c in tree.children)
+    return node
+
+
+def tree_from_proto(proto: Optional[expand_service_pb2.SubjectTree]) -> Optional[Tree]:
+    if proto is None or proto.node_type == expand_service_pb2.NODE_TYPE_UNSPECIFIED:
+        return None
+    tree = Tree(
+        type=_TYPE_FROM_PROTO[proto.node_type], subject=subject_from_proto(proto.subject)
+    )
+    if tree.type != LEAF:
+        tree.children = [tree_from_proto(c) for c in proto.children]
+    return tree

@@ -1,0 +1,356 @@
+"""SQLite-backed tuple store.
+
+The durable counterpart of the in-memory store, mirroring the reference's
+SQL schema and query semantics (reference
+internal/persistence/sql/relationtuples.go, migrations at
+internal/persistence/sql/migrations/sql/20210623162417000000-000003):
+
+- single ``keto_relation_tuples`` table with a CHECK constraint enforcing
+  exactly one of subject_id / subject_set (…000000_relationtuple:3-25);
+- partial index on subject_ids, partial index on subject_sets, and a full
+  covering index including the commit ordering (…000001-000003);
+- every row carries the network id; queries are network-scoped
+  (persister.go:94-96);
+- list order is the reference's ORDER BY with SQLite NULLS-FIRST semantics
+  (relationtuples.go:215), commit order breaking ties;
+- pagination tokens are 1-based page-number strings (persister.go:106-134);
+- versioned migrations with up/down/status driven by ``keto migrate``
+  (reference cmd/migrate/*.go), tracked in ``keto_migrations``.
+
+DSNs: ``sqlite://:memory:`` or ``sqlite://<path>``.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+import uuid
+from typing import Optional, Sequence
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.persistence.memory import InternalRow
+from keto_tpu.relationtuple.manager import Manager
+from keto_tpu.relationtuple.model import RelationQuery, RelationTuple, SubjectID, SubjectSet
+from keto_tpu.x.errors import ErrMalformedPageToken, ErrNilSubject
+from keto_tpu.x.pagination import (
+    DEFAULT_PAGE_SIZE,
+    PaginationOptionSetter,
+    get_pagination_options,
+)
+
+MIGRATIONS: list[tuple[str, str, str]] = [
+    (
+        "20210623000000_relation_tuples",
+        """
+        CREATE TABLE keto_relation_tuples (
+            shard_id TEXT NOT NULL,
+            nid TEXT NOT NULL,
+            namespace_id INTEGER NOT NULL,
+            object TEXT NOT NULL,
+            relation TEXT NOT NULL,
+            subject_id TEXT NULL,
+            subject_set_namespace_id INTEGER NULL,
+            subject_set_object TEXT NULL,
+            subject_set_relation TEXT NULL,
+            commit_time INTEGER NOT NULL,
+            PRIMARY KEY (shard_id, nid),
+            CHECK (
+                (subject_id IS NULL AND subject_set_namespace_id IS NOT NULL
+                    AND subject_set_object IS NOT NULL AND subject_set_relation IS NOT NULL)
+                OR
+                (subject_id IS NOT NULL AND subject_set_namespace_id IS NULL
+                    AND subject_set_object IS NULL AND subject_set_relation IS NULL)
+            )
+        )
+        """,
+        "DROP TABLE keto_relation_tuples",
+    ),
+    (
+        "20210623000001_subject_id_idx",
+        """
+        CREATE INDEX keto_relation_tuples_subject_ids_idx
+        ON keto_relation_tuples (nid, namespace_id, object, relation, subject_id)
+        WHERE subject_id IS NOT NULL
+        """,
+        "DROP INDEX keto_relation_tuples_subject_ids_idx",
+    ),
+    (
+        "20210623000002_subject_set_idx",
+        """
+        CREATE INDEX keto_relation_tuples_subject_sets_idx
+        ON keto_relation_tuples (nid, namespace_id, object, relation,
+            subject_set_namespace_id, subject_set_object, subject_set_relation)
+        WHERE subject_set_namespace_id IS NOT NULL
+        """,
+        "DROP INDEX keto_relation_tuples_subject_sets_idx",
+    ),
+    (
+        "20210623000003_full_idx",
+        """
+        CREATE INDEX keto_relation_tuples_full_idx
+        ON keto_relation_tuples (nid, namespace_id, object, relation, subject_id,
+            subject_set_namespace_id, subject_set_object, subject_set_relation, commit_time)
+        """,
+        "DROP INDEX keto_relation_tuples_full_idx",
+    ),
+    (
+        "20210623000004_watermarks",
+        """
+        CREATE TABLE keto_watermarks (
+            nid TEXT PRIMARY KEY,
+            watermark INTEGER NOT NULL DEFAULT 0
+        )
+        """,
+        "DROP TABLE keto_watermarks",
+    ),
+]
+
+_ORDER = (
+    "ORDER BY namespace_id, object, relation, subject_id, "
+    "subject_set_namespace_id, subject_set_object, subject_set_relation, commit_time"
+)
+
+
+def _path_from_dsn(dsn: str) -> str:
+    if not dsn.startswith("sqlite://"):
+        raise ValueError(f"not a sqlite DSN: {dsn!r}")
+    path = dsn[len("sqlite://") :]
+    return path or ":memory:"
+
+
+class SQLitePersister(Manager):
+    def __init__(
+        self,
+        dsn: str,
+        namespace_manager_source,
+        network_id: str = "default",
+        auto_migrate: bool = True,
+        _conn: Optional[sqlite3.Connection] = None,
+    ):
+        if isinstance(namespace_manager_source, namespace_pkg.Manager):
+            self._nm = lambda: namespace_manager_source
+        else:
+            self._nm = namespace_manager_source
+        self.network_id = network_id
+        self._lock = threading.RLock()
+        self._conn = _conn or sqlite3.connect(
+            _path_from_dsn(dsn), check_same_thread=False, isolation_level=None
+        )
+        self._dsn = dsn
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS keto_migrations "
+                "(version TEXT PRIMARY KEY, applied_at INTEGER NOT NULL)"
+            )
+        if auto_migrate:
+            self.migrate_up()
+
+    def with_network(self, network_id: str) -> "SQLitePersister":
+        """Second view over the same database bound to another network id
+        (reference internal/relationtuple/manager_isolation.go:39-116)."""
+        return SQLitePersister(
+            self._dsn, self._nm, network_id, auto_migrate=False, _conn=self._conn
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # -- migrations ----------------------------------------------------------
+
+    def _applied(self) -> set[str]:
+        rows = self._conn.execute("SELECT version FROM keto_migrations").fetchall()
+        return {r[0] for r in rows}
+
+    def migration_status(self) -> list[tuple[str, bool]]:
+        with self._lock:
+            applied = self._applied()
+            return [(v, v in applied) for v, _, _ in MIGRATIONS]
+
+    @property
+    def namespaces(self):
+        """Zero-arg callable returning the current namespace manager."""
+        return self._nm
+
+    def migrate_up(self) -> int:
+        with self._lock:
+            applied = self._applied()
+            n = 0
+            for version, up, _ in MIGRATIONS:
+                if version in applied:
+                    continue
+                self._conn.execute(up)
+                self._conn.execute(
+                    "INSERT INTO keto_migrations (version, applied_at) VALUES (?, strftime('%s','now'))",
+                    (version,),
+                )
+                n += 1
+            return n
+
+    def migrate_down(self, steps: int = 1) -> int:
+        with self._lock:
+            applied = self._applied()
+            n = 0
+            for version, _, down in reversed(MIGRATIONS):
+                if n >= steps:
+                    break
+                if version not in applied:
+                    continue
+                self._conn.execute(down)
+                self._conn.execute("DELETE FROM keto_migrations WHERE version = ?", (version,))
+                n += 1
+            return n
+
+    # -- helpers -------------------------------------------------------------
+
+    def _row_values(self, rt: RelationTuple):
+        nm = self._nm()
+        ns_id = nm.get_namespace_by_name(rt.namespace).id
+        if rt.subject is None:
+            raise ErrNilSubject()
+        if isinstance(rt.subject, SubjectID):
+            return (ns_id, rt.object, rt.relation, rt.subject.id, None, None, None)
+        sns_id = nm.get_namespace_by_name(rt.subject.namespace).id
+        return (ns_id, rt.object, rt.relation, None, sns_id, rt.subject.object, rt.subject.relation)
+
+    def _to_tuple(self, row) -> RelationTuple:
+        nm = self._nm()
+        ns = nm.get_namespace_by_config_id(row[0])
+        if row[3] is not None:
+            subject = SubjectID(id=row[3])
+        else:
+            sns = nm.get_namespace_by_config_id(row[4])
+            subject = SubjectSet(namespace=sns.name, object=row[5], relation=row[6])
+        return RelationTuple(namespace=ns.name, object=row[1], relation=row[2], subject=subject)
+
+    def _where(self, query: RelationQuery):
+        """WHERE clauses with the reference's skip-empty-field wildcarding
+        (relationtuples.go:218-235) and explicit NULL filters on the subject
+        so the partial indexes apply (relationtuples.go:151-176)."""
+        nm = self._nm()
+        clauses, params = ["nid = ?"], [self.network_id]
+        if query.relation != "":
+            clauses.append("relation = ?")
+            params.append(query.relation)
+        if query.object != "":
+            clauses.append("object = ?")
+            params.append(query.object)
+        if query.namespace != "":
+            clauses.append("namespace_id = ?")
+            params.append(nm.get_namespace_by_name(query.namespace).id)
+        sub = query.subject
+        if isinstance(sub, SubjectID):
+            clauses.append(
+                "subject_id = ? AND subject_set_namespace_id IS NULL "
+                "AND subject_set_object IS NULL AND subject_set_relation IS NULL"
+            )
+            params.append(sub.id)
+        elif isinstance(sub, SubjectSet):
+            clauses.append(
+                "subject_id IS NULL AND subject_set_namespace_id = ? "
+                "AND subject_set_object = ? AND subject_set_relation = ?"
+            )
+            params.extend([nm.get_namespace_by_name(sub.namespace).id, sub.object, sub.relation])
+        return " AND ".join(clauses), params
+
+    # -- Manager -------------------------------------------------------------
+
+    def get_relation_tuples(
+        self, query: RelationQuery, *options: PaginationOptionSetter
+    ) -> tuple[list[RelationTuple], str]:
+        opts = get_pagination_options(*options)
+        per_page = opts.size or DEFAULT_PAGE_SIZE
+        if opts.token == "":
+            page = 1
+        elif opts.token.isdigit():
+            page = max(int(opts.token), 1)
+        else:
+            raise ErrMalformedPageToken()
+
+        where, params = self._where(query)
+        with self._lock:
+            total = self._conn.execute(
+                f"SELECT COUNT(*) FROM keto_relation_tuples WHERE {where}", params
+            ).fetchone()[0]
+            rows = self._conn.execute(
+                f"SELECT namespace_id, object, relation, subject_id, subject_set_namespace_id, "
+                f"subject_set_object, subject_set_relation FROM keto_relation_tuples "
+                f"WHERE {where} {_ORDER} LIMIT ? OFFSET ?",
+                params + [per_page, (page - 1) * per_page],
+            ).fetchall()
+        total_pages = -(-total // per_page)
+        next_token = "" if page >= total_pages else str(page + 1)
+        return [self._to_tuple(r) for r in rows], next_token
+
+    def write_relation_tuples(self, *tuples: RelationTuple) -> None:
+        self.transact_relation_tuples(tuples, ())
+
+    def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
+        self.transact_relation_tuples((), tuples)
+
+    def transact_relation_tuples(
+        self, insert: Sequence[RelationTuple], delete: Sequence[RelationTuple]
+    ) -> None:
+        with self._lock:
+            # resolve everything before mutating so namespace errors roll
+            # back cleanly (reference relationtuples.go:271-278)
+            ins_rows = [self._row_values(rt) for rt in insert]
+            del_rows = [self._row_values(rt) for rt in delete]
+            self._conn.execute("BEGIN")
+            try:
+                for values in ins_rows:
+                    self._conn.execute(
+                        "INSERT INTO keto_relation_tuples (shard_id, nid, namespace_id, object, "
+                        "relation, subject_id, subject_set_namespace_id, subject_set_object, "
+                        "subject_set_relation, commit_time) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, "
+                        "(SELECT COALESCE(MAX(commit_time), 0) + 1 FROM keto_relation_tuples))",
+                        (str(uuid.uuid4()), self.network_id) + values,
+                    )
+                for values in del_rows:
+                    null_safe = [
+                        f"{col} IS ?" for col in (
+                            "subject_id",
+                            "subject_set_namespace_id",
+                            "subject_set_object",
+                            "subject_set_relation",
+                        )
+                    ]
+                    self._conn.execute(
+                        "DELETE FROM keto_relation_tuples WHERE nid = ? AND namespace_id = ? "
+                        "AND object = ? AND relation = ? AND " + " AND ".join(null_safe),
+                        (self.network_id,) + values[:3] + values[3:],
+                    )
+                self._conn.execute(
+                    "INSERT INTO keto_watermarks (nid, watermark) VALUES (?, 1) "
+                    "ON CONFLICT(nid) DO UPDATE SET watermark = watermark + 1",
+                    (self.network_id,),
+                )
+                self._conn.execute("COMMIT")
+            except Exception:
+                self._conn.execute("ROLLBACK")
+                raise
+
+    def watermark(self) -> int:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT watermark FROM keto_watermarks WHERE nid = ?", (self.network_id,)
+            ).fetchone()
+            return row[0] if row else 0
+
+    # -- snapshot support (TPU graph builder) --------------------------------
+
+    def snapshot_rows(self) -> tuple[list[InternalRow], int]:
+        """Consistent (rows, watermark) view for the TPU graph builder."""
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT namespace_id, object, relation, subject_id, subject_set_namespace_id, "
+                f"subject_set_object, subject_set_relation, commit_time FROM keto_relation_tuples "
+                f"WHERE nid = ? {_ORDER}",
+                (self.network_id,),
+            ).fetchall()
+            wm = self.watermark()
+        return [InternalRow(*r[:7], seq=r[7]) for r in rows], wm
+
+
+#: import alias
+SqlitePersister = SQLitePersister

@@ -1,0 +1,75 @@
+"""Model ↔ protobuf codecs.
+
+Mirrors the reference's proto conversions (reference
+internal/relationtuple/definitions.go:206-271: ToProto/FromDataProvider,
+SubjectFromProto) over the wire-compatible messages in
+proto/ory/keto/acl/v1alpha1.
+"""
+
+from __future__ import annotations
+
+from ory.keto.acl.v1alpha1 import acl_pb2
+
+from keto_tpu.relationtuple.model import (
+    RelationQuery,
+    RelationTuple,
+    Subject,
+    SubjectID,
+    SubjectSet,
+)
+from keto_tpu.x.errors import ErrNilSubject
+
+
+def subject_to_proto(subject: Subject) -> acl_pb2.Subject:
+    if isinstance(subject, SubjectID):
+        return acl_pb2.Subject(id=subject.id)
+    return acl_pb2.Subject(
+        set=acl_pb2.SubjectSet(
+            namespace=subject.namespace, object=subject.object, relation=subject.relation
+        )
+    )
+
+
+def subject_from_proto(proto: acl_pb2.Subject) -> Subject:
+    which = proto.WhichOneof("ref")
+    if which == "id":
+        return SubjectID(id=proto.id)
+    if which == "set":
+        return SubjectSet(
+            namespace=proto.set.namespace, object=proto.set.object, relation=proto.set.relation
+        )
+    raise ErrNilSubject()
+
+
+def tuple_to_proto(rt: RelationTuple) -> acl_pb2.RelationTuple:
+    return acl_pb2.RelationTuple(
+        namespace=rt.namespace,
+        object=rt.object,
+        relation=rt.relation,
+        subject=subject_to_proto(rt.subject),
+    )
+
+
+def tuple_from_proto(proto) -> RelationTuple:
+    """Accepts any message with namespace/object/relation/subject fields
+    (RelationTuple, CheckRequest — the reference's TupleData interface,
+    definitions.go:70-76)."""
+    return RelationTuple(
+        namespace=proto.namespace,
+        object=proto.object,
+        relation=proto.relation,
+        subject=subject_from_proto(proto.subject),
+    )
+
+
+def query_from_proto(proto) -> RelationQuery:
+    """ListRelationTuplesRequest.Query → RelationQuery (reference
+    read_server.go:21-48)."""
+    q = RelationQuery(namespace=proto.namespace, object=proto.object, relation=proto.relation)
+    if proto.HasField("subject"):
+        sub = subject_from_proto(proto.subject)
+        if isinstance(sub, SubjectID):
+            q.subject_id = sub.id
+        else:
+            q.subject_set = sub
+    return q

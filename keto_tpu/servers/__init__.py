@@ -1,0 +1,11 @@
+"""API servers: REST + gRPC with the reference's read/write split.
+
+Read API (default :4466): ``GET/POST /check``, ``GET /expand``,
+``GET /relation-tuples``; write API (default :4467): ``PUT/DELETE/PATCH
+/relation-tuples`` — routes, parameters, status codes, and error envelopes
+match the reference handlers (reference internal/check/handler.go:41-52,
+internal/expand/handler.go:40-42, internal/relationtuple/handler.go:41-49).
+Both ports also speak gRPC, multiplexed by connection sniffing
+(keto_tpu/servers/mux.py) the way the reference uses cmux (reference
+internal/driver/daemon.go:93-97).
+"""

@@ -1,0 +1,285 @@
+"""gRPC services over the wire-compatible ory.keto.acl.v1alpha1 contract.
+
+The reference registers CheckService + ExpandService + ReadService on the
+read server and WriteService on the write server, plus VersionService and
+grpc.health.v1.Health on both (reference
+internal/driver/registry_default.go:350-382). Service/method registration
+here is hand-written over protoc-generated messages (the runtime image has
+no grpc codegen plugin): each servicer installs a
+``grpc.method_handlers_generic_handler`` keyed by the same full service
+names, so generated clients from the reference ecosystem interoperate.
+
+Errors map through the KetoError.grpc_code taxonomy; the check RPC returns a
+real snaptoken — the watermark of the device graph snapshot that produced
+the decision (the reference stubs this field, reference
+internal/check/handler.go:162).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+from grpchealth.v1 import health_pb2
+from ory.keto.acl.v1alpha1 import (
+    check_service_pb2,
+    expand_service_pb2,
+    read_service_pb2,
+    version_pb2,
+    write_service_pb2,
+)
+
+from keto_tpu.expand.proto_codec import tree_to_proto
+from keto_tpu.relationtuple.proto_codec import (
+    query_from_proto,
+    subject_from_proto,
+    tuple_from_proto,
+)
+from keto_tpu.x.errors import ErrBadRequest, KetoError
+from keto_tpu.x.pagination import with_size, with_token
+
+READ = "read"
+WRITE = "write"
+
+
+_CODE_BY_NUM = {c.value[0]: c for c in grpc.StatusCode}
+
+
+def _abort(context, err: KetoError):
+    context.abort(_CODE_BY_NUM.get(err.grpc_code, grpc.StatusCode.INTERNAL), err.message)
+
+
+def _wrap(fn):
+    """Translate KetoError into gRPC status codes."""
+
+    def handler(request, context):
+        try:
+            return fn(request, context)
+        except KetoError as e:
+            _abort(context, e)
+
+    return handler
+
+
+def _unary(fn, req_cls, resp_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        _wrap(fn),
+        request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString,
+    )
+
+
+class CheckService:
+    """ory.keto.acl.v1alpha1.CheckService (reference internal/check/handler.go:148-164)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def Check(self, request, context):
+        tuple_ = tuple_from_proto(request)
+        allowed = self.registry.check_batcher().check(tuple_)
+        engine = self.registry.permission_engine()
+        snaptoken = ""
+        if hasattr(engine, "snapshot"):
+            snaptoken = str(engine.snapshot().snapshot_id)
+        return check_service_pb2.CheckResponse(allowed=allowed, snaptoken=snaptoken)
+
+    def register(self, server):
+        server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "ory.keto.acl.v1alpha1.CheckService",
+                    {
+                        "Check": _unary(
+                            self.Check,
+                            check_service_pb2.CheckRequest,
+                            check_service_pb2.CheckResponse,
+                        )
+                    },
+                ),
+            )
+        )
+
+
+class ExpandService:
+    """ory.keto.acl.v1alpha1.ExpandService (reference internal/expand/handler.go:94-105)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def Expand(self, request, context):
+        subject = subject_from_proto(request.subject)
+        tree = self.registry.expand_engine().build_tree(subject, request.max_depth)
+        return expand_service_pb2.ExpandResponse(tree=tree_to_proto(tree))
+
+    def register(self, server):
+        server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "ory.keto.acl.v1alpha1.ExpandService",
+                    {
+                        "Expand": _unary(
+                            self.Expand,
+                            expand_service_pb2.ExpandRequest,
+                            expand_service_pb2.ExpandResponse,
+                        )
+                    },
+                ),
+            )
+        )
+
+
+class ReadService:
+    """ory.keto.acl.v1alpha1.ReadService (reference internal/relationtuple/read_server.go:21-48)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def ListRelationTuples(self, request, context):
+        if not request.HasField("query"):
+            raise ErrBadRequest("invalid request")
+        query = query_from_proto(request.query)
+        opts = []
+        if request.page_token:
+            opts.append(with_token(request.page_token))
+        if request.page_size:
+            opts.append(with_size(request.page_size))
+        rels, next_page = self.registry.relation_tuple_manager().get_relation_tuples(
+            query, *opts
+        )
+        from keto_tpu.relationtuple.proto_codec import tuple_to_proto
+
+        return read_service_pb2.ListRelationTuplesResponse(
+            relation_tuples=[tuple_to_proto(r) for r in rels], next_page_token=next_page
+        )
+
+    def register(self, server):
+        server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "ory.keto.acl.v1alpha1.ReadService",
+                    {
+                        "ListRelationTuples": _unary(
+                            self.ListRelationTuples,
+                            read_service_pb2.ListRelationTuplesRequest,
+                            read_service_pb2.ListRelationTuplesResponse,
+                        )
+                    },
+                ),
+            )
+        )
+
+
+class WriteService:
+    """ory.keto.acl.v1alpha1.WriteService (reference internal/relationtuple/transact_server.go:30-53)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def TransactRelationTuples(self, request, context):
+        insert, delete = [], []
+        for delta in request.relation_tuple_deltas:
+            action = delta.action
+            if action == write_service_pb2.RelationTupleDelta.INSERT:
+                insert.append(tuple_from_proto(delta.relation_tuple))
+            elif action == write_service_pb2.RelationTupleDelta.DELETE:
+                delete.append(tuple_from_proto(delta.relation_tuple))
+            else:
+                raise ErrBadRequest(f"unknown action {action}")
+        manager = self.registry.relation_tuple_manager()
+        manager.transact_relation_tuples(insert, delete)
+        token = str(manager.watermark())
+        return write_service_pb2.TransactRelationTuplesResponse(
+            snaptokens=[token] * len(request.relation_tuple_deltas)
+        )
+
+    def register(self, server):
+        server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "ory.keto.acl.v1alpha1.WriteService",
+                    {
+                        "TransactRelationTuples": _unary(
+                            self.TransactRelationTuples,
+                            write_service_pb2.TransactRelationTuplesRequest,
+                            write_service_pb2.TransactRelationTuplesResponse,
+                        )
+                    },
+                ),
+            )
+        )
+
+
+class VersionService:
+    """ory.keto.acl.v1alpha1.VersionService (reference proto version.proto:15-19)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def GetVersion(self, request, context):
+        return version_pb2.GetVersionResponse(version=self.registry.version())
+
+    def register(self, server):
+        server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "ory.keto.acl.v1alpha1.VersionService",
+                    {
+                        "GetVersion": _unary(
+                            self.GetVersion,
+                            version_pb2.GetVersionRequest,
+                            version_pb2.GetVersionResponse,
+                        )
+                    },
+                ),
+            )
+        )
+
+
+class HealthService:
+    """grpc.health.v1.Health (reference registry_default.go:105-111)."""
+
+    def Check(self, request, context):
+        return health_pb2.HealthCheckResponse(status=health_pb2.HealthCheckResponse.SERVING)
+
+    def Watch(self, request, context):
+        yield health_pb2.HealthCheckResponse(status=health_pb2.HealthCheckResponse.SERVING)
+
+    def register(self, server):
+        server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "grpc.health.v1.Health",
+                    {
+                        "Check": _unary(
+                            self.Check,
+                            health_pb2.HealthCheckRequest,
+                            health_pb2.HealthCheckResponse,
+                        ),
+                        "Watch": grpc.unary_stream_rpc_method_handler(
+                            self.Watch,
+                            request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                            response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+                        ),
+                    },
+                ),
+            )
+        )
+
+
+def build_grpc_server(registry, role: str, address: str = "127.0.0.1:0"):
+    """A grpc.Server with the role's services registered; returns
+    (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
+    if role == READ:
+        CheckService(registry).register(server)
+        ExpandService(registry).register(server)
+        ReadService(registry).register(server)
+    else:
+        WriteService(registry).register(server)
+    VersionService(registry).register(server)
+    HealthService().register(server)
+    port = server.add_insecure_port(address)
+    return server, port

@@ -1,0 +1,94 @@
+"""Single-port gRPC + REST multiplexing by connection sniffing.
+
+The reference multiplexes gRPC and REST on one TCP port with cmux, matching
+HTTP/2 connections by their client preface (reference
+internal/driver/daemon.go:87-159). Python's grpc and http.server stacks
+cannot share a listener, so this module reproduces cmux's trick one level
+down: a front listener accepts each connection, peeks the first bytes, and
+splices the socket to a loopback backend — the gRPC server for connections
+opening with the HTTP/2 client preface (``PRI * HTTP/2.0``), the REST
+server otherwise. Splicing is two pump threads per connection; the peeked
+bytes are replayed to the backend first.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+_H2_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+
+def _pump(src: socket.socket, dst: socket.socket) -> None:
+    try:
+        while True:
+            data = src.recv(65536)
+            if not data:
+                break
+            dst.sendall(data)
+    except OSError:
+        pass
+    finally:
+        for s, how in ((dst, socket.SHUT_WR), (src, socket.SHUT_RD)):
+            try:
+                s.shutdown(how)
+            except OSError:
+                pass
+
+
+class PortMux:
+    """Front listener splicing connections to REST / gRPC loopback backends."""
+
+    def __init__(self, host: str, port: int, rest_port: int, grpc_port: int):
+        self._listener = socket.create_server((host or "0.0.0.0", port), reuse_port=False)
+        self._listener.settimeout(0.5)
+        self.rest_port = rest_port
+        self.grpc_port = grpc_port
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._accept_loop, name="portmux", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        self._listener.close()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._splice, args=(conn,), daemon=True).start()
+
+    def _splice(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(10)
+            # peek until the method token is unambiguous ("PRI " = HTTP/2
+            # client preface = gRPC; anything else = HTTP/1 REST)
+            head = b""
+            while len(head) < 4:
+                head = conn.recv(4, socket.MSG_PEEK)
+                if not head:
+                    conn.close()
+                    return
+            conn.settimeout(None)
+            backend_port = self.grpc_port if head == b"PRI " else self.rest_port
+            backend = socket.create_connection(("127.0.0.1", backend_port))
+        except OSError:
+            conn.close()
+            return
+        t = threading.Thread(target=_pump, args=(conn, backend), daemon=True)
+        t.start()
+        _pump(backend, conn)

@@ -1,0 +1,254 @@
+"""REST handlers over the stdlib HTTP server.
+
+Endpoint behavior is a 1:1 mapping of the reference REST surface:
+
+- ``GET /check`` decodes the tuple from the URL query; a nil subject is a
+  400 with "Subject has to be specified." (reference
+  internal/check/handler.go:85-107); the *status code mirrors the
+  decision*: 200 allowed / 403 denied, body ``{"allowed": bool}``.
+- ``POST /check`` takes the tuple as JSON (handler.go:128-146).
+- ``GET /expand`` requires ``max-depth`` plus a subject-set query and
+  returns the tree JSON (reference internal/expand/handler.go:79-92).
+- ``GET /relation-tuples`` decodes a RelationQuery + ``page_token`` /
+  ``page_size`` and returns ``{"relation_tuples": [...],
+  "next_page_token": "..."}`` (reference
+  internal/relationtuple/read_server.go:77-117).
+- ``PUT /relation-tuples`` creates from a JSON body → 201 + Location
+  (reference transact_server.go:130-153); ``DELETE`` by URL query → 204
+  (transact_server.go:173-187); ``PATCH`` applies
+  ``[{"action": "insert"|"delete", "relation_tuple": {...}}]``
+  atomically → 204 (transact_server.go:217-242).
+- ``GET /health/alive``, ``GET /health/ready`` → ``{"status": "ok"}``
+  (reference registry_default.go:97-103); ``GET /version``.
+
+Errors render the herodot-style envelope from keto_tpu/x/errors.py.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+from urllib.parse import parse_qs, urlsplit
+
+from keto_tpu.expand.tree import Tree
+from keto_tpu.relationtuple.model import (
+    RelationQuery,
+    RelationTuple,
+    subject_set_from_url_query,
+)
+from keto_tpu.x.errors import ErrBadRequest, ErrNilSubject, KetoError
+from keto_tpu.x.pagination import with_size, with_token
+
+READ = "read"
+WRITE = "write"
+
+
+class RestApp:
+    """Routes requests for one server role against the registry."""
+
+    def __init__(self, registry, role: str):
+        self.registry = registry
+        self.role = role
+
+    # -- dispatch ------------------------------------------------------------
+
+    def handle(self, method: str, path: str, query: dict[str, list[str]], body: bytes):
+        """Returns (status, payload-dict | None, headers-dict)."""
+        try:
+            route = (method, path)
+            if path in ("/health/alive", "/health/ready"):
+                return 200, {"status": "ok"}, {}
+            if path == "/version":
+                return 200, {"version": self.registry.version()}, {}
+
+            if self.role == READ:
+                if route == ("GET", "/check"):
+                    return self._get_check(query)
+                if route == ("POST", "/check"):
+                    return self._post_check(body)
+                if route == ("GET", "/expand"):
+                    return self._get_expand(query)
+                if route == ("GET", "/relation-tuples"):
+                    return self._get_relation_tuples(query)
+            else:
+                if route == ("PUT", "/relation-tuples"):
+                    return self._put_relation_tuple(body)
+                if route == ("DELETE", "/relation-tuples"):
+                    return self._delete_relation_tuple(query)
+                if route == ("PATCH", "/relation-tuples"):
+                    return self._patch_relation_tuples(body)
+
+            err = KetoError("404 page not found")
+            err.status_code = 404
+            return 404, err.to_json(), {}
+        except KetoError as e:
+            return e.status_code, e.to_json(), {}
+        except Exception as e:  # unexpected → 500 envelope
+            err = KetoError(str(e) or "internal server error")
+            return 500, err.to_json(), {}
+
+    # -- read ----------------------------------------------------------------
+
+    def _check(self, tuple_: RelationTuple):
+        allowed = self.registry.check_batcher().check(tuple_)
+        return (200 if allowed else 403), {"allowed": allowed}, {}
+
+    def _get_check(self, query):
+        try:
+            tuple_ = RelationTuple.from_url_query(query)
+        except ErrNilSubject:
+            raise ErrBadRequest("Subject has to be specified.") from None
+        return self._check(tuple_)
+
+    def _post_check(self, body: bytes):
+        try:
+            obj = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            raise ErrBadRequest(f"Unable to decode JSON payload: {e}") from None
+        return self._check(RelationTuple.from_json(obj))
+
+    def _get_expand(self, query):
+        raw_depth = (query.get("max-depth") or [""])[0]
+        try:
+            depth = int(raw_depth)
+        except ValueError:
+            raise ErrBadRequest(f"invalid max-depth {raw_depth!r}") from None
+        subject = subject_set_from_url_query(query)
+        tree = self.registry.expand_engine().build_tree(subject, depth)
+        if tree is None:
+            return 200, None, {}
+        return 200, tree.to_json(), {}
+
+    def _get_relation_tuples(self, query):
+        rq = RelationQuery.from_url_query(query)
+        opts = []
+        token = (query.get("page_token") or [""])[0]
+        if token:
+            opts.append(with_token(token))
+        raw_size = (query.get("page_size") or [""])[0]
+        if raw_size:
+            try:
+                opts.append(with_size(int(raw_size)))
+            except ValueError:
+                raise ErrBadRequest(f"invalid page_size {raw_size!r}") from None
+        rels, next_page = self.registry.relation_tuple_manager().get_relation_tuples(rq, *opts)
+        return (
+            200,
+            {
+                "relation_tuples": [r.to_json() for r in rels],
+                "next_page_token": next_page,
+            },
+            {},
+        )
+
+    # -- write ---------------------------------------------------------------
+
+    def _put_relation_tuple(self, body: bytes):
+        try:
+            obj = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            raise ErrBadRequest(str(e)) from None
+        rel = RelationTuple.from_json(obj)
+        self.registry.relation_tuple_manager().write_relation_tuples(rel)
+        location = "/relation-tuples?" + rel.to_url_query()
+        return 201, rel.to_json(), {"Location": location}
+
+    def _delete_relation_tuple(self, query):
+        rel = RelationTuple.from_url_query(query)
+        self.registry.relation_tuple_manager().delete_relation_tuples(rel)
+        return 204, None, {}
+
+    def _patch_relation_tuples(self, body: bytes):
+        try:
+            deltas = json.loads(body or b"[]")
+        except json.JSONDecodeError as e:
+            raise ErrBadRequest(str(e)) from None
+        if not isinstance(deltas, list):
+            raise ErrBadRequest("expected a JSON array of patch deltas")
+        insert, delete = [], []
+        for d in deltas:
+            raw = d.get("relation_tuple") if isinstance(d, dict) else None
+            if raw is None:
+                raise ErrBadRequest("relation_tuple is missing")
+            action = d.get("action")
+            if action == "insert":
+                insert.append(RelationTuple.from_json(raw))
+            elif action == "delete":
+                delete.append(RelationTuple.from_json(raw))
+            else:
+                raise ErrBadRequest(f"unknown action {action}")
+        self.registry.relation_tuple_manager().transact_relation_tuples(insert, delete)
+        return 204, None, {}
+
+
+def _make_handler(app: RestApp):
+    logger = app.registry.logger()
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "keto-tpu"
+
+        def _serve(self, method: str):
+            parts = urlsplit(self.path)
+            query = parse_qs(parts.query, keep_blank_values=True)
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            status, payload, headers = app.handle(method, parts.path, query, body)
+            data = b"" if payload is None else json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.end_headers()
+            if data:
+                self.wfile.write(data)
+
+        def log_message(self, fmt, *args):  # per-request logging, health excluded
+            if not self.path.startswith("/health/"):
+                logger.debug("%s", fmt % args)
+
+        def do_GET(self):
+            self._serve("GET")
+
+        def do_POST(self):
+            self._serve("POST")
+
+        def do_PUT(self):
+            self._serve("PUT")
+
+        def do_DELETE(self):
+            self._serve("DELETE")
+
+        def do_PATCH(self):
+            self._serve("PATCH")
+
+    return Handler
+
+
+class RestServer:
+    """One role's REST server on its own port, served from a thread."""
+
+    def __init__(self, registry, role: str, host: str = "127.0.0.1", port: int = 0):
+        self.app = RestApp(registry, role)
+        self.httpd = ThreadingHTTPServer((host or "0.0.0.0", port), _make_handler(self.app))
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name=f"rest-{self.app.role}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,64 @@
+"""Structured logging.
+
+The analog of the reference's logrusx setup (reference
+internal/driver/registry_factory.go:33): level and format come from config
+(``log.level``, ``log.format``), per-request logging is attached by the REST
+servers excluding health endpoints (reference registry_default.go:275,300),
+and ``text``/``json`` formats are supported.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any, Optional
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        body: dict[str, Any] = {
+            "level": record.levelname.lower(),
+            "msg": record.getMessage(),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S%z", time.localtime(record.created)),
+            "logger": record.name,
+        }
+        extra = getattr(record, "fields", None)
+        if extra:
+            body.update(extra)
+        if record.exc_info:
+            body["error"] = self.formatException(record.exc_info)
+        return json.dumps(body)
+
+
+class _TextFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        base = f"{self.formatTime(record, '%H:%M:%S')} {record.levelname:<5} {record.name}: {record.getMessage()}"
+        extra = getattr(record, "fields", None)
+        if extra:
+            base += " " + " ".join(f"{k}={v}" for k, v in extra.items())
+        if record.exc_info:
+            base += "\n" + self.formatException(record.exc_info)
+        return base
+
+
+def new_logger(level: str = "info", fmt: str = "text", name: str = "keto_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    logger.setLevel(getattr(logging, level.upper(), logging.INFO))
+    logger.propagate = False
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_JsonFormatter() if fmt == "json" else _TextFormatter())
+    logger.handlers = [handler]
+    return logger
+
+
+def with_fields(logger: logging.Logger, **fields) -> logging.LoggerAdapter:
+    """Attach structured fields to subsequent log calls."""
+
+    class _Adapter(logging.LoggerAdapter):
+        def process(self, msg, kwargs):
+            kwargs.setdefault("extra", {})["fields"] = {**fields, **kwargs.get("extra", {}).get("fields", {})}
+            return msg, kwargs
+
+    return _Adapter(logger, {})

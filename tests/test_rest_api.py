@@ -1,0 +1,202 @@
+"""REST API parity tests.
+
+Boots real read/write REST servers on free ports and exercises the
+reference's routes, parameters, status codes, and error envelopes
+(reference internal/check/handler_test.go:41-110,
+internal/relationtuple/read_server.go, transact_server.go).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.config.provider import Config
+from keto_tpu.driver.registry import Registry
+from keto_tpu.servers.rest import READ, WRITE, RestServer
+
+
+@pytest.fixture
+def servers():
+    cfg = Config(overrides={"namespaces": [{"id": 0, "name": "videos"}, {"id": 1, "name": "groups"}]})
+    reg = Registry(cfg)
+    read = RestServer(reg, READ, port=0)
+    write = RestServer(reg, WRITE, port=0)
+    read.start()
+    write.start()
+    yield read, write
+    read.stop()
+    write.stop()
+    reg.close()
+
+
+def req(server, method, path, body=None):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method)
+    if data:
+        r.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(r) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None, dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None, dict(e.headers)
+
+
+def tuple_json(ns, obj, rel, subject_id=None, subject_set=None):
+    body = {"namespace": ns, "object": obj, "relation": rel}
+    if subject_id is not None:
+        body["subject_id"] = subject_id
+    if subject_set is not None:
+        body["subject_set"] = subject_set
+    return body
+
+
+def test_health_and_version(servers):
+    read, write = servers
+    for s in servers:
+        assert req(s, "GET", "/health/alive")[0] == 200
+        assert req(s, "GET", "/health/ready")[0] == 200
+    status, body, _ = req(read, "GET", "/version")
+    assert status == 200 and "version" in body
+
+
+def test_check_status_mirrors_decision(servers):
+    read, write = servers
+    status, body, headers = req(
+        write, "PUT", "/relation-tuples", tuple_json("videos", "v1", "view", subject_id="alice")
+    )
+    assert status == 201
+    assert headers.get("Location", "").startswith("/relation-tuples?")
+    assert body["namespace"] == "videos"
+
+    # GET /check via URL query: 200 allowed
+    status, body, _ = req(
+        read, "GET", "/check?namespace=videos&object=v1&relation=view&subject_id=alice"
+    )
+    assert (status, body) == (200, {"allowed": True})
+    # denied → 403 with allowed=false
+    status, body, _ = req(
+        read, "GET", "/check?namespace=videos&object=v1&relation=view&subject_id=bob"
+    )
+    assert (status, body) == (403, {"allowed": False})
+    # POST variant
+    status, body, _ = req(
+        read, "POST", "/check", tuple_json("videos", "v1", "view", subject_id="alice")
+    )
+    assert (status, body) == (200, {"allowed": True})
+    # unknown namespace → denied, not an error
+    status, body, _ = req(
+        read, "GET", "/check?namespace=nope&object=v1&relation=view&subject_id=alice"
+    )
+    assert (status, body) == (403, {"allowed": False})
+
+
+def test_check_requires_subject(servers):
+    read, _ = servers
+    status, body, _ = req(read, "GET", "/check?namespace=videos&object=v1&relation=view")
+    assert status == 400
+    assert body["error"]["code"] == 400
+
+
+def test_expand(servers):
+    read, write = servers
+    req(write, "PUT", "/relation-tuples",
+        tuple_json("videos", "v2", "view",
+                   subject_set={"namespace": "groups", "object": "g1", "relation": "member"}))
+    req(write, "PUT", "/relation-tuples", tuple_json("groups", "g1", "member", subject_id="u1"))
+
+    status, body, _ = req(
+        read, "GET", "/expand?namespace=videos&object=v2&relation=view&max-depth=3"
+    )
+    assert status == 200
+    assert body["type"] == "union"
+    assert body["subject_set"]["object"] == "v2"
+    child = body["children"][0]
+    assert child["type"] == "union"
+    assert child["children"][0] == {"type": "leaf", "subject_id": "u1"}
+
+    # missing max-depth → 400 (reference parses it unconditionally)
+    status, _, _ = req(read, "GET", "/expand?namespace=videos&object=v2&relation=view")
+    assert status == 400
+
+
+def test_relation_tuples_crud_and_pagination(servers):
+    read, write = servers
+    for i in range(5):
+        req(write, "PUT", "/relation-tuples", tuple_json("videos", "list", "view", subject_id=f"u{i}"))
+
+    status, body, _ = req(
+        read, "GET", "/relation-tuples?namespace=videos&object=list&relation=view&page_size=2"
+    )
+    assert status == 200
+    assert len(body["relation_tuples"]) == 2
+    assert body["next_page_token"] == "2"
+
+    # follow pagination to the end
+    seen = [t["subject_id"] for t in body["relation_tuples"]]
+    token = body["next_page_token"]
+    while token:
+        status, body, _ = req(
+            read,
+            "GET",
+            f"/relation-tuples?namespace=videos&object=list&relation=view&page_size=2&page_token={token}",
+        )
+        seen += [t["subject_id"] for t in body["relation_tuples"]]
+        token = body["next_page_token"]
+    assert seen == [f"u{i}" for i in range(5)]
+
+    # unknown namespace → 404 error envelope (not a deny)
+    status, body, _ = req(read, "GET", "/relation-tuples?namespace=nope")
+    assert status == 404 and body["error"]["code"] == 404
+
+    # DELETE by query → 204; tuple is gone
+    status, _, _ = req(
+        write, "DELETE", "/relation-tuples?namespace=videos&object=list&relation=view&subject_id=u0"
+    )
+    assert status == 204
+    _, body, _ = req(read, "GET", "/relation-tuples?namespace=videos&object=list&relation=view")
+    assert [t["subject_id"] for t in body["relation_tuples"]] == [f"u{i}" for i in range(1, 5)]
+
+
+def test_patch_transaction(servers):
+    read, write = servers
+    req(write, "PUT", "/relation-tuples", tuple_json("videos", "p", "view", subject_id="old"))
+    status, _, _ = req(write, "PATCH", "/relation-tuples", [
+        {"action": "insert", "relation_tuple": tuple_json("videos", "p", "view", subject_id="new")},
+        {"action": "delete", "relation_tuple": tuple_json("videos", "p", "view", subject_id="old")},
+    ])
+    assert status == 204
+    _, body, _ = req(read, "GET", "/relation-tuples?namespace=videos&object=p&relation=view")
+    assert [t["subject_id"] for t in body["relation_tuples"]] == ["new"]
+
+    # unknown action → 400, nothing applied
+    status, body, _ = req(write, "PATCH", "/relation-tuples", [
+        {"action": "upsert", "relation_tuple": tuple_json("videos", "p", "view", subject_id="x")},
+    ])
+    assert status == 400
+    _, body, _ = req(read, "GET", "/relation-tuples?namespace=videos&object=p&relation=view")
+    assert [t["subject_id"] for t in body["relation_tuples"]] == ["new"]
+
+    # write into an unknown namespace → 404, transaction rolled back
+    status, body, _ = req(write, "PATCH", "/relation-tuples", [
+        {"action": "insert", "relation_tuple": tuple_json("videos", "p", "view", subject_id="y")},
+        {"action": "insert", "relation_tuple": tuple_json("nope", "p", "view", subject_id="y")},
+    ])
+    assert status == 404
+    _, body, _ = req(read, "GET", "/relation-tuples?namespace=videos&object=p&relation=view")
+    assert [t["subject_id"] for t in body["relation_tuples"]] == ["new"]
+
+
+def test_read_write_split(servers):
+    read, write = servers
+    # write routes absent from the read server
+    status, _, _ = req(read, "PUT", "/relation-tuples", tuple_json("videos", "x", "r", subject_id="u"))
+    assert status == 404
+    # read routes absent from the write server
+    status, _, _ = req(write, "GET", "/check?namespace=videos&object=x&relation=r&subject_id=u")
+    assert status == 404
