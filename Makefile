@@ -1,0 +1,29 @@
+# Build/test entry points (the reference drives the same tasks from its
+# Makefile: build tags, codegen, tests — reference Makefile:44-108).
+
+CXX ?= g++
+CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
+
+.PHONY: all native proto test bench clean
+
+all: native proto
+
+# native tuple→graph interner (keto_tpu/graph/native.py loads it)
+native: native/libketoingest.so
+
+native/libketoingest.so: native/ingest.cpp
+	$(CXX) $(CXXFLAGS) -shared $< -o $@
+
+# regenerate protobuf modules from the wire contract
+proto:
+	protoc -I proto -I /usr/include --python_out=. \
+		proto/ory/keto/acl/v1alpha1/*.proto proto/grpchealth/v1/health.proto
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+clean:
+	rm -f native/libketoingest.so

@@ -93,6 +93,22 @@ class InternedGraph:
     def num_nodes(self) -> int:
         return self.num_sets + self.num_leaves
 
+    # -- resolution (the native interner implements the same interface) ------
+
+    def resolve_set(self, ns_id: int, obj: str, rel: str) -> int:
+        """Raw set-node id, or -1 when absent."""
+        return self.set_ids.get((ns_id, obj, rel), -1)
+
+    def resolve_leaf(self, subject_id: str) -> int:
+        """Raw leaf index (not offset by num_sets), or -1 when absent."""
+        return self.leaf_ids.get(subject_id, -1)
+
+    def obj_code(self, s: str) -> int:
+        return self.obj_codes.get(s, -1)
+
+    def rel_code(self, s: str) -> int:
+        return self.rel_codes.get(s, -1)
+
 
 def intern_rows(rows: Iterable, wild_ns_ids: FrozenSet[int] = frozenset()) -> InternedGraph:
     """Intern ``persistence.memory.InternalRow``-shaped rows (attributes:
@@ -108,6 +124,10 @@ def intern_rows(rows: Iterable, wild_ns_ids: FrozenSet[int] = frozenset()) -> In
         if idx is None:
             idx = len(set_ids)
             set_ids[key] = idx
+            # intern field codes at node creation so code numbering matches
+            # the native interner exactly (native/ingest.cpp set_node)
+            objc.code(obj)
+            relc.code(rel)
         return idx
 
     def leaf_node(s: str) -> int:

@@ -1,0 +1,186 @@
+"""ctypes binding for the native (C++) tuple→graph interner.
+
+``native/ingest.cpp`` implements the same interning contract as
+``keto_tpu.graph.interner.intern_rows`` (same node-id assignment order, same
+wildcard-expansion edges, same dedup), parsing a packed byte buffer in one
+native pass and keeping the string tables resident so per-query resolution
+stays in C++. Build it with ``make native`` (repo root); loading is
+opportunistic — ``load_library()`` returns None and callers fall back to the
+Python interner when the shared object is absent or
+``KETO_TPU_NATIVE=0``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+from typing import Iterable, Optional
+
+import numpy as np
+
+_FIELD = b"\x1f"
+_RECORD = b"\x1e"
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_checked = False
+
+
+def _candidate_paths():
+    if os.environ.get("KETO_TPU_NATIVE_LIB"):
+        yield Path(os.environ["KETO_TPU_NATIVE_LIB"])
+    root = Path(__file__).resolve().parents[2]
+    yield root / "native" / "libketoingest.so"
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_checked
+    if _lib_checked:
+        return _lib
+    _lib_checked = True
+    if os.environ.get("KETO_TPU_NATIVE", "1") == "0":
+        return None
+    for path in _candidate_paths():
+        if not path.exists():
+            continue
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            continue  # corrupt / wrong-arch build → Python fallback
+        c = ctypes.c_int64
+        p = ctypes.c_void_p
+        lib.graph_build.restype = p
+        lib.graph_build.argtypes = [ctypes.c_char_p, c, ctypes.POINTER(c), c]
+        lib.graph_free.argtypes = [p]
+        for fn in ("graph_num_sets", "graph_num_leaves", "graph_num_edges"):
+            getattr(lib, fn).restype = c
+            getattr(lib, fn).argtypes = [p]
+        lib.graph_edges.argtypes = [p, ctypes.POINTER(c), ctypes.POINTER(c)]
+        lib.graph_release_edges.argtypes = [p]
+        lib.graph_keys.argtypes = [
+            p, ctypes.POINTER(c), ctypes.POINTER(c), ctypes.POINTER(c),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.graph_resolve_set.restype = c
+        lib.graph_resolve_set.argtypes = [p, c, ctypes.c_char_p, c, ctypes.c_char_p, c]
+        for fn in ("graph_resolve_leaf", "graph_obj_code", "graph_rel_code"):
+            getattr(lib, fn).restype = c
+            getattr(lib, fn).argtypes = [p, ctypes.c_char_p, c]
+        _lib = lib
+        return _lib
+    return None
+
+
+def encode_row(r) -> bytes:
+    """One InternalRow-shaped row in the parser's record format — the single
+    Python-side definition of the wire encoding (native/ingest.cpp parses
+    it; InternalRow.packed() caches it)."""
+    if r.subject_id is not None:
+        sub = b"1" + _FIELD + r.subject_id.encode() + _FIELD + _FIELD
+    else:
+        sub = (
+            b"0" + _FIELD + str(r.sset_namespace_id).encode() + _FIELD
+            + r.sset_object.encode() + _FIELD + r.sset_relation.encode()
+        )
+    return (
+        str(r.namespace_id).encode() + _FIELD + r.object.encode() + _FIELD
+        + r.relation.encode() + _FIELD + sub + _RECORD
+    )
+
+
+def pack_rows(rows) -> bytes:
+    """Serialize rows into the parser's buffer format. Rows exposing
+    ``packed()`` (keto_tpu.persistence.memory.InternalRow) amortize the
+    encoding across snapshot rebuilds."""
+    if not isinstance(rows, list):
+        rows = list(rows)
+    if not rows:
+        return b""
+    if hasattr(rows[0], "packed"):
+        return b"".join(r.packed() for r in rows)
+    return b"".join(encode_row(r) for r in rows)
+
+
+class NativeInterned:
+    """Drop-in for ``InternedGraph``: same arrays and resolution interface,
+    backed by the resident C++ intern tables."""
+
+    def __init__(self, lib: ctypes.CDLL, handle: int):
+        self._lib = lib
+        self._handle = handle
+        c = ctypes.c_int64
+        self.num_sets = int(lib.graph_num_sets(handle))
+        self.num_leaves = int(lib.graph_num_leaves(handle))
+        n_edges = int(lib.graph_num_edges(handle))
+        self.src = np.empty(n_edges, np.int64)
+        self.dst = np.empty(n_edges, np.int64)
+        if n_edges:
+            lib.graph_edges(
+                handle,
+                self.src.ctypes.data_as(ctypes.POINTER(c)),
+                self.dst.ctypes.data_as(ctypes.POINTER(c)),
+            )
+        lib.graph_release_edges(handle)  # numpy owns the copies now
+        self.key_ns = np.empty(self.num_sets, np.int64)
+        self.key_obj = np.empty(self.num_sets, np.int64)
+        self.key_rel = np.empty(self.num_sets, np.int64)
+        self.key_wild = np.empty(self.num_sets, np.uint8)
+        if self.num_sets:
+            lib.graph_keys(
+                handle,
+                self.key_ns.ctypes.data_as(ctypes.POINTER(c)),
+                self.key_obj.ctypes.data_as(ctypes.POINTER(c)),
+                self.key_rel.ctypes.data_as(ctypes.POINTER(c)),
+                self.key_wild.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            )
+        self.key_wild = self.key_wild.astype(bool)
+
+    @property
+    def num_nodes(self) -> int:
+        return self.num_sets + self.num_leaves
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and self._handle:
+            lib.graph_free(self._handle)
+            self._handle = None
+
+    def resolve_set(self, ns_id: int, obj: str, rel: str) -> int:
+        o, r = obj.encode(), rel.encode()
+        return int(self._lib.graph_resolve_set(self._handle, ns_id, o, len(o), r, len(r)))
+
+    def resolve_leaf(self, subject_id: str) -> int:
+        s = subject_id.encode()
+        return int(self._lib.graph_resolve_leaf(self._handle, s, len(s)))
+
+    def obj_code(self, s: str) -> int:
+        b = s.encode()
+        return int(self._lib.graph_obj_code(self._handle, b, len(b)))
+
+    def rel_code(self, s: str) -> int:
+        b = s.encode()
+        return int(self._lib.graph_rel_code(self._handle, b, len(b)))
+
+
+def native_intern_rows(rows: Iterable, wild_ns_ids=frozenset()) -> Optional[NativeInterned]:
+    """Native counterpart of ``intern_rows``; None when the lib is absent."""
+    lib = load_library()
+    if lib is None:
+        return None
+    if not isinstance(rows, list):
+        rows = list(rows)
+    buf = pack_rows(rows)
+    # strings containing the separator control bytes would corrupt the
+    # framing — detectable as a field-count mismatch; fall back to Python
+    if buf.count(_FIELD) != 6 * len(rows) or buf.count(_RECORD) != len(rows):
+        return None
+    wild = np.asarray(sorted(wild_ns_ids), np.int64)
+    handle = lib.graph_build(
+        buf,
+        len(buf),
+        wild.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(wild),
+    )
+    if not handle:
+        return None  # parser rejected the buffer → Python fallback
+    return NativeInterned(lib, handle)

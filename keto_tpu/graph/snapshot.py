@@ -64,15 +64,10 @@ class GraphSnapshot:
     num_sets: int
     num_leaves: int
     buckets: list[Bucket]
-    set_dev: dict[tuple[int, str, str], int]  # (ns_id, obj, rel) → device id
-    leaf_dev: dict[str, int]  # subject-id string → device id
-    # set-node key fields aligned with *raw* set index, for wildcard matching
-    key_ns: np.ndarray
-    key_obj: np.ndarray
-    key_rel: np.ndarray
-    obj_codes: dict[str, int]
-    rel_codes: dict[str, int]
-    set_raw2dev: np.ndarray  # int64 [num_sets]
+    # string→raw-id resolution: an InternedGraph (Python dicts) or a
+    # NativeInterned (resident C++ tables) — same interface either way
+    interned: Any
+    raw2dev: np.ndarray  # int64 [n_nodes]: raw node id → device id
     wild_ns_ids: FrozenSet[int] = frozenset()
     # forward CSR over device ids, host-side (expand assist, debugging)
     fwd_indptr: Optional[np.ndarray] = None  # int64 [n_nodes+1]
@@ -91,10 +86,12 @@ class GraphSnapshot:
 
 
     def resolve_set(self, ns_id: int, obj: str, rel: str) -> Optional[int]:
-        return self.set_dev.get((ns_id, obj, rel))
+        raw = self.interned.resolve_set(ns_id, obj, rel)
+        return None if raw < 0 else int(self.raw2dev[raw])
 
     def resolve_leaf(self, subject_id: str) -> Optional[int]:
-        return self.leaf_dev.get(subject_id)
+        raw = self.interned.resolve_leaf(subject_id)
+        return None if raw < 0 else int(self.raw2dev[raw + self.num_sets])
 
     def resolve_starts(self, ns_id: int, obj: str, rel: str) -> np.ndarray:
         """Device ids of the set nodes a check starting at ``(ns, obj, rel)``
@@ -110,7 +107,7 @@ class GraphSnapshot:
         """
         ns_wild = ns_id == WILDCARD or ns_id in self.wild_ns_ids
         if not ns_wild and obj != "" and rel != "":
-            dev = self.set_dev.get((ns_id, obj, rel))
+            dev = self.resolve_set(ns_id, obj, rel)
             return np.asarray([] if dev is None else [dev], np.int64)
 
         key = (WILDCARD if ns_wild else ns_id, obj if obj != "" else None, rel if rel != "" else None)
@@ -120,14 +117,14 @@ class GraphSnapshot:
             return hit
         m = np.ones(self.num_sets, bool)
         if not ns_wild:
-            m &= self.key_ns == ns_id
+            m &= self.interned.key_ns == ns_id
         if obj != "":
-            code = self.obj_codes.get(obj)
-            m &= (self.key_obj == code) if code is not None else False
+            code = self.interned.obj_code(obj)
+            m &= (self.interned.key_obj == code) if code >= 0 else False
         if rel != "":
-            code = self.rel_codes.get(rel)
-            m &= (self.key_rel == code) if code is not None else False
-        starts = self.set_raw2dev[np.nonzero(m)[0]]
+            code = self.interned.rel_code(rel)
+            m &= (self.interned.key_rel == code) if code >= 0 else False
+        starts = self.raw2dev[: self.num_sets][np.nonzero(m)[0]]
         with self._cache_lock:
             self._pattern_cache[key] = starts
         return starts
@@ -139,9 +136,16 @@ def build_snapshot(
     """Intern rows and lay out the bucketed reverse-ELL adjacency.
 
     ``wild_ns_ids``: ids of configured namespaces whose *name* is the empty
-    string — their set nodes expand with a wildcarded namespace.
+    string — their set nodes expand with a wildcarded namespace. Interning
+    runs in the native C++ path when ``native/libketoingest.so`` is built
+    (``make native``), else in Python.
     """
-    g: InternedGraph = intern_rows(rows, wild_ns_ids)
+    rows = list(rows)
+    from keto_tpu.graph.native import native_intern_rows
+
+    g = native_intern_rows(rows, wild_ns_ids)
+    if g is None:
+        g = intern_rows(rows, wild_ns_ids)
     src_raw, dst_raw = g.src, g.dst
     n = g.num_nodes
 
@@ -151,14 +155,8 @@ def build_snapshot(
             num_sets=0,
             num_leaves=0,
             buckets=[],
-            set_dev={},
-            leaf_dev={},
-            key_ns=np.zeros(0, np.int64),
-            key_obj=np.zeros(0, np.int64),
-            key_rel=np.zeros(0, np.int64),
-            obj_codes={},
-            rel_codes={},
-            set_raw2dev=np.zeros(0, np.int64),
+            interned=g,
+            raw2dev=np.zeros(0, np.int64),
             wild_ns_ids=wild_ns_ids,
             fwd_indptr=np.zeros(1, np.int64),
             fwd_indices=np.zeros(0, np.int32),
@@ -208,22 +206,13 @@ def build_snapshot(
     findices = dst_dev[forder].astype(np.int32)
     findptr = np.searchsorted(fsrc, np.arange(n + 1))
 
-    set_dev = {key: int(raw2dev[raw]) for key, raw in g.set_ids.items()}
-    leaf_dev = {key: int(raw2dev[raw + g.num_sets]) for key, raw in g.leaf_ids.items()}
-
     return GraphSnapshot(
         snapshot_id=watermark,
         num_sets=g.num_sets,
         num_leaves=g.num_leaves,
         buckets=buckets,
-        set_dev=set_dev,
-        leaf_dev=leaf_dev,
-        key_ns=g.key_ns,
-        key_obj=g.key_obj,
-        key_rel=g.key_rel,
-        obj_codes=g.obj_codes,
-        rel_codes=g.rel_codes,
-        set_raw2dev=raw2dev[: g.num_sets],
+        interned=g,
+        raw2dev=raw2dev,
         wild_ns_ids=wild_ns_ids,
         fwd_indptr=findptr,
         fwd_indices=findices,

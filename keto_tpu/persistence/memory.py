@@ -53,6 +53,18 @@ class InternalRow:
     sset_relation: Optional[str]
     seq: int  # commit order (the reference's commit_time)
 
+    def packed(self) -> bytes:
+        """The native interner's record encoding, cached on first use so
+        snapshot rebuilds pay serialization once per row lifetime
+        (keto_tpu/graph/native.py documents the format)."""
+        cached = self.__dict__.get("_packed")
+        if cached is None:
+            from keto_tpu.graph.native import encode_row
+
+            cached = encode_row(self)
+            object.__setattr__(self, "_packed", cached)
+        return cached
+
     def sort_key(self):
         # ORDER BY namespace_id, object, relation, subject_id,
         #   subject_set_namespace_id, subject_set_object, subject_set_relation,

@@ -1,0 +1,271 @@
+// Native tuple→graph ingest: string interning and edge construction.
+//
+// The hot host-side path when (re)building a device snapshot is interning
+// millions of tuple rows into int32 node ids (keto_tpu/graph/interner.py
+// documents the node/edge model and wildcard-expansion semantics; this file
+// implements the same contract behind a C ABI). The Python fallback walks
+// rows in a Python loop; this implementation parses a packed byte buffer in
+// one pass and keeps the intern tables resident so query resolution
+// (set-node and leaf lookups) stays native too.
+//
+// Input buffer format, one record per tuple row, fields separated by 0x1F
+// (unit separator), records by 0x1E (record separator):
+//   ns_id '\x1f' object '\x1f' relation '\x1f' kind '\x1f' f0 '\x1f' f1 '\x1f' f2 '\x1e'
+// where kind is "0" (subject set: f0=ns_id, f1=object, f2=relation) or
+// "1" (subject id: f0=id, f1=f2 empty). ns_id is decimal ASCII.
+//
+// Exported functions use plain C types; ownership of the Graph handle stays
+// with the caller (graph_free).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct SetKey {
+    int64_t ns;
+    std::string obj;
+    std::string rel;
+    bool operator==(const SetKey& o) const {
+        return ns == o.ns && obj == o.obj && rel == o.rel;
+    }
+};
+
+struct SetKeyHash {
+    size_t operator()(const SetKey& k) const {
+        size_t h = std::hash<int64_t>()(k.ns);
+        h ^= std::hash<std::string>()(k.obj) + 0x9e3779b97f4a7c15ULL + (h << 6) + (h >> 2);
+        h ^= std::hash<std::string>()(k.rel) + 0x9e3779b97f4a7c15ULL + (h << 6) + (h >> 2);
+        return h;
+    }
+};
+
+struct Graph {
+    std::unordered_map<SetKey, int64_t, SetKeyHash> set_ids;
+    std::unordered_map<std::string, int64_t> leaf_ids;
+    std::unordered_map<std::string, int64_t> obj_codes;
+    std::unordered_map<std::string, int64_t> rel_codes;
+    // per set node, aligned with set id
+    std::vector<int64_t> key_ns, key_obj, key_rel;
+    std::vector<uint8_t> wild;
+    // tuples (lhs set id, per-field codes, subject raw kind/idx)
+    std::vector<int64_t> t_lhs, t_ns, t_obj, t_rel, t_sub_idx;
+    std::vector<uint8_t> t_sub_kind;
+    // final edges (raw ids; dst offset by num_sets for leaves)
+    std::vector<int64_t> src, dst;
+    std::vector<int64_t> wild_ns_ids;
+};
+
+int64_t intern_code(std::unordered_map<std::string, int64_t>& table, std::string_view s) {
+    auto it = table.find(std::string(s));
+    if (it != table.end()) return it->second;
+    int64_t code = (int64_t)table.size();
+    table.emplace(std::string(s), code);
+    return code;
+}
+
+int64_t set_node(Graph& g, int64_t ns, std::string_view obj, std::string_view rel,
+                 bool ns_wild) {
+    SetKey key{ns, std::string(obj), std::string(rel)};
+    auto it = g.set_ids.find(key);
+    if (it != g.set_ids.end()) return it->second;
+    int64_t id = (int64_t)g.set_ids.size();
+    g.set_ids.emplace(std::move(key), id);
+    g.key_ns.push_back(ns);
+    g.key_obj.push_back(intern_code(g.obj_codes, obj));
+    g.key_rel.push_back(intern_code(g.rel_codes, rel));
+    g.wild.push_back(ns_wild || obj.empty() || rel.empty());
+    return id;
+}
+
+int64_t leaf_node(Graph& g, std::string_view s) {
+    auto it = g.leaf_ids.find(std::string(s));
+    if (it != g.leaf_ids.end()) return it->second;
+    int64_t id = (int64_t)g.leaf_ids.size();
+    g.leaf_ids.emplace(std::string(s), id);
+    return id;
+}
+
+bool is_wild_ns(const Graph& g, int64_t ns) {
+    for (int64_t w : g.wild_ns_ids)
+        if (w == ns) return true;
+    return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse the packed row buffer; returns a Graph handle or nullptr on a
+// malformed buffer.
+Graph* graph_build(const char* buf, int64_t len, const int64_t* wild_ns_ids,
+                   int64_t n_wild_ns) {
+    Graph* g = new Graph();
+    g->wild_ns_ids.assign(wild_ns_ids, wild_ns_ids + n_wild_ns);
+
+    const char* p = buf;
+    const char* end = buf + len;
+    std::string_view fields[7];
+    while (p < end) {
+        // split one record into 7 fields
+        int f = 0;
+        const char* field_start = p;
+        while (p < end && f < 7) {
+            if (*p == '\x1f' || *p == '\x1e') {
+                fields[f++] = std::string_view(field_start, (size_t)(p - field_start));
+                bool rec_end = (*p == '\x1e');
+                ++p;
+                field_start = p;
+                if (rec_end) break;
+            } else {
+                ++p;
+            }
+        }
+        if (f != 7) {
+            delete g;
+            return nullptr;
+        }
+        int64_t ns = 0;
+        for (char c : fields[0]) {
+            if (c < '0' || c > '9') { delete g; return nullptr; }
+            ns = ns * 10 + (c - '0');
+        }
+        int64_t lhs = set_node(*g, ns, fields[1], fields[2], is_wild_ns(*g, ns));
+        g->t_lhs.push_back(lhs);
+        g->t_ns.push_back(ns);
+        g->t_obj.push_back(intern_code(g->obj_codes, fields[1]));
+        g->t_rel.push_back(intern_code(g->rel_codes, fields[2]));
+        if (fields[3] == "1") {
+            g->t_sub_kind.push_back(1);
+            g->t_sub_idx.push_back(leaf_node(*g, fields[4]));
+        } else {
+            int64_t sns = 0;
+            for (char c : fields[4]) {
+                if (c < '0' || c > '9') { delete g; return nullptr; }
+                sns = sns * 10 + (c - '0');
+            }
+            g->t_sub_kind.push_back(0);
+            g->t_sub_idx.push_back(
+                set_node(*g, sns, fields[5], fields[6], is_wild_ns(*g, sns)));
+        }
+    }
+
+    // edges: literal LHS nodes take their own tuples; wildcard-bearing set
+    // nodes take every matching tuple's subject (see interner.py pass 2)
+    const int64_t num_sets = (int64_t)g->set_ids.size();
+    const size_t nt = g->t_lhs.size();
+    auto sub_raw = [&](size_t i) {
+        return g->t_sub_kind[i] ? g->t_sub_idx[i] + num_sets : g->t_sub_idx[i];
+    };
+    for (size_t i = 0; i < nt; ++i) {
+        if (!g->wild[(size_t)g->t_lhs[i]]) {
+            g->src.push_back(g->t_lhs[i]);
+            g->dst.push_back(sub_raw(i));
+        }
+    }
+    int64_t empty_obj = -1, empty_rel = -1;
+    {
+        auto it = g->obj_codes.find("");
+        if (it != g->obj_codes.end()) empty_obj = it->second;
+        it = g->rel_codes.find("");
+        if (it != g->rel_codes.end()) empty_rel = it->second;
+    }
+    for (int64_t s = 0; s < num_sets; ++s) {
+        if (!g->wild[(size_t)s]) continue;
+        const bool ns_w = is_wild_ns(*g, g->key_ns[(size_t)s]);
+        const bool obj_w = g->key_obj[(size_t)s] == empty_obj;
+        const bool rel_w = g->key_rel[(size_t)s] == empty_rel;
+        for (size_t i = 0; i < nt; ++i) {
+            if (!ns_w && g->t_ns[i] != g->key_ns[(size_t)s]) continue;
+            if (!obj_w && g->t_obj[i] != g->key_obj[(size_t)s]) continue;
+            if (!rel_w && g->t_rel[i] != g->key_rel[(size_t)s]) continue;
+            g->src.push_back(s);
+            g->dst.push_back(sub_raw(i));
+        }
+    }
+
+    // dedup edges (duplicate tuples add nothing to reachability)
+    if (!g->src.empty()) {
+        std::vector<size_t> order(g->src.size());
+        for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+        const int64_t n_nodes = num_sets + (int64_t)g->leaf_ids.size();
+        std::vector<int64_t> packed(g->src.size());
+        for (size_t i = 0; i < packed.size(); ++i)
+            packed[i] = g->src[i] * n_nodes + g->dst[i];
+        std::sort(packed.begin(), packed.end());
+        packed.erase(std::unique(packed.begin(), packed.end()), packed.end());
+        g->src.resize(packed.size());
+        g->dst.resize(packed.size());
+        for (size_t i = 0; i < packed.size(); ++i) {
+            g->src[i] = packed[i] / n_nodes;
+            g->dst[i] = packed[i] % n_nodes;
+        }
+    }
+
+    // per-tuple build temporaries are dead once edges exist; the handle
+    // stays resident for string resolution, so drop them now
+    std::vector<int64_t>().swap(g->t_lhs);
+    std::vector<int64_t>().swap(g->t_ns);
+    std::vector<int64_t>().swap(g->t_obj);
+    std::vector<int64_t>().swap(g->t_rel);
+    std::vector<int64_t>().swap(g->t_sub_idx);
+    std::vector<uint8_t>().swap(g->t_sub_kind);
+    return g;
+}
+
+// Free the edge arrays once the caller has copied them out; resolution
+// keeps working off the intern tables.
+void graph_release_edges(Graph* g) {
+    std::vector<int64_t>().swap(g->src);
+    std::vector<int64_t>().swap(g->dst);
+}
+
+void graph_free(Graph* g) { delete g; }
+
+int64_t graph_num_sets(const Graph* g) { return (int64_t)g->set_ids.size(); }
+int64_t graph_num_leaves(const Graph* g) { return (int64_t)g->leaf_ids.size(); }
+int64_t graph_num_edges(const Graph* g) { return (int64_t)g->src.size(); }
+
+// Copy-out accessors; caller allocates.
+void graph_edges(const Graph* g, int64_t* src, int64_t* dst) {
+    std::memcpy(src, g->src.data(), g->src.size() * sizeof(int64_t));
+    std::memcpy(dst, g->dst.data(), g->dst.size() * sizeof(int64_t));
+}
+
+void graph_keys(const Graph* g, int64_t* key_ns, int64_t* key_obj, int64_t* key_rel,
+                uint8_t* wild) {
+    std::memcpy(key_ns, g->key_ns.data(), g->key_ns.size() * sizeof(int64_t));
+    std::memcpy(key_obj, g->key_obj.data(), g->key_obj.size() * sizeof(int64_t));
+    std::memcpy(key_rel, g->key_rel.data(), g->key_rel.size() * sizeof(int64_t));
+    std::memcpy(wild, g->wild.data(), g->wild.size());
+}
+
+// Resolution: -1 = not present.
+int64_t graph_resolve_set(const Graph* g, int64_t ns, const char* obj, int64_t obj_len,
+                          const char* rel, int64_t rel_len) {
+    SetKey key{ns, std::string(obj, (size_t)obj_len), std::string(rel, (size_t)rel_len)};
+    auto it = g->set_ids.find(key);
+    return it == g->set_ids.end() ? -1 : it->second;
+}
+
+int64_t graph_resolve_leaf(const Graph* g, const char* s, int64_t len) {
+    auto it = g->leaf_ids.find(std::string(s, (size_t)len));
+    return it == g->leaf_ids.end() ? -1 : it->second;
+}
+
+int64_t graph_obj_code(const Graph* g, const char* s, int64_t len) {
+    auto it = g->obj_codes.find(std::string(s, (size_t)len));
+    return it == g->obj_codes.end() ? -1 : it->second;
+}
+
+int64_t graph_rel_code(const Graph* g, const char* s, int64_t len) {
+    auto it = g->rel_codes.find(std::string(s, (size_t)len));
+    return it == g->rel_codes.end() ? -1 : it->second;
+}
+
+}  // extern "C"

@@ -1,0 +1,76 @@
+"""Native (C++) interner equivalence.
+
+The node-id assignment of ``native/ingest.cpp`` must be *identical* to the
+Python interner — both assign ids in first-appearance order and dedup edges
+by the same (src·n + dst) packing — so the arrays compare exactly, not just
+up to isomorphism.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from keto_tpu.graph.interner import intern_rows
+from keto_tpu.graph.native import load_library, native_intern_rows
+from keto_tpu.persistence.memory import InternalRow
+
+pytestmark = pytest.mark.skipif(
+    load_library() is None, reason="native/libketoingest.so not built (make native)"
+)
+
+
+def fuzz_rows(seed, n):
+    rng = random.Random(seed)
+    rows = []
+    for i in range(n):
+        ns = rng.choice([0, 1, 7])
+        obj = rng.choice(["", "a", "b", "obj-long-name", "ünïcode-объект"])
+        rel = rng.choice(["", "r", "member", "view"])
+        if rng.random() < 0.5:
+            rows.append(InternalRow(ns, obj, rel, rng.choice(["u1", "u2", "üser", ""]), None, None, None, i))
+        else:
+            rows.append(
+                InternalRow(ns, obj, rel, None, rng.choice([0, 1, 7]),
+                            rng.choice(["", "x", "group"]), rng.choice(["", "member"]), i)
+            )
+    return rows
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("wild_ns", [frozenset(), frozenset({7})])
+def test_exact_equivalence(seed, wild_ns):
+    rows = fuzz_rows(seed, 300)
+    py = intern_rows(rows, wild_ns)
+    nat = native_intern_rows(rows, wild_ns)
+    assert nat is not None
+
+    assert nat.num_sets == py.num_sets
+    assert nat.num_leaves == py.num_leaves
+    np.testing.assert_array_equal(nat.src, py.src)
+    np.testing.assert_array_equal(nat.dst, py.dst)
+    np.testing.assert_array_equal(nat.key_ns, py.key_ns)
+    np.testing.assert_array_equal(nat.key_obj, py.key_obj)
+    np.testing.assert_array_equal(nat.key_rel, py.key_rel)
+    np.testing.assert_array_equal(nat.key_wild, py.key_wild)
+
+    # resolution parity over every interned key + misses
+    for (ns, obj, rel), raw in py.set_ids.items():
+        assert nat.resolve_set(ns, obj, rel) == raw
+    for s, raw in py.leaf_ids.items():
+        assert nat.resolve_leaf(s) == raw
+    assert nat.resolve_set(99, "no", "no") == -1 == py.resolve_set(99, "no", "no")
+    assert nat.resolve_leaf("missing") == -1 == py.resolve_leaf("missing")
+    for s in ["", "a", "missing", "ünïcode-объект"]:
+        assert nat.obj_code(s) == py.obj_code(s)
+        assert nat.rel_code(s) == py.rel_code(s)
+
+
+def test_separator_bytes_fall_back():
+    rows = [InternalRow(0, "bad\x1fobj", "r", "u", None, None, None, 0)]
+    assert native_intern_rows(rows, frozenset()) is None
+
+
+def test_empty():
+    nat = native_intern_rows([], frozenset())
+    assert nat is not None and nat.num_nodes == 0 and nat.src.size == 0
