@@ -4,7 +4,11 @@
 CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 
-.PHONY: all native proto test bench clean
+.PHONY: all native proto schemas test bench clean
+
+# render the public JSON schemas into .schema/
+schemas:
+	python scripts/render_schemas.py
 
 all: native proto
 

@@ -58,8 +58,10 @@ def serve(config):
     from keto_tpu.config.provider import Config
     from keto_tpu.driver.daemon import Daemon
     from keto_tpu.driver.registry import Registry
+    from keto_tpu.x import profiling
 
     cfg = Config(config_file=config)
+    profiling.attach(cfg.get("profiling", ""))  # reference main.go:25-28
     registry = Registry(cfg)
     Daemon(registry).serve_all(block=True)
 
@@ -354,6 +356,36 @@ def validate(files):
             failed = True
     if failed:
         sys.exit(1)
+
+
+@namespace.command("migrate-legacy")
+@click.argument("target", required=False)
+@click.option("--config", "-c", default=None)
+@click.option("--yes", "-y", is_flag=True)
+def migrate_legacy(target, config, yes):
+    """Migrate v0.6-era per-namespace tables into the single tuple table
+    (reference cmd/namespace/migrate_legacy.go:18-118)."""
+    from keto_tpu.persistence.legacy import ToSingleTableMigrator
+
+    p = _migrator(config)
+    p.migrate_up()
+    m = ToSingleTableMigrator(p)
+    namespaces = m.legacy_namespaces()
+    if target is not None:
+        namespaces = [n for n in namespaces if n.name == target]
+        if not namespaces:
+            raise SystemExit(f"no legacy table found for namespace {target!r}")
+    if not namespaces:
+        click.echo("No legacy namespace tables found, nothing to do.")
+        return
+    names = ", ".join(n.name for n in namespaces)
+    if not yes and not click.confirm(f"Migrate legacy tables for: {names}?"):
+        raise SystemExit("aborted")
+    for ns in namespaces:
+        report = m.migrate_namespace(ns)
+        click.echo(f"{ns.name}: migrated {report.migrated[ns.name]} tuples")
+        for bad in report.invalid:
+            click.echo(f"  SKIPPED {bad.object}#{bad.relation}@{bad.subject!r}: {bad.error}", err=True)
 
 
 # -- migrate -----------------------------------------------------------------

@@ -54,6 +54,7 @@ _DEFAULTS: dict[str, Any] = {
     "log": {"level": "info", "format": "text"},
     "tracing": {"provider": ""},
     "profiling": "",
+    "telemetry": {"enabled": False},
 }
 
 _ENV_KEYS = [

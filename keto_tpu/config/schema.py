@@ -90,10 +90,16 @@ CONFIG_SCHEMA = {
             "type": "object",
             "additionalProperties": False,
             "properties": {
-                "provider": {"type": "string", "enum": ["", "log"], "default": ""},
+                "provider": {"type": "string", "enum": ["", "log", "memory"], "default": ""},
             },
         },
         "profiling": {"type": "string", "enum": ["", "cpu", "mem"], "default": ""},
+        "telemetry": {
+            "type": "object",
+            "additionalProperties": False,
+            "description": "In-process usage counters (the zero-egress analog of the reference's SQA middleware, reference internal/driver/daemon.go:27-55). Off by default.",
+            "properties": {"enabled": {"type": "boolean", "default": False}},
+        },
     },
     "additionalProperties": False,
 }

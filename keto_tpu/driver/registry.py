@@ -115,6 +115,23 @@ class Registry:
 
         return self._memo("check_batcher", build)
 
+    # -- observability -------------------------------------------------------
+
+    def tracer(self):
+        from keto_tpu.x.tracing import Tracer
+
+        return self._memo(
+            "tracer",
+            lambda: Tracer(self._config.get("tracing.provider", ""), self.logger()),
+        )
+
+    def telemetry(self):
+        from keto_tpu.x.telemetry import Telemetry
+
+        return self._memo(
+            "telemetry", lambda: Telemetry(bool(self._config.get("telemetry.enabled", False)))
+        )
+
     # -- info ----------------------------------------------------------------
 
     def version(self) -> str:

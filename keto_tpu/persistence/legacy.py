@@ -1,0 +1,139 @@
+"""Legacy (v0.6-era) storage migrator: table-per-namespace → single table.
+
+The reference's v0.6 schema kept one ``keto_%010d_relation_tuples`` table
+per namespace with the subject stored in *string form*; v0.7 merged them
+into the single ``keto_relation_tuples`` table (reference
+internal/persistence/sql/migrations/single_table.go:126-242). This module
+reproduces that migration for the SQLite store:
+
+- paginated copy (batches of ``per_page``) per namespace, in one
+  transaction per namespace (MigrateNamespace :189-242);
+- subjects are parsed from their string form; rows that fail to parse are
+  collected and reported together (ErrInvalidTuples :84-99) without
+  aborting the already-valid rows' migration;
+- ``legacy_namespaces`` discovers migratable tables from the catalog
+  (LegacyNamespaces :244-285).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.persistence.sqlite import SQLitePersister
+from keto_tpu.relationtuple.model import SubjectID, SubjectSet, subject_from_string
+from keto_tpu.x.errors import KetoError
+
+
+def legacy_table_name(ns_id: int) -> str:
+    return f"keto_{ns_id:010d}_relation_tuples"
+
+
+@dataclass
+class InvalidTuple:
+    namespace: str
+    object: str
+    relation: str
+    subject: str
+    error: str
+
+
+class ErrInvalidTuples(KetoError):
+    status_code = 400
+
+    def __init__(self, tuples: list[InvalidTuple]):
+        super().__init__(
+            "found non-deserializable relationtuples: "
+            + ", ".join(f"{t.namespace}:{t.object}#{t.relation}@{t.subject!r}" for t in tuples)
+        )
+        self.tuples = tuples
+
+
+@dataclass
+class LegacyMigrationReport:
+    migrated: dict[str, int] = field(default_factory=dict)
+    invalid: list[InvalidTuple] = field(default_factory=list)
+
+
+class ToSingleTableMigrator:
+    def __init__(self, persister: SQLitePersister, per_page: int = 100):
+        self.p = persister
+        self.per_page = per_page
+
+    def legacy_namespaces(self) -> list[namespace_pkg.Namespace]:
+        """Configured namespaces whose legacy table exists in the catalog."""
+        out = []
+        with self.p._lock:
+            rows = self.p._conn.execute(
+                "SELECT name FROM sqlite_master WHERE type = 'table' AND name LIKE 'keto_%_relation_tuples'"
+            ).fetchall()
+        tables = {r[0] for r in rows} - {"keto_relation_tuples"}
+        for ns in self.p._nm().namespaces():
+            if legacy_table_name(ns.id) in tables:
+                out.append(ns)
+        return out
+
+    def migrate_namespace(self, ns: namespace_pkg.Namespace) -> LegacyMigrationReport:
+        """Copy one namespace's legacy rows; drops the legacy table when
+        every row migrated cleanly."""
+        report = LegacyMigrationReport()
+        table = legacy_table_name(ns.id)
+        n_done = 0
+        with self.p._lock:
+            self.p._conn.execute("BEGIN")
+            try:
+                offset = 0
+                while True:
+                    rows = self.p._conn.execute(
+                        f"SELECT object, relation, subject, commit_time FROM {table} "
+                        f"ORDER BY object, relation, subject LIMIT ? OFFSET ?",
+                        (self.per_page, offset),
+                    ).fetchall()
+                    if not rows:
+                        break
+                    offset += len(rows)
+                    for obj, rel, sub_str, _commit in rows:
+                        try:
+                            sub = subject_from_string(sub_str)
+                            if isinstance(sub, SubjectSet):
+                                # namespace must resolve for subject sets
+                                sns = self.p._nm().get_namespace_by_name(sub.namespace)
+                                values = (ns.id, obj, rel, None, sns.id, sub.object, sub.relation)
+                            else:
+                                values = (ns.id, obj, rel, sub.id, None, None, None)
+                        except KetoError as e:
+                            report.invalid.append(
+                                InvalidTuple(ns.name, obj, rel, sub_str, e.message)
+                            )
+                            continue
+                        self.p._conn.execute(
+                            "INSERT INTO keto_relation_tuples (shard_id, nid, namespace_id, "
+                            "object, relation, subject_id, subject_set_namespace_id, "
+                            "subject_set_object, subject_set_relation, commit_time) "
+                            "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, "
+                            "(SELECT COALESCE(MAX(commit_time), 0) + 1 FROM keto_relation_tuples))",
+                            (str(uuid.uuid4()), self.p.network_id) + values,
+                        )
+                        n_done += 1
+                if not report.invalid:
+                    self.p._conn.execute(f"DROP TABLE {table}")
+                self.p._conn.execute(
+                    "INSERT INTO keto_watermarks (nid, watermark) VALUES (?, 1) "
+                    "ON CONFLICT(nid) DO UPDATE SET watermark = watermark + 1",
+                    (self.p.network_id,),
+                )
+                self.p._conn.execute("COMMIT")
+            except Exception:
+                self.p._conn.execute("ROLLBACK")
+                raise
+        report.migrated[ns.name] = n_done
+        return report
+
+    def migrate_all(self) -> LegacyMigrationReport:
+        total = LegacyMigrationReport()
+        for ns in self.legacy_namespaces():
+            r = self.migrate_namespace(ns)
+            total.migrated.update(r.migrated)
+            total.invalid.extend(r.invalid)
+        return total

@@ -51,11 +51,17 @@ def _abort(context, err: KetoError):
     context.abort(_CODE_BY_NUM.get(err.grpc_code, grpc.StatusCode.INTERNAL), err.message)
 
 
-def _wrap(fn):
-    """Translate KetoError into gRPC status codes."""
+def _wrap(fn, registry=None, name: str = ""):
+    """Translate KetoError into gRPC status codes; trace + count the call
+    (the reference's otgrpc/grpc_logrus interceptor slot,
+    registry_default.go:327-346)."""
 
     def handler(request, context):
         try:
+            if registry is not None:
+                registry.telemetry().record(f"grpc {name}")
+                with registry.tracer().span(f"grpc.{name}"):
+                    return fn(request, context)
             return fn(request, context)
         except KetoError as e:
             _abort(context, e)
@@ -63,9 +69,9 @@ def _wrap(fn):
     return handler
 
 
-def _unary(fn, req_cls, resp_cls):
+def _unary(fn, req_cls, resp_cls, registry=None, name: str = ""):
     return grpc.unary_unary_rpc_method_handler(
-        _wrap(fn),
+        _wrap(fn, registry, name),
         request_deserializer=req_cls.FromString,
         response_serializer=resp_cls.SerializeToString,
     )
@@ -96,6 +102,8 @@ class CheckService:
                             self.Check,
                             check_service_pb2.CheckRequest,
                             check_service_pb2.CheckResponse,
+                            self.registry,
+                            "CheckService/Check",
                         )
                     },
                 ),
@@ -124,6 +132,8 @@ class ExpandService:
                             self.Expand,
                             expand_service_pb2.ExpandRequest,
                             expand_service_pb2.ExpandResponse,
+                            self.registry,
+                            "ExpandService/Expand",
                         )
                     },
                 ),
@@ -165,6 +175,8 @@ class ReadService:
                             self.ListRelationTuples,
                             read_service_pb2.ListRelationTuplesRequest,
                             read_service_pb2.ListRelationTuplesResponse,
+                            self.registry,
+                            "ReadService/ListRelationTuples",
                         )
                     },
                 ),
@@ -205,6 +217,8 @@ class WriteService:
                             self.TransactRelationTuples,
                             write_service_pb2.TransactRelationTuplesRequest,
                             write_service_pb2.TransactRelationTuplesResponse,
+                            self.registry,
+                            "WriteService/TransactRelationTuples",
                         )
                     },
                 ),

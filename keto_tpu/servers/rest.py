@@ -56,6 +56,15 @@ class RestApp:
 
     def handle(self, method: str, path: str, query: dict[str, list[str]], body: bytes):
         """Returns (status, payload-dict | None, headers-dict)."""
+        # request span + usage counter (health endpoints excluded), matching
+        # the reference's middleware placement (registry_default.go:288-300)
+        if not path.startswith("/health/"):
+            self.registry.telemetry().record(f"{self.role} {method} {path}")
+            with self.registry.tracer().span(f"http.{method} {path}", role=self.role):
+                return self._route(method, path, query, body)
+        return self._route(method, path, query, body)
+
+    def _route(self, method: str, path: str, query: dict[str, list[str]], body: bytes):
         try:
             route = (method, path)
             if path in ("/health/alive", "/health/ready"):

@@ -1,0 +1,43 @@
+"""Profiling guard.
+
+The analog of the reference's profilex wiring in main (reference
+main.go:25-28; config key ``profiling``, config.schema.json:271-280):
+``profiling: cpu`` wraps the process in cProfile, ``profiling: mem`` in
+tracemalloc; stats print to stderr on clean shutdown.
+"""
+
+from __future__ import annotations
+
+import atexit
+import sys
+from typing import Optional
+
+
+def attach(mode: str) -> None:
+    """Install the requested profiler for the process lifetime."""
+    if mode == "cpu":
+        import cProfile
+        import pstats
+
+        profiler = cProfile.Profile()
+        profiler.enable()
+
+        def dump():
+            profiler.disable()
+            pstats.Stats(profiler, stream=sys.stderr).sort_stats("cumulative").print_stats(40)
+
+        atexit.register(dump)
+    elif mode == "mem":
+        import tracemalloc
+
+        tracemalloc.start(10)
+
+        def dump():
+            snapshot = tracemalloc.take_snapshot()
+            print("== top allocations ==", file=sys.stderr)
+            for stat in snapshot.statistics("lineno")[:25]:
+                print(stat, file=sys.stderr)
+
+        atexit.register(dump)
+    elif mode:
+        raise ValueError(f"unknown profiling mode {mode!r} (want cpu|mem)")

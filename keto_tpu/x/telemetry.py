@@ -1,0 +1,32 @@
+"""Anonymized usage telemetry (disabled by default).
+
+The reference optionally posts anonymized request metrics to sqa.ory.sh via
+a middleware (reference internal/driver/daemon.go:27-55, flag
+``--sqa-opt-out``). This build runs in zero-egress environments, so the
+equivalent is an **in-process counter sink**: when enabled it aggregates
+request counts per route, exposes them for introspection, and never leaves
+the process. The collection seam matches the reference's middleware shape
+so a network exporter could be attached where the reference posts.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+
+class Telemetry:
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._counts: collections.Counter = collections.Counter()
+
+    def record(self, route: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._counts[route] += 1
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counts)

@@ -1,0 +1,93 @@
+"""Request tracing.
+
+The analog of the reference's opentracing wiring (reference
+internal/driver/config/provider.go:145-155 for config,
+registry_default.go:288-290 HTTP middleware, :331-333/:344-346 gRPC
+interceptors, pop_connection.go:17-23 SQL-level spans): spans carry a trace
+id, name, duration, and tags, propagate via a context variable, and export
+through a pluggable provider. Providers:
+
+- ``""`` (default): tracing disabled, spans are no-ops;
+- ``log``: finished spans go to the structured logger at debug level;
+- ``memory``: spans collect in a ring buffer (tests, /debug introspection).
+
+Zero-egress environments get no jaeger/zipkin exporter; the provider seam is
+where one would plug in.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "keto_tpu_span", default=None
+)
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start: float
+    end: Optional[float] = None
+    tags: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        return None if self.end is None else (self.end - self.start) * 1e3
+
+
+class Tracer:
+    def __init__(self, provider: str = "", logger=None, capacity: int = 1024):
+        self.provider = provider
+        self._logger = logger
+        self._lock = threading.Lock()
+        self.finished: collections.deque[Span] = collections.deque(maxlen=capacity)
+
+    @property
+    def enabled(self) -> bool:
+        return self.provider != ""
+
+    @contextmanager
+    def span(self, name: str, **tags) -> Iterator[Optional[Span]]:
+        if not self.enabled:
+            yield None
+            return
+        parent = _current_span.get()
+        s = Span(
+            name=name,
+            trace_id=parent.trace_id if parent else uuid.uuid4().hex,
+            span_id=uuid.uuid4().hex[:16],
+            parent_id=parent.span_id if parent else None,
+            start=time.perf_counter(),
+            tags=dict(tags),
+        )
+        token = _current_span.set(s)
+        try:
+            yield s
+        finally:
+            s.end = time.perf_counter()
+            _current_span.reset(token)
+            self._export(s)
+
+    def _export(self, s: Span) -> None:
+        if self.provider == "log" and self._logger is not None:
+            self._logger.debug(
+                "span %s trace=%s dur=%.2fms %s", s.name, s.trace_id, s.duration_ms, s.tags
+            )
+        elif self.provider == "memory":
+            with self._lock:
+                self.finished.append(s)
+
+
+#: process-wide no-op tracer used before a registry exists
+NOOP = Tracer("")

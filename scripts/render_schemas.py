@@ -1,0 +1,72 @@
+"""Render the public JSON schemas into .schema/ (reference keeps the same
+four files at .schema/*.schema.json; here they are generated from the
+in-code schemas so they cannot drift — `make schemas`)."""
+
+import json
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+from keto_tpu.config.schema import CONFIG_SCHEMA, NAMESPACE_SCHEMA  # noqa: E402
+
+RELATION_TUPLE_SCHEMA = {
+    "$id": "keto-tpu/relation_tuple.schema.json",
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "title": "Relation tuple",
+    "type": "object",
+    "oneOf": [
+        {"required": ["namespace", "object", "relation", "subject_id"]},
+        {"required": ["namespace", "object", "relation", "subject_set"]},
+    ],
+    "properties": {
+        "$schema": {"type": "string"},
+        "namespace": {"type": "string"},
+        "object": {"type": "string"},
+        "relation": {"type": "string"},
+        "subject_id": {"type": "string"},
+        "subject_set": {
+            "type": "object",
+            "additionalProperties": False,
+            "required": ["namespace", "object", "relation"],
+            "properties": {
+                "namespace": {"type": "string"},
+                "object": {"type": "string"},
+                "relation": {"type": "string"},
+            },
+        },
+    },
+    "additionalProperties": False,
+}
+
+VERSION_SCHEMA = {
+    "$id": "keto-tpu/version.schema.json",
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "title": "Version response",
+    "type": "object",
+    "required": ["version"],
+    "properties": {"version": {"type": "string"}},
+    "additionalProperties": False,
+}
+
+
+def render() -> dict[str, dict]:
+    return {
+        "config.schema.json": CONFIG_SCHEMA,
+        "namespace.schema.json": NAMESPACE_SCHEMA,
+        "relation_tuple.schema.json": RELATION_TUPLE_SCHEMA,
+        "version.schema.json": VERSION_SCHEMA,
+    }
+
+
+def main():
+    out = ROOT / ".schema"
+    out.mkdir(exist_ok=True)
+    for name, schema in render().items():
+        (out / name).write_text(json.dumps(schema, indent=2) + "\n")
+        print(f"rendered .schema/{name}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""CLI unit tests for the server-less commands.
+
+Client-facing commands (check/expand/get/create/status) are covered against
+a live daemon in tests/test_e2e.py; these cover the local ones: parse,
+namespace validate, migrate, version (reference cmd/relationtuple/parse.go,
+cmd/namespace/validate.go, cmd/migrate/*).
+"""
+
+import json
+
+import yaml
+from click.testing import CliRunner
+
+from keto_tpu.cmd import cli
+
+
+def run(args, input=None):
+    return CliRunner().invoke(cli, args, input=input, catch_exceptions=False)
+
+
+def test_parse_single_and_table(tmp_path):
+    f = tmp_path / "tuples.txt"
+    f.write_text(
+        "// comment line\n"
+        "\n"
+        "videos:/cats/1.mp4#view@alice\n"
+        "videos:/cats#owner@(videos:admins#member)\n"
+    )
+    result = run(["relation-tuple", "parse", str(f), "--format", "json"])
+    assert result.exit_code == 0
+    parsed = json.loads(result.output)
+    assert parsed[0]["subject_id"] == "alice"
+    assert parsed[1]["subject_set"]["object"] == "admins"
+
+    # single tuple renders its string form by default
+    single = tmp_path / "one.txt"
+    single.write_text("n:o#r@u\n")
+    result = run(["relation-tuple", "parse", str(single)])
+    assert result.output.strip() == "n:o#r@u"
+
+
+def test_parse_stdin_and_error():
+    result = run(["relation-tuple", "parse", "-", "--format", "json"], input="a:b#c@d\n")
+    assert json.loads(result.output)["namespace"] == "a"
+
+    result = CliRunner().invoke(cli, ["relation-tuple", "parse", "-"], input="not a tuple\n")
+    assert result.exit_code != 0
+    assert "Could not decode stdin:1" in str(result.output) + str(result.exception)
+
+
+def test_namespace_validate(tmp_path):
+    good = tmp_path / "good.yml"
+    good.write_text(yaml.safe_dump({"id": 1, "name": "ok"}))
+    bad = tmp_path / "bad.yml"
+    bad.write_text(yaml.safe_dump({"name": "missing-id"}))
+
+    assert run(["namespace", "validate", str(good)]).exit_code == 0
+    assert CliRunner().invoke(cli, ["namespace", "validate", str(bad)]).exit_code == 1
+
+
+def test_migrate_cycle(tmp_path):
+    db = tmp_path / "keto.db"
+    cfgf = tmp_path / "keto.yml"
+    cfgf.write_text(yaml.safe_dump({"dsn": f"sqlite://{db}", "namespaces": [{"id": 0, "name": "n"}]}))
+
+    result = run(["migrate", "status", "-c", str(cfgf)])
+    assert result.output.count("pending") == 5
+
+    result = run(["migrate", "up", "-c", str(cfgf), "--yes"])
+    assert "applied 5 migrations" in result.output
+    result = run(["migrate", "status", "-c", str(cfgf)])
+    assert result.output.count("applied") >= 5 and "pending" not in result.output
+
+    result = run(["migrate", "up", "-c", str(cfgf), "--yes"])
+    assert "nothing to do" in result.output
+
+    result = run(["migrate", "down", "-c", str(cfgf), "--yes", "--steps", "2"])
+    assert "rolled back 2" in result.output
+    result = run(["migrate", "status", "-c", str(cfgf)])
+    assert result.output.count("pending") == 2
+
+
+def test_version():
+    from keto_tpu.version import __version__
+
+    assert run(["version"]).output.strip() == __version__

@@ -1,0 +1,103 @@
+"""Config provider + namespace watcher.
+
+Covers the reference's config behaviors (reference
+internal/driver/config/provider_test.go, namespace_watcher_test.go):
+defaults, file/env layering, schema rejection, inline vs URI namespaces,
+hot-reload with last-good retention.
+"""
+
+import time
+
+import pytest
+import yaml
+
+from keto_tpu.config.provider import Config, NamespaceWatcher, load_namespaces_from_uri
+from keto_tpu.x.errors import ErrBadRequest, ErrNamespaceUnknown
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.dsn == "memory"
+    assert cfg.read_api_address() == ("", 4466)
+    assert cfg.write_api_address() == ("", 4467)
+    assert cfg.get("log.level") == "info"
+    cfg.close()
+
+
+def test_file_env_override_layering(tmp_path):
+    f = tmp_path / "keto.yml"
+    f.write_text(yaml.safe_dump({"serve": {"read": {"port": 1111}}, "log": {"level": "debug"}}))
+    cfg = Config(
+        config_file=str(f),
+        env={"SERVE_READ_PORT": "2222", "DSN": "sqlite://:memory:"},
+        overrides={"log.format": "json"},
+    )
+    # env beats file; explicit overrides beat both
+    assert cfg.read_api_address()[1] == 2222
+    assert cfg.dsn == "sqlite://:memory:"
+    assert cfg.get("log.level") == "debug"
+    assert cfg.get("log.format") == "json"
+    cfg.close()
+
+
+def test_schema_rejects_unknown_and_invalid():
+    with pytest.raises(ErrBadRequest):
+        Config(overrides={"serve.read.port": "not-a-port"})
+    with pytest.raises(ErrBadRequest):
+        Config(overrides={"nonsense_key": 1})
+    with pytest.raises(ErrBadRequest):
+        Config(overrides={"log.level": "extreme"})
+
+
+def test_inline_namespaces():
+    cfg = Config(overrides={"namespaces": [{"id": 3, "name": "docs"}]})
+    nm = cfg.namespace_manager()
+    assert nm.get_namespace_by_name("docs").id == 3
+    with pytest.raises(ErrNamespaceUnknown):
+        nm.get_namespace_by_name("nope")
+    cfg.close()
+
+
+def test_namespace_uri_file_and_dir(tmp_path):
+    (tmp_path / "a.yml").write_text(yaml.safe_dump({"id": 1, "name": "alpha"}))
+    (tmp_path / "b.json").write_text('[{"id": 2, "name": "beta"}]')
+    nss = load_namespaces_from_uri(f"file://{tmp_path}")
+    assert {n.name for n in nss} == {"alpha", "beta"}
+    nss = load_namespaces_from_uri(str(tmp_path / "a.yml"))
+    assert [n.name for n in nss] == ["alpha"]
+
+
+def test_watcher_hot_reload_keeps_last_good(tmp_path):
+    f = tmp_path / "ns.yml"
+    f.write_text(yaml.safe_dump({"id": 1, "name": "one"}))
+    w = NamespaceWatcher(str(f), poll_interval=0.05)
+    assert w.manager().get_namespace_by_name("one").id == 1
+
+    # valid change is picked up
+    f.write_text(yaml.safe_dump([{"id": 1, "name": "one"}, {"id": 2, "name": "two"}]))
+    assert w.check_reload() is True
+    assert w.manager().get_namespace_by_name("two").id == 2
+
+    # parse error → previous set retained (reference namespace_watcher.go:110-121)
+    f.write_text("{definitely: [not, valid")
+    assert w.check_reload() is False
+    assert w.manager().get_namespace_by_name("two").id == 2
+    w.stop()
+
+
+def test_config_watcher_integration(tmp_path):
+    f = tmp_path / "ns.yml"
+    f.write_text(yaml.safe_dump({"id": 7, "name": "watched"}))
+    cfg = Config(overrides={"namespaces": f"file://{f}"})
+    fired = []
+    cfg.on_namespace_change(lambda: fired.append(1))
+    assert cfg.namespace_manager().get_namespace_by_name("watched").id == 7
+    f.write_text(yaml.safe_dump({"id": 8, "name": "watched"}))
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if fired and cfg.namespace_manager().get_namespace_by_name("watched").id == 8:
+            break
+        time.sleep(0.05)
+    assert cfg.namespace_manager().get_namespace_by_name("watched").id == 8
+    assert fired
+    cfg.close()

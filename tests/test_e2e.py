@@ -268,12 +268,58 @@ def seeded(daemon):
     return daemon
 
 
-@pytest.fixture(params=["grpc", "rest", "cli"])
+class SdkClient:
+    """The generated-swagger-SDK analog (keto_tpu/httpclient.py) — fourth
+    client flavor, matching reference sdk_client_test.go."""
+
+    def __init__(self, daemon):
+        from keto_tpu.httpclient import KetoClient
+
+        self.c = KetoClient(
+            f"http://127.0.0.1:{daemon.read_port}", f"http://127.0.0.1:{daemon.write_port}"
+        )
+
+    def create(self, rt_json):
+        from keto_tpu.relationtuple.model import RelationTuple
+
+        self.c.create_relation_tuple(RelationTuple.from_json(rt_json))
+
+    def check(self, subject, relation, namespace, object):
+        from keto_tpu.relationtuple.model import RelationTuple, SubjectID
+
+        return self.c.check(
+            RelationTuple(namespace=namespace, object=object, relation=relation,
+                          subject=SubjectID(subject))
+        )
+
+    def list_subjects(self, namespace, object, relation, page_size=100):
+        from keto_tpu.relationtuple.model import RelationQuery
+
+        out, token = [], ""
+        while True:
+            resp = self.c.get_relation_tuples(
+                RelationQuery(namespace=namespace, object=object, relation=relation),
+                page_size=page_size,
+                page_token=token,
+            )
+            out += [str(t.subject) for t in resp.relation_tuples]
+            token = resp.next_page_token
+            if not token:
+                return out
+
+    def expand_tree(self, namespace, object, relation, depth=10):
+        tree = self.c.expand(namespace, object, relation, max_depth=depth)
+        return tree.to_json() if tree else None
+
+
+@pytest.fixture(params=["grpc", "rest", "cli", "sdk"])
 def client(request, seeded, tmp_path):
     if request.param == "grpc":
         return GrpcClient(seeded)
     if request.param == "rest":
         return RestClient(seeded)
+    if request.param == "sdk":
+        return SdkClient(seeded)
     return CliClient(seeded, tmp_path)
 
 

@@ -1,0 +1,86 @@
+"""Legacy single-table migration (reference
+internal/persistence/sql/migrations/single_table_test.go and the binary
+e2e at scripts/single-table-migration-e2e.sh: write legacy rows, migrate,
+assert identical check decisions)."""
+
+import yaml
+from click.testing import CliRunner
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.check import CheckEngine
+from keto_tpu.cmd import cli
+from keto_tpu.persistence.legacy import ToSingleTableMigrator, legacy_table_name
+from keto_tpu.persistence.sqlite import SQLitePersister
+from keto_tpu.relationtuple.model import RelationQuery, RelationTuple, SubjectID
+
+NAMESPACES = [namespace_pkg.Namespace(id=1, name="files"), namespace_pkg.Namespace(id=2, name="teams")]
+
+
+def make_legacy_store(tmp_path, rows_by_ns):
+    dsn = f"sqlite://{tmp_path}/legacy.db"
+    p = SQLitePersister(dsn, namespace_pkg.MemoryManager(NAMESPACES))
+    with p._lock:
+        for ns_id, rows in rows_by_ns.items():
+            table = legacy_table_name(ns_id)
+            p._conn.execute(
+                f"CREATE TABLE {table} (shard_id TEXT, object TEXT, relation TEXT, "
+                f"subject TEXT, commit_time INTEGER)"
+            )
+            for i, (obj, rel, sub) in enumerate(rows):
+                p._conn.execute(
+                    f"INSERT INTO {table} VALUES (?, ?, ?, ?, ?)", (str(i), obj, rel, sub, i)
+                )
+    return dsn, p
+
+
+def test_migrates_and_preserves_decisions(tmp_path):
+    dsn, p = make_legacy_store(
+        tmp_path,
+        {
+            1: [("readme", "view", "teams:devs#member"), ("readme", "edit", "ed")],
+            2: [("devs", "member", "deb")],
+        },
+    )
+    m = ToSingleTableMigrator(p, per_page=2)
+    assert [n.name for n in m.legacy_namespaces()] == ["files", "teams"]
+    report = m.migrate_all()
+    assert report.migrated == {"files": 2, "teams": 1}
+    assert report.invalid == []
+    # legacy tables dropped
+    assert m.legacy_namespaces() == []
+
+    e = CheckEngine(p)
+    assert e.subject_is_allowed(RelationTuple.from_string("files:readme#view@deb"))
+    assert e.subject_is_allowed(RelationTuple.from_string("files:readme#edit@ed"))
+    assert not e.subject_is_allowed(RelationTuple.from_string("files:readme#edit@deb"))
+
+
+def test_invalid_rows_collected_table_kept(tmp_path):
+    # a subject set referencing an unconfigured namespace cannot migrate
+    dsn, p = make_legacy_store(
+        tmp_path, {1: [("a", "r", "ghosts:x#member"), ("a", "r", "alice")]}
+    )
+    m = ToSingleTableMigrator(p)
+    report = m.migrate_all()
+    assert report.migrated == {"files": 1}
+    assert len(report.invalid) == 1
+    assert report.invalid[0].subject == "ghosts:x#member"
+    # table kept for retry after fixing config
+    assert [n.name for n in m.legacy_namespaces()] == ["files"]
+    # the valid row did land
+    rels, _ = p.get_relation_tuples(RelationQuery(namespace="files"))
+    assert [str(r.subject) for r in rels] == ["alice"]
+
+
+def test_cli_migrate_legacy(tmp_path):
+    dsn, p = make_legacy_store(tmp_path, {2: [("devs", "member", "deb")]})
+    p.close()
+    cfgf = tmp_path / "keto.yml"
+    cfgf.write_text(
+        yaml.safe_dump({"dsn": dsn, "namespaces": [n.to_json() for n in NAMESPACES]})
+    )
+    result = CliRunner().invoke(
+        cli, ["namespace", "migrate-legacy", "-c", str(cfgf), "--yes"], catch_exceptions=False
+    )
+    assert result.exit_code == 0, result.output
+    assert "teams: migrated 1 tuples" in result.output

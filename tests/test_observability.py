@@ -1,0 +1,69 @@
+"""Tracing, telemetry, and logging plumbing."""
+
+import pytest
+
+from keto_tpu.config.provider import Config
+from keto_tpu.driver.registry import Registry
+from keto_tpu.servers.rest import READ, RestApp
+from keto_tpu.x.tracing import Tracer
+from keto_tpu.x.telemetry import Telemetry
+
+
+def test_tracer_disabled_is_noop():
+    t = Tracer("")
+    with t.span("x") as s:
+        assert s is None
+    assert len(t.finished) == 0
+
+
+def test_tracer_memory_provider_nests():
+    t = Tracer("memory")
+    with t.span("outer", role="read") as outer:
+        with t.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert [s.name for s in t.finished] == ["inner", "outer"]
+    assert t.finished[1].tags == {"role": "read"}
+    assert t.finished[0].duration_ms is not None
+
+
+def test_telemetry_counts_only_when_enabled():
+    t = Telemetry(enabled=False)
+    t.record("a")
+    assert t.snapshot() == {}
+    t = Telemetry(enabled=True)
+    t.record("a")
+    t.record("a")
+    t.record("b")
+    assert t.snapshot() == {"a": 2, "b": 1}
+
+
+def test_rest_requests_traced_and_counted():
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "n"}],
+            "tracing.provider": "memory",
+            "telemetry.enabled": True,
+        }
+    )
+    reg = Registry(cfg)
+    app = RestApp(reg, READ)
+    app.handle("GET", "/health/alive", {}, b"")  # excluded from both
+    status, _, _ = app.handle(
+        "GET",
+        "/check",
+        {"namespace": ["n"], "object": ["o"], "relation": ["r"], "subject_id": ["u"]},
+        b"",
+    )
+    assert status == 403
+    assert reg.telemetry().snapshot() == {"read GET /check": 1}
+    assert [s.name for s in reg.tracer().finished] == ["http.GET /check"]
+    reg.close()
+
+
+def test_profiling_attach_validates():
+    from keto_tpu.x import profiling
+
+    with pytest.raises(ValueError):
+        profiling.attach("gpu")
+    profiling.attach("")  # no-op
