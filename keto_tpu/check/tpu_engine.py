@@ -5,18 +5,27 @@ one SQL query per subject-set node per page (reference
 internal/check/engine.go:33-95), this engine answers **thousands of checks
 in one device program**:
 
-- up to 32·W queries are packed into a ``uint32[n_nodes+1, W]`` reached
+- up to 32·W queries are packed into a ``uint32[n_live+1, W]`` reached
   bitmap ``R`` — bit ``q%32`` of word ``q//32`` in row ``v`` means "query q
-  has reached node v";
-- one BFS step is a **pull**: ``P[v] = OR over in-neighbors s of R[s]``,
-  computed per degree bucket as a gather + OR-reduction
-  (see keto_tpu/graph/snapshot.py for the layout rationale);
+  has reached node v". Only nodes *with in-edges* ("live") get bitmap rows;
+  zero-in-degree ("static") nodes never change and are handled by
+  propagating their start bits one hop on the host at batch setup
+  (``pack_chunk``), which both seeds ``R`` and pre-computes their
+  contribution to the answer;
+- one BFS step is a **pull**: ``P[v] = OR over live in-neighbors s of
+  R[s]``, computed per degree bucket as a gather + OR-reduction over
+  *live→live* edges only (see keto_tpu/graph/snapshot.py for the layout
+  rationale). Rows that can change ("active") form a prefix of the bitmap;
+  the loop updates them in place via an aliased carry — nothing the size of
+  the full graph is ever copied per step;
 - ``lax.while_loop`` iterates to the reachability fixpoint (the analog of
   the reference's visited-set cycle guard — monotone bitmaps make cycles
   terminate for free);
-- the answer for query q is the target-row bit of ``A = ⋃ pulls``, i.e.
-  "reached via ≥ 1 edge", reproducing the reference's rule that a subject
-  only matches via an actual tuple, never by being the queried set itself.
+- the answer for query q is the target-row bit of ``pull(fixpoint) ∪
+  one-hop-term``, i.e. "reached via ≥ 1 edge", reproducing the reference's
+  rule that a subject only matches via an actual tuple, never by being the
+  queried set itself. The fixpoint pull is carried out of the loop (the
+  converging iteration already computed it) — no extra answer pass.
 
 Decision parity with the reference engine:
 - unknown namespace → denied, not an error (engine.go:76-77): host
@@ -35,6 +44,7 @@ Decision parity with the reference engine:
 
 from __future__ import annotations
 
+import logging
 import threading
 from functools import partial
 from typing import Callable, Optional, Sequence
@@ -49,6 +59,11 @@ from keto_tpu.graph.snapshot import WILDCARD, GraphSnapshot, build_snapshot
 from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
 from keto_tpu.x.errors import ErrNamespaceUnknown
 
+_log = logging.getLogger("keto_tpu.check")
+
+#: distinct-from-None cache sentinel for namespace resolution
+_UNSET = object()
+
 # batch widths (in 32-query words) the engine compiles for; a request is
 # padded up to the smallest fitting width so jit caches stay small
 _WORD_WIDTHS = (1, 8, 64, 256)
@@ -59,36 +74,36 @@ _DEGREE_CHUNK = 1024
 def _pull(
     bucket_nbrs: Sequence[jnp.ndarray], bucket_valid_rows: Sequence[int], R: jnp.ndarray
 ) -> jnp.ndarray:
-    """One BFS pull step over the live (in-edged) rows.
+    """One BFS pull step over the active rows.
 
-    R: uint32[n_nodes+1, W] → uint32[n_live, W]. Zero-in-degree nodes sort
-    last in device order (their rows never change after initialization), so
-    the pull only produces the live prefix. Buckets are contiguous in
-    device-id order — concatenating per-bucket OR-reductions yields the
-    prefix with no scatter.
+    R: uint32[n_live+1, W] → uint32[n_active, W]. Buckets hold live→live
+    edges and are contiguous in device-id order — concatenating per-bucket
+    OR-reductions yields the active prefix with no scatter.
     """
     outs = []
     for nbrs, n_valid in zip(bucket_nbrs, bucket_valid_rows):
         n_pad, cap = nbrs.shape
-        if cap == 0:
-            continue  # zero-in-degree tail: not part of the live prefix
         acc = None
         for c0 in range(0, cap, _DEGREE_CHUNK):
             gathered = R[nbrs[:, c0 : c0 + _DEGREE_CHUNK]]  # [n_pad, chunk, W]
             part = lax.reduce(gathered, np.uint32(0), lax.bitwise_or, (1,))
             acc = part if acc is None else lax.bitwise_or(acc, part)
         outs.append(acc[:n_valid])
-    return jnp.concatenate(outs, axis=0) if outs else R[:0]
+    return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
 
 
 def check_step(
     bucket_nbrs: tuple[jnp.ndarray, ...],
-    start_rows: jnp.ndarray,  # int32[SP] node device ids (padding → n_nodes)
-    start_words: jnp.ndarray,  # int32[SP] query word index
-    start_masks: jnp.ndarray,  # uint32[SP] query bit mask (padding → 0)
-    targets: jnp.ndarray,  # int32[B], n_nodes = unresolved
+    e1_rows: jnp.ndarray,  # int32[S1] live start rows (padding → n_live+1)
+    e1_words: jnp.ndarray,  # int32[S1] query word index
+    e1_masks: jnp.ndarray,  # uint32[S1] query bit mask (padding → 0)
+    e2_rows: jnp.ndarray,  # int32[S2] one-hop rows from static starts
+    e2_words: jnp.ndarray,  # int32[S2]
+    e2_masks: jnp.ndarray,  # uint32[S2]
+    targets: jnp.ndarray,  # int32[B], n_live = unresolved/unreachable
     *,
-    n_nodes: int,
+    n_active: int,
+    n_live: int,
     valid_rows: tuple[int, ...],
     it_cap: int,
     block_iters: int = 8,
@@ -96,66 +111,76 @@ def check_step(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     B = targets.shape[0]
     W = B // 32
-    n_live = sum(n for (nb, n) in zip(bucket_nbrs, valid_rows) if nb.shape[1] > 0)
     q = jnp.arange(B)
     words = q // 32
     bits = (q % 32).astype(jnp.uint32)
     # per (row, word) slot, masks from distinct queries occupy distinct bits
-    # and per-query start lists are deduplicated on host, so scatter-add
+    # and per-query row lists are deduplicated on host, so scatter-add
     # never carries — add on disjoint bits is bitwise OR
-    R0 = (
-        jnp.zeros((n_nodes + 1, W), jnp.uint32)
-        .at[start_rows, start_words]
-        .add(start_masks, mode="drop")
-    )
+    zero = jnp.zeros((n_live + 1, W), jnp.uint32)
+    # the one-hop term: start bits of static (zero-in-degree) nodes
+    # propagated to their out-neighbors on host. These bits are "reached
+    # via ≥ 1 edge" by construction, so they feed both R0 and the answer.
+    ans_base = zero.at[e2_rows, e2_words].add(e2_masks, mode="drop")
+    R0 = zero.at[e1_rows, e1_words].add(e1_masks, mode="drop") | ans_base
     if bitmap_sharding is not None:
         # "data" shards words (embarrassingly parallel); "graph" shards rows
         # and lets the SPMD partitioner insert the per-step all-gather the
         # pull's cross-shard row gathers need
         R0 = lax.with_sharding_constraint(R0, bitmap_sharding)
-    # rows past n_live (zero-in-degree nodes + the phantom sentinel) never
-    # change — only the live prefix is carried through the loop
-    static_tail = R0[n_live:]
+        ans_base = lax.with_sharding_constraint(ans_base, bitmap_sharding)
 
-    def step(live):
-        R = jnp.concatenate([live, static_tail], axis=0)
-        nxt = lax.bitwise_or(_pull(bucket_nbrs, valid_rows, R), live)
-        return nxt, jnp.any(nxt != live)
+    if n_active == 0 or not bucket_nbrs:
+        # no live→live edges: every answer is already in the one-hop term
+        a = ans_base[targets, words]
+        return (a >> bits) & jnp.uint32(1) == 1, jnp.int32(0), jnp.bool_(False)
+
+    # Only the active prefix R[:n_active] can change; the in-place .set on
+    # the while-loop carry aliases, so passive rows are never copied.
+    def step(st):
+        R, _, _, it = st
+        p = _pull(bucket_nbrs, valid_rows, R)
+        act = R[:n_active]
+        nxt = lax.bitwise_or(p, act)
+        return R.at[:n_active].set(nxt), p, jnp.any(nxt != act), it + 1
 
     # The while cond is the only point the runtime must observe a device
     # value, which costs a full round trip on tunneled devices — so each
     # while iteration runs a *block* of pulls, each skipped via lax.cond
     # once the fixpoint is reached (monotone bitmaps: converged stays
     # converged). Steady state: one observation per batch.
-    def block(carry):
-        def one(_, st):
-            live, changed, it = st
-            nxt, ch = lax.cond(
-                changed, step, lambda l: (l, jnp.bool_(False)), live
-            )
-            return nxt, ch, it + changed.astype(jnp.int32)
-        return lax.fori_loop(0, block_iters, one, carry)
+    def block(st):
+        return lax.fori_loop(
+            0, block_iters, lambda _, s: lax.cond(s[2], step, lambda x: x, s), st
+        )
 
-    live, _, iters = lax.while_loop(
-        lambda c: c[1] & (c[2] < it_cap), block, (R0[:n_live], jnp.bool_(True), jnp.int32(0))
+    # p0 is shape-placeholder only: changed=True guarantees ≥ 1 real step
+    p0 = R0[:n_active]
+    _, p_fix, truncated, iters = lax.while_loop(
+        lambda st: st[2] & (st[3] < it_cap),
+        block,
+        (R0, p0, jnp.bool_(True), jnp.int32(0)),
     )
 
-    # answers require "reached via ≥ 1 edge": one more pull of the fixpoint,
-    # without the OR of start bits; unreachable rows (no in-edges) stay zero
-    R_fix = jnp.concatenate([live, static_tail], axis=0)
-    A = jnp.concatenate(
-        [_pull(bucket_nbrs, valid_rows, R_fix), jnp.zeros((n_nodes + 1 - n_live, W), jnp.uint32)],
-        axis=0,
-    )
-    hit = (A[targets, words] >> bits) & jnp.uint32(1)
-    return hit == 1, iters
+    # answers require "reached via ≥ 1 edge": the pull of the fixpoint —
+    # already computed by the converging iteration and carried out of the
+    # loop — plus the one-hop term. Passive/unresolved targets read row
+    # n_active of the padded pull (all-zero) and row ≤ n_live of ans_base.
+    pull_p = jnp.concatenate([p_fix, jnp.zeros((1, W), jnp.uint32)], axis=0)
+    t_act = jnp.where(targets < n_active, targets, n_active)
+    a = pull_p[t_act, words] | ans_base[targets, words]
+    # truncated: the loop stopped on the iteration cap while the frontier
+    # was still growing — converging in exactly it_cap steps is NOT truncation
+    return (a >> bits) & jnp.uint32(1) == 1, iters, truncated
 
 
 #: jitted entrypoint used by the engine; ``check_step`` stays un-jitted for
 #: ahead-of-time compile checks (__graft_entry__.py)
 _check_kernel = partial(
     jax.jit,
-    static_argnames=("n_nodes", "valid_rows", "it_cap", "block_iters", "bitmap_sharding"),
+    static_argnames=(
+        "n_active", "n_live", "valid_rows", "it_cap", "block_iters", "bitmap_sharding"
+    ),
 )(check_step)
 
 
@@ -163,50 +188,95 @@ def _ceil_pow2(x: int) -> int:
     return 1 if x <= 1 else 1 << (int(x) - 1).bit_length()
 
 
-def pack_batch(
-    snap: GraphSnapshot,
-    resolved: Sequence[tuple[np.ndarray, int]],
-    force_W: Optional[int] = None,
-):
-    """Pack resolved queries into kernel arguments.
+def _csr_gather(indptr: np.ndarray, indices: np.ndarray, nodes: np.ndarray):
+    """(all out-neighbors of ``nodes`` concatenated, per-node counts)."""
+    cnts = indptr[nodes + 1] - indptr[nodes]
+    total = int(cnts.sum())
+    if not total:
+        return np.zeros(0, indices.dtype), cnts
+    base = np.repeat(indptr[nodes], cnts)
+    within = np.arange(total) - np.repeat(np.cumsum(cnts) - cnts, cnts)
+    return indices[base + within], cnts
 
-    ``resolved`` holds per-query ``(start device ids, target device id)``
-    from ``TpuCheckEngine._resolve``. Returns ``(rows, words, masks,
-    targets)`` numpy arrays, or None when no query has a start node (the
-    whole batch is a guaranteed deny).
-    """
-    nq = len(resolved)
-    W = force_W or next(w for w in _WORD_WIDTHS if 32 * w >= nq)
-    B = 32 * W
-    targets = np.full(B, snap.n_nodes, dtype=np.int32)
-    rows_l: list[np.ndarray] = []
-    words_l: list[np.ndarray] = []
-    masks_l: list[np.ndarray] = []
-    for i, (starts, t) in enumerate(resolved):
-        targets[i] = t
-        if starts.size:
-            rows_l.append(starts)
-            words_l.append(np.full(starts.size, i // 32, np.int32))
-            masks_l.append(np.full(starts.size, np.uint32(1) << np.uint32(i % 32)))
-    if not rows_l:
-        return None
 
-    rows = np.concatenate(rows_l).astype(np.int32)
-    words = np.concatenate(words_l)
-    masks = np.concatenate(masks_l)
-    # keep the kernel's start-array geometry to a handful of shapes: SP == B
-    # when entries fit; multi-start chunks share the max-batch size (the
-    # chunker caps entries there); only a single query with a larger
-    # wildcard fan-out grows past it
-    if rows.size <= B:
-        sp = B
+def _pad_entries(rows_l, words_l, masks_l, B: int, drop_row: int):
+    """Concatenate + pad scatter-entry lists to a small set of geometries:
+    size B when entries fit, else the next power of two (≥ the max batch) —
+    so every chunk of a request hits the same jit cache entry."""
+    if rows_l:
+        rows = np.concatenate(rows_l).astype(np.int32)
+        words = np.concatenate(words_l)
+        masks = np.concatenate(masks_l)
     else:
-        sp = max(_ceil_pow2(rows.size), 32 * _WORD_WIDTHS[-1])
+        rows = np.zeros(0, np.int32)
+        words = np.zeros(0, np.int32)
+        masks = np.zeros(0, np.uint32)
+    sp = B if rows.size <= B else max(_ceil_pow2(rows.size), 32 * _WORD_WIDTHS[-1])
     pad = sp - rows.size
-    rows = np.concatenate([rows, np.full(pad, snap.n_nodes, np.int32)])
+    rows = np.concatenate([rows, np.full(pad, drop_row, np.int32)])
     words = np.concatenate([words, np.zeros(pad, np.int32)])
     masks = np.concatenate([masks, np.zeros(pad, np.uint32)])
-    return rows, words, masks, targets
+    return rows, words, masks
+
+
+def pack_chunk(
+    snap: GraphSnapshot,
+    sd: np.ndarray,
+    tg: np.ndarray,
+    multi: dict,
+    i0: int,
+    i1: int,
+    force_W: Optional[int] = None,
+):
+    """Pack queries ``[i0, i1)`` of a bulk-resolved batch into kernel
+    arguments — vectorized numpy throughout (the host side of the hot path,
+    replacing the reference's per-traversal-step SQL round trips).
+
+    ``sd``/``tg``/``multi`` come from ``TpuCheckEngine._resolve_bulk``.
+    Single static starts are propagated one hop here via the forward CSR
+    (out-neighbor lists are duplicate-free: both interners dedup edges).
+    Returns ``(e1_rows, e1_words, e1_masks, e2_rows, e2_words, e2_masks,
+    targets)`` numpy arrays, or None when no query has any entry (the whole
+    chunk is a guaranteed deny).
+    """
+    nq = i1 - i0
+    W = force_W or next(w for w in _WORD_WIDTHS if 32 * w >= nq)
+    B = 32 * W
+    nl = snap.num_live
+    qi = np.arange(nq)
+    qw = (qi // 32).astype(np.int32)
+    qm = (1 << (qi % 32)).astype(np.uint32)
+    targets = np.full(B, nl, dtype=np.int32)
+    targets[:nq] = tg[i0:i1]
+    sdc = sd[i0:i1]
+
+    e1: tuple[list, list, list] = ([], [], [])
+    e2: tuple[list, list, list] = ([], [], [])
+    m_live = (sdc >= 0) & (sdc < nl)
+    if m_live.any():
+        e1[0].append(sdc[m_live])
+        e1[1].append(qw[m_live])
+        e1[2].append(qm[m_live])
+    m_stat = sdc >= nl
+    if m_stat.any():
+        rows, cnts = _csr_gather(snap.fwd_indptr, snap.fwd_indices, sdc[m_stat])
+        if rows.size:
+            e2[0].append(rows)
+            e2[1].append(np.repeat(qw[m_stat], cnts))
+            e2[2].append(np.repeat(qm[m_stat], cnts))
+    for i, (live, hop) in multi.items():
+        if not (i0 <= i < i1):
+            continue
+        w, m = qw[i - i0], qm[i - i0]
+        for (rows_l, words_l, masks_l), arr in ((e1, live), (e2, hop)):
+            if arr.size:
+                rows_l.append(arr)
+                words_l.append(np.full(arr.size, w, np.int32))
+                masks_l.append(np.full(arr.size, m, np.uint32))
+    if not e1[0] and not e2[0]:
+        return None
+    # padding row nl+1 is out of range for the [nl+1, W] bitmap → dropped
+    return _pad_entries(*e1, B, nl + 1) + _pad_entries(*e2, B, nl + 1) + (targets,)
 
 
 class TpuCheckEngine:
@@ -295,50 +365,112 @@ class TpuCheckEngine:
 
     # -- resolution ----------------------------------------------------------
 
-    def _resolve_ns(self, name: str) -> Optional[int]:
-        """Namespace name → id; "" wildcards (never resolved, like reference
-        relationtuples.go:230-235); unknown → None (denied)."""
-        if name == "":
-            return WILDCARD
-        try:
-            return self._nm().get_namespace_by_name(name).id
-        except ErrNamespaceUnknown:
-            return None
+    def _resolve_bulk(
+        self, snap: GraphSnapshot, tuples: Sequence[RelationTuple]
+    ) -> tuple[np.ndarray, np.ndarray, dict]:
+        """One tight host pass resolving every query to device rows.
 
-    def _resolve(
-        self, snap: GraphSnapshot, rt: RelationTuple
-    ) -> tuple[np.ndarray, int]:
-        """(start device ids, target device id); phantom target = n_nodes."""
-        miss = snap.n_nodes
-        none = np.zeros(0, np.int64)
-        ns_id = self._resolve_ns(rt.namespace)
-        if ns_id is None:
-            return none, miss  # unknown namespace → denied (engine.go:76-77)
-        starts = snap.resolve_starts(ns_id, rt.object, rt.relation)
-        if starts.size == 0:
-            return none, miss
-        if isinstance(rt.subject, SubjectID):
-            target = snap.resolve_leaf(rt.subject.id)
-        elif isinstance(rt.subject, SubjectSet):
-            sns_id = self._resolve_ns(rt.subject.namespace)
-            if sns_id is None:
-                return none, miss
-            if sns_id == WILDCARD:
-                # subjects are matched literally; an empty subject namespace
-                # can only equal a stored subject in a namespace named ""
-                wild = [i for i in snap.wild_ns_ids]
-                target = (
-                    snap.resolve_set(wild[0], rt.subject.object, rt.subject.relation)
-                    if wild
-                    else None
-                )
+        Returns ``(sd, tg, multi)``:
+
+        - ``sd[i]`` — the query's single start row: ``-1`` no start
+          (guaranteed deny: unknown namespace per engine.go:76-77, or no
+          matching node), ``-2`` multi-start (wildcard pattern, rows in
+          ``multi``), else a device id (live or static);
+        - ``tg[i]`` — target row, mapped to the all-zero row ``num_live``
+          when unreachable (static row, or no such node);
+        - ``multi`` — ``{i: (live start rows, deduplicated one-hop rows)}``
+          for wildcard-pattern queries.
+
+        The common case (literal query, SubjectID) costs two intern-table
+        lookups and two ``raw2dev`` reads — no numpy allocation.
+        """
+        n = len(tuples)
+        nl = snap.num_live
+        sd = np.full(n, -1, np.int64)
+        tg = np.full(n, nl, np.int64)
+        multi: dict = {}
+        interned = snap.interned
+        resolve_set = interned.resolve_set
+        resolve_leaf = interned.resolve_leaf
+        raw2dev = snap.raw2dev
+        num_sets = snap.num_sets
+        wild_ids = snap.wild_ns_ids
+        wild_list = list(wild_ids)
+        nm = self._nm()
+        ns_cache: dict = {}
+
+        def _ns(name: str):
+            hit = ns_cache.get(name, _UNSET)
+            if hit is not _UNSET:
+                return hit
+            if name == "":
+                r: object = WILDCARD
             else:
-                target = snap.resolve_set(sns_id, rt.subject.object, rt.subject.relation)
-        else:
-            return none, miss
-        if target is None:
-            return starts, miss  # live BFS, but the bit can never match
-        return starts, target
+                try:
+                    r = nm.get_namespace_by_name(name).id
+                except ErrNamespaceUnknown:
+                    r = None
+            ns_cache[name] = r
+            return r
+
+        for i, rt in enumerate(tuples):
+            ns_id = _ns(rt.namespace)
+            if ns_id is None:
+                continue  # unknown namespace → denied (engine.go:76-77)
+            obj, rel = rt.object, rt.relation
+            starts = None
+            if ns_id != WILDCARD and ns_id not in wild_ids and obj != "" and rel != "":
+                raw = resolve_set(ns_id, obj, rel)
+                if raw < 0:
+                    continue
+                start_dev = int(raw2dev[raw])
+            else:
+                starts = snap.resolve_starts(ns_id, obj, rel)
+                if starts.size == 0:
+                    continue
+                start_dev = -2
+
+            sub = rt.subject
+            t = -1
+            if type(sub) is SubjectID:
+                rawl = resolve_leaf(sub.id)
+                if rawl >= 0:
+                    t = int(raw2dev[rawl + num_sets])
+            elif isinstance(sub, SubjectSet):
+                sns_id = _ns(sub.namespace)
+                if sns_id is None:
+                    continue
+                if sns_id == WILDCARD:
+                    # subjects are matched literally; an empty subject
+                    # namespace can only equal a stored subject in a
+                    # namespace named ""
+                    rawt = (
+                        resolve_set(wild_list[0], sub.object, sub.relation)
+                        if wild_list
+                        else -1
+                    )
+                else:
+                    rawt = resolve_set(sns_id, sub.object, sub.relation)
+                if rawt >= 0:
+                    t = int(raw2dev[rawt])
+            else:
+                continue  # nil subject → denied
+            if 0 <= t < nl:
+                tg[i] = t
+            sd[i] = start_dev
+            if starts is not None:
+                live = starts[starts < nl]
+                static = starts[starts >= nl]
+                hop = np.zeros(0, np.int64)
+                if static.size:
+                    nbrs, _ = _csr_gather(snap.fwd_indptr, snap.fwd_indices, static)
+                    if nbrs.size:
+                        # cross-start dedup: two static starts of one query
+                        # may share an out-neighbor, and scatter-add bits
+                        # must stay disjoint per (row, query)
+                        hop = np.unique(nbrs).astype(np.int64)
+                multi[i] = (live, hop)
+        return sd, tg, multi
 
     # -- public API ----------------------------------------------------------
 
@@ -350,28 +482,38 @@ class TpuCheckEngine:
         # resolve on host first, then pack chunks so that the start-entry
         # array stays at its padded size B — chunk geometry (W, SP) is then
         # constant across calls and every chunk hits the same jit cache entry
-        resolved = [self._resolve(snap, rt) for rt in tuples]
+        sd, tg, multi = self._resolve_bulk(snap, tuples)
 
-        chunks: list[list[tuple[np.ndarray, int]]] = []
-        cur: list[tuple[np.ndarray, int]] = []
-        cur_entries = 0
+        # per-query device entry counts → greedy chunk boundaries bounded
+        # by both query count and scatter entries
+        n = len(tuples)
+        nl = snap.num_live
+        ip = snap.fwd_indptr
+        cnt = np.zeros(n, np.int64)
+        m_live = (sd >= 0) & (sd < nl)
+        cnt[m_live] = 1
+        m_stat = sd >= nl
+        if m_stat.any():
+            s = sd[m_stat]
+            cnt[m_stat] = ip[s + 1] - ip[s]
+        for i, (live, hop) in multi.items():
+            cnt[i] = live.size + hop.size
         cap = self._max_batch
-        for starts, t in resolved:
-            n = int(starts.size)
-            if cur and (len(cur) >= cap or cur_entries + n > cap):
-                chunks.append(cur)
-                cur, cur_entries = [], 0
-            cur.append((starts, t))
-            cur_entries += n
-        if cur:
-            chunks.append(cur)
+        csum = np.concatenate([np.zeros(1, np.int64), np.cumsum(cnt)])
+        bounds: list[tuple[int, int]] = []
+        i0 = 0
+        while i0 < n:
+            i1 = int(np.searchsorted(csum, csum[i0] + cap, side="right")) - 1
+            i1 = max(i0 + 1, min(i1, i0 + cap, n))
+            bounds.append((i0, i1))
+            i0 = i1
 
         # one multi-chunk request keeps a single kernel shape: every chunk
         # pads to the width fitting the largest one rather than compiling
         # narrower variants for tails
         force_W = None
-        if len(chunks) > 1:
-            biggest = max(len(c) for c in chunks)
+        if len(bounds) > 1:
+            biggest = max(b - a for a, b in bounds)
             force_W = next(w for w in _WORD_WIDTHS if 32 * w >= biggest)
 
         # dispatch every chunk asynchronously (windowed so in-flight bitmap
@@ -380,36 +522,57 @@ class TpuCheckEngine:
         # concurrent fetches overlap
         out: list[bool] = []
         max_iters = 0
-        for woff in range(0, len(chunks), self._dispatch_window):
-            wave = chunks[woff : woff + self._dispatch_window]
-            pending = [(self._device_batch(snap, c, force_W), len(c)) for c in wave]
+        any_truncated = False
+        for woff in range(0, len(bounds), self._dispatch_window):
+            wave = bounds[woff : woff + self._dispatch_window]
+            pending = [
+                (self._device_batch(snap, sd, tg, multi, a, b, force_W), b - a)
+                for a, b in wave
+            ]
             fetched = jax.device_get([d for d, _ in pending])
-            for (arr, iters), (_, nq) in zip(fetched, pending):
+            for (arr, iters, trunc), (_, nq) in zip(fetched, pending):
                 out.extend(bool(x) for x in arr[:nq])
                 max_iters = max(max_iters, int(iters))
+                any_truncated = any_truncated or bool(trunc)
         # adapt the pull-block size so the next batch converges within one
         # convergence observation (clamped to powers of two ≤ 32)
         self._block_iters = max(2, min(32, _ceil_pow2(max_iters + 1)))
+        if any_truncated:
+            # the reference terminates exactly via its visited set; hitting
+            # the cap means some deny decisions may come from a truncated
+            # frontier — surface it instead of failing silently
+            _log.warning(
+                "check BFS hit it_cap=%d before the fixpoint; deny decisions "
+                "in this batch may be incomplete (raise it_cap)", self._it_cap,
+            )
         return out
 
     def _device_batch(
         self,
         snap: GraphSnapshot,
-        resolved: list[tuple[np.ndarray, int]],
+        sd: np.ndarray,
+        tg: np.ndarray,
+        multi: dict,
+        i0: int,
+        i1: int,
         force_W: Optional[int] = None,
     ):
-        packed = pack_batch(snap, resolved, force_W)
+        packed = pack_chunk(snap, sd, tg, multi, i0, i1, force_W)
         if packed is None:
-            W = force_W or next(w for w in _WORD_WIDTHS if 32 * w >= len(resolved))
-            return np.zeros(32 * W, dtype=bool), np.int32(0)
-        rows, words, masks, targets = packed
+            W = force_W or next(w for w in _WORD_WIDTHS if 32 * w >= i1 - i0)
+            return np.zeros(32 * W, dtype=bool), np.int32(0), False
+        e1_rows, e1_words, e1_masks, e2_rows, e2_words, e2_masks, targets = packed
         return _check_kernel(
             snap.device_buckets,
-            jnp.asarray(rows),
-            jnp.asarray(words),
-            jnp.asarray(masks),
+            jnp.asarray(e1_rows),
+            jnp.asarray(e1_words),
+            jnp.asarray(e1_masks),
+            jnp.asarray(e2_rows),
+            jnp.asarray(e2_words),
+            jnp.asarray(e2_masks),
             jnp.asarray(targets),
-            n_nodes=snap.n_nodes,
+            n_active=snap.num_active,
+            n_live=snap.num_live,
             valid_rows=tuple(b.n for b in snap.buckets),
             it_cap=self._it_cap,
             block_iters=self._block_iters,

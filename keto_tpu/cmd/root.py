@@ -458,6 +458,8 @@ def status_cmd(block, read_remote, write_remote, write):
     """Query the gRPC health endpoint (reference cmd/status/root.go:22-117)."""
     from grpchealth.v1 import health_pb2
 
+    import grpc
+
     target = (
         client_pkg.write_remote(write_remote) if write else client_pkg.read_remote(read_remote)
     )
@@ -473,9 +475,16 @@ def status_cmd(block, read_remote, write_remote, write):
             if resp.status == health_pb2.HealthCheckResponse.SERVING:
                 click.echo("SERVING")
                 return
+        # a raw RpcError (server up but unhealthy / mid-start) must keep the
+        # --block watch alive, same as the dial failures surfaced as
+        # SystemExit (reference cmd/status/root.go:67-100 retries both)
         except SystemExit:
             if not block:
                 raise
+        except grpc.RpcError:
+            if not block:
+                click.echo("NOT_SERVING")
+                raise SystemExit(1)
         if not block:
             click.echo("NOT_SERVING")
             sys.exit(1)

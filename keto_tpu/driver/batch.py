@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Optional, Sequence
 
@@ -44,14 +45,28 @@ class CheckBatcher:
         if self._thread:
             self._thread.join(timeout=5)
             self._thread = None
+        # requests still queued would otherwise block their callers for the
+        # full future timeout — fail them promptly instead
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None and not item[1].done():
+                item[1].set_exception(RuntimeError("check batcher stopped"))
 
     # -- API -----------------------------------------------------------------
 
     def check(self, tuple_: RelationTuple, timeout: Optional[float] = 30.0) -> bool:
         """Blocking single check, transparently batched with concurrent
         callers."""
+        if self._stop.is_set():
+            raise RuntimeError("check batcher stopped")
         fut: Future = Future()
         self._queue.put((tuple_, fut))
+        if self._stop.is_set() and not fut.done():
+            # raced with stop()'s drain: nobody will serve the queue anymore
+            fut.set_exception(RuntimeError("check batcher stopped"))
         return fut.result(timeout=timeout)
 
     def check_batch(self, tuples: Sequence[RelationTuple]) -> list[bool]:
@@ -66,21 +81,21 @@ class CheckBatcher:
             if item is None:
                 continue
             batch = [item]
-            deadline = threading.Event()
-            # drain whatever arrives within the window, up to batch_size
-            timer = threading.Timer(self._window_s, deadline.set)
-            timer.start()
-            try:
-                while len(batch) < self._batch_size and not deadline.is_set():
-                    try:
-                        nxt = self._queue.get(timeout=self._window_s / 10)
-                    except queue.Empty:
-                        continue
-                    if nxt is None:
-                        break
-                    batch.append(nxt)
-            finally:
-                timer.cancel()
+            # drain whatever arrives within the window, up to batch_size —
+            # each wait blocks on the queue's condition for exactly the
+            # remaining window, no polling
+            deadline = time.monotonic() + self._window_s
+            while len(batch) < self._batch_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    break
+                batch.append(nxt)
 
             tuples = [t for t, _ in batch]
             try:

@@ -6,11 +6,27 @@ its *in*-neighbors. A pull step is gather-only — TPUs gather well but
 serialize scatters with colliding indices, so the layout makes the inner
 loop pure gathers + OR-reductions:
 
-- nodes are **renumbered** so nodes with similar in-degree are contiguous
-  ("device ids"), grouped into power-of-two degree buckets;
-- each bucket stores a dense ``[rows, degree]`` int32 matrix of in-neighbor
-  device ids (ELL format), padded with a sentinel id ``n_nodes`` that points
-  at a phantom all-zero bitmap row;
+- nodes are **renumbered** ("device ids") into three classes, sorted in
+  this order:
+
+  * **active** — has at least one in-edge whose source itself has in-edges
+    (a "live" source). Only active rows can change after the first BFS
+    step, so the iterative pull reads and writes just this prefix.
+  * **passive** — has in-edges, but only from zero-in-degree ("static")
+    sources. Their reached-bitmap is constant after initialization: the
+    start bits propagated one hop from static sources (computed on host
+    per batch, see tpu_engine.pack_chunk).
+  * **static** — no in-edges. Never materialized on device at all; their
+    only effect is the one-hop propagation above.
+
+- active nodes are grouped into power-of-two **live-in-degree** buckets;
+  each bucket stores a dense ``[rows, degree]`` int32 matrix of *live*
+  in-neighbor device ids (ELL format), padded with sentinel ``n_live``
+  that points at an all-zero bitmap row. Edges from static sources are
+  excluded — the bitmap the kernel iterates is ``[n_live+1, W]``, not
+  ``[n_nodes+1, W]``, and each pull gathers only live→live edges (often a
+  small fraction of the graph: e.g. per-document grant edges all originate
+  at zero-in-degree document nodes);
 - bucket row counts are padded to powers of two so a snapshot rebuild after
   tuple writes usually keeps the same array shapes and hits the jit cache.
 
@@ -42,9 +58,9 @@ def _ceil_pow2(x: int) -> int:
 
 @dataclass
 class Bucket:
-    """One in-degree bucket: ``nbrs[i, j]`` is the device id of the j-th
-    in-neighbor of device node ``offset + i`` (sentinel ``n_nodes`` when
-    padding)."""
+    """One live-in-degree bucket: ``nbrs[i, j]`` is the device id of the
+    j-th live in-neighbor of device node ``offset + i`` (sentinel
+    ``num_live`` — the all-zero bitmap row — when padding)."""
 
     offset: int  # device id of the first row
     n: int  # valid rows (bucket membership)
@@ -63,6 +79,11 @@ class GraphSnapshot:
     snapshot_id: int
     num_sets: int
     num_leaves: int
+    #: device ids < num_active are iterated by the BFS loop
+    num_active: int
+    #: device ids < num_live have in-edges (active + passive); the device
+    #: bitmap has num_live+1 rows (last row all-zero)
+    num_live: int
     buckets: list[Bucket]
     # string→raw-id resolution: an InternedGraph (Python dicts) or a
     # NativeInterned (resident C++ tables) — same interface either way
@@ -154,6 +175,8 @@ def build_snapshot(
             snapshot_id=watermark,
             num_sets=0,
             num_leaves=0,
+            num_active=0,
+            num_live=0,
             buckets=[],
             interned=g,
             raw2dev=np.zeros(0, np.int64),
@@ -163,53 +186,69 @@ def build_snapshot(
         )
 
     in_deg = np.bincount(dst_raw, minlength=n)
-    # bucket key: ceil-log2(degree) + 1; nodes without in-edges sort LAST
-    # (key 63) — their bitmap rows never change, so the kernel iterates only
-    # the prefix of rows that can (see tpu_engine.check_step)
+    has_in = in_deg > 0
+    # live edges: source itself has in-edges, so its bitmap row can change
+    # during BFS. Edges from static (zero-in-degree) sources contribute a
+    # constant one-hop term handled at batch setup (tpu_engine.pack_chunk),
+    # so only live edges are materialized on device.
+    live_edge = has_in[src_raw]
+    live_in_deg = np.bincount(dst_raw[live_edge], minlength=n)
+
+    # bucket key: ceil-log2(live in-degree) + 1 for active rows; passive
+    # rows (in-edges only from static sources) sort after them (key 62),
+    # static rows last (key 63)
     with np.errstate(divide="ignore"):
-        bucket_key = np.where(
-            in_deg == 0, 63, np.ceil(np.log2(np.maximum(in_deg, 1))).astype(np.int64) + 1
-        )
-    bucket_key[in_deg == 1] = 1
+        bucket_key = np.ceil(np.log2(np.maximum(live_in_deg, 1))).astype(np.int64) + 1
+    bucket_key[live_in_deg == 1] = 1
+    bucket_key[(live_in_deg == 0) & has_in] = 62
+    bucket_key[~has_in] = 63
 
     # renumber: device order sorts by (bucket, raw id); raw2dev inverts it
     dev_order = np.lexsort((np.arange(n), bucket_key))
     raw2dev = np.empty(n, dtype=np.int64)
     raw2dev[dev_order] = np.arange(n)
 
-    # group edges by destination device id; cumcount gives the column slot
-    dst_dev = raw2dev[dst_raw]
-    src_dev = raw2dev[src_raw]
+    num_active = int(np.count_nonzero(bucket_key < 62))
+    num_live = int(np.count_nonzero(has_in))
+
+    # group live edges by destination device id; cumcount gives the column
+    # slot. Destinations of live edges are active rows by construction.
+    dst_dev = raw2dev[dst_raw[live_edge]]
+    src_dev = raw2dev[src_raw[live_edge]]
     order = np.argsort(dst_dev, kind="stable")
     dst_sorted = dst_dev[order]
     src_sorted = src_dev[order].astype(np.int32)
-    starts = np.searchsorted(dst_sorted, np.arange(n))
+    starts = np.searchsorted(dst_sorted, np.arange(num_active))
     cumcount = np.arange(dst_sorted.shape[0]) - starts[dst_sorted]
 
-    key_by_dev = bucket_key[dev_order]
+    key_by_dev = bucket_key[dev_order][:num_active]
     buckets: list[Bucket] = []
-    sentinel = np.int32(n)
+    sentinel = np.int32(num_live)  # the bitmap's all-zero row
     for key in np.unique(key_by_dev):
         members = np.nonzero(key_by_dev == key)[0]  # contiguous by construction
         offset, n_rows = int(members[0]), int(members.shape[0])
-        cap = 0 if key == 63 else 1 << (int(key) - 1)
+        cap = 1 << (int(key) - 1)
         n_pad = _ceil_pow2(n_rows)
         nbrs = np.full((n_pad, cap), sentinel, dtype=np.int32)
-        if cap:
-            edge_mask = (dst_sorted >= offset) & (dst_sorted < offset + n_rows)
-            nbrs[dst_sorted[edge_mask] - offset, cumcount[edge_mask]] = src_sorted[edge_mask]
+        edge_mask = (dst_sorted >= offset) & (dst_sorted < offset + n_rows)
+        nbrs[dst_sorted[edge_mask] - offset, cumcount[edge_mask]] = src_sorted[edge_mask]
         buckets.append(Bucket(offset=offset, n=n_rows, nbrs=nbrs))
 
-    # host-side forward CSR (device ids), for expand assist & introspection
-    forder = np.argsort(src_dev, kind="stable")
-    fsrc = src_dev[forder]
-    findices = dst_dev[forder].astype(np.int32)
+    # host-side forward CSR over ALL edges (device ids) — used by expand
+    # and by the batch-setup one-hop propagation from static start nodes
+    all_src_dev = raw2dev[src_raw]
+    all_dst_dev = raw2dev[dst_raw]
+    forder = np.argsort(all_src_dev, kind="stable")
+    fsrc = all_src_dev[forder]
+    findices = all_dst_dev[forder].astype(np.int32)
     findptr = np.searchsorted(fsrc, np.arange(n + 1))
 
     return GraphSnapshot(
         snapshot_id=watermark,
         num_sets=g.num_sets,
         num_leaves=g.num_leaves,
+        num_active=num_active,
+        num_live=num_live,
         buckets=buckets,
         interned=g,
         raw2dev=raw2dev,

@@ -126,13 +126,18 @@ class SQLitePersister(Manager):
         network_id: str = "default",
         auto_migrate: bool = True,
         _conn: Optional[sqlite3.Connection] = None,
+        _lock: Optional[threading.RLock] = None,
     ):
         if isinstance(namespace_manager_source, namespace_pkg.Manager):
             self._nm = lambda: namespace_manager_source
         else:
             self._nm = namespace_manager_source
         self.network_id = network_id
-        self._lock = threading.RLock()
+        # views created by with_network share the parent's connection AND
+        # lock, so transactions from different network scopes serialize on
+        # one connection instead of interleaving BEGINs
+        self._lock = _lock or threading.RLock()
+        self._owns_conn = _conn is None
         self._conn = _conn or sqlite3.connect(
             _path_from_dsn(dsn), check_same_thread=False, isolation_level=None
         )
@@ -149,12 +154,15 @@ class SQLitePersister(Manager):
         """Second view over the same database bound to another network id
         (reference internal/relationtuple/manager_isolation.go:39-116)."""
         return SQLitePersister(
-            self._dsn, self._nm, network_id, auto_migrate=False, _conn=self._conn
+            self._dsn, self._nm, network_id,
+            auto_migrate=False, _conn=self._conn, _lock=self._lock,
         )
 
     def close(self) -> None:
-        with self._lock:
-            self._conn.close()
+        # derived views never close the shared connection
+        if self._owns_conn:
+            with self._lock:
+                self._conn.close()
 
     # -- migrations ----------------------------------------------------------
 
@@ -298,33 +306,51 @@ class SQLitePersister(Manager):
             del_rows = [self._row_values(rt) for rt in delete]
             self._conn.execute("BEGIN")
             try:
-                for values in ins_rows:
-                    self._conn.execute(
-                        "INSERT INTO keto_relation_tuples (shard_id, nid, namespace_id, object, "
-                        "relation, subject_id, subject_set_namespace_id, subject_set_object, "
-                        "subject_set_relation, commit_time) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, "
-                        "(SELECT COALESCE(MAX(commit_time), 0) + 1 FROM keto_relation_tuples))",
-                        (str(uuid.uuid4()), self.network_id) + values,
+                # commit_time is the per-network watermark + 1: O(1) to
+                # obtain (vs. a MAX() scan per row), monotone across
+                # transactions, constant within one (like the reference's
+                # commit_time=now(), relationtuples.go:128-149)
+                row = self._conn.execute(
+                    "SELECT watermark FROM keto_watermarks WHERE nid = ?",
+                    (self.network_id,),
+                ).fetchone()
+                commit_time = (row[0] if row else 0) + 1
+                changed = bool(ins_rows)
+                if ins_rows:
+                    shard_ids = uuid.uuid4().hex
+                    self._conn.executemany(
+                        "INSERT INTO keto_relation_tuples (shard_id, nid, namespace_id, "
+                        "object, relation, subject_id, subject_set_namespace_id, "
+                        "subject_set_object, subject_set_relation, commit_time) "
+                        "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                        [
+                            (f"{shard_ids}-{i}", self.network_id) + values + (commit_time,)
+                            for i, values in enumerate(ins_rows)
+                        ],
                     )
-                for values in del_rows:
-                    null_safe = [
+                if del_rows:
+                    null_safe = " AND ".join(
                         f"{col} IS ?" for col in (
                             "subject_id",
                             "subject_set_namespace_id",
                             "subject_set_object",
                             "subject_set_relation",
                         )
-                    ]
-                    self._conn.execute(
-                        "DELETE FROM keto_relation_tuples WHERE nid = ? AND namespace_id = ? "
-                        "AND object = ? AND relation = ? AND " + " AND ".join(null_safe),
-                        (self.network_id,) + values[:3] + values[3:],
                     )
-                self._conn.execute(
-                    "INSERT INTO keto_watermarks (nid, watermark) VALUES (?, 1) "
-                    "ON CONFLICT(nid) DO UPDATE SET watermark = watermark + 1",
-                    (self.network_id,),
-                )
+                    cur = self._conn.executemany(
+                        "DELETE FROM keto_relation_tuples WHERE nid = ? AND namespace_id = ? "
+                        "AND object = ? AND relation = ? AND " + null_safe,
+                        [(self.network_id,) + values for values in del_rows],
+                    )
+                    changed = changed or cur.rowcount > 0
+                if changed:
+                    # bump only when the data actually moved, so the device
+                    # snapshot is not rebuilt for no-op transactions
+                    self._conn.execute(
+                        "INSERT INTO keto_watermarks (nid, watermark) VALUES (?, 1) "
+                        "ON CONFLICT(nid) DO UPDATE SET watermark = watermark + 1",
+                        (self.network_id,),
+                    )
                 self._conn.execute("COMMIT")
             except Exception:
                 self._conn.execute("ROLLBACK")

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Optional
 
 _H2_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
@@ -40,13 +41,19 @@ def _pump(src: socket.socket, dst: socket.socket) -> None:
 class PortMux:
     """Front listener splicing connections to REST / gRPC loopback backends."""
 
-    def __init__(self, host: str, port: int, rest_port: int, grpc_port: int):
+    def __init__(
+        self, host: str, port: int, rest_port: int, grpc_port: int,
+        max_connections: int = 256,
+    ):
         self._listener = socket.create_server((host or "0.0.0.0", port), reuse_port=False)
         self._listener.settimeout(0.5)
         self.rest_port = rest_port
         self.grpc_port = grpc_port
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # bounds splice threads (2 per connection): beyond the cap, accepts
+        # wait briefly then shed load instead of spawning without limit
+        self._slots = threading.BoundedSemaphore(max_connections)
 
     @property
     def port(self) -> int:
@@ -70,25 +77,49 @@ class PortMux:
                 continue
             except OSError:
                 return
-            threading.Thread(target=self._splice, args=(conn,), daemon=True).start()
+            if not self._slots.acquire(timeout=5):
+                conn.close()  # at capacity: shed rather than queue unboundedly
+                continue
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            self._splice(conn)
+        finally:
+            self._slots.release()
 
     def _splice(self, conn: socket.socket) -> None:
         try:
-            conn.settimeout(10)
-            # peek until the method token is unambiguous ("PRI " = HTTP/2
-            # client preface = gRPC; anything else = HTTP/1 REST)
+            # read (not peek) until the method token is unambiguous
+            # ("PRI " = HTTP/2 client preface = gRPC; anything else =
+            # HTTP/1 REST) — blocking reads under a deadline (not select():
+            # fds ≥ FD_SETSIZE would raise); the consumed prefix is
+            # replayed to the backend before splicing
             head = b""
+            deadline = time.monotonic() + 10
             while len(head) < 4:
-                head = conn.recv(4, socket.MSG_PEEK)
-                if not head:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
                     conn.close()
                     return
+                conn.settimeout(remaining)
+                try:
+                    data = conn.recv(4 - len(head))
+                except socket.timeout:
+                    conn.close()
+                    return
+                if not data:
+                    conn.close()
+                    return
+                head += data
             conn.settimeout(None)
             backend_port = self.grpc_port if head == b"PRI " else self.rest_port
             backend = socket.create_connection(("127.0.0.1", backend_port))
+            backend.sendall(head)
         except OSError:
             conn.close()
             return
         t = threading.Thread(target=_pump, args=(conn, backend), daemon=True)
         t.start()
         _pump(backend, conn)
+        t.join()
