@@ -63,6 +63,10 @@ _log = logging.getLogger("keto_tpu.check")
 
 #: distinct-from-None cache sentinel for namespace resolution
 _UNSET = object()
+#: wildcard-namespace marker in the native resolve cache
+_WILD = object()
+#: native-format record whose result is overwritten on the Python side
+_PLACEHOLDER = b"0\x1f\x1f\x1f1\x1f\x1f\x1f\x1e"
 
 # batch widths (in 32-query words) the engine compiles for; a request is
 # padded up to the smallest fitting width so jit caches stay small
@@ -94,21 +98,23 @@ def _pull(
 
 def check_step(
     bucket_nbrs: tuple[jnp.ndarray, ...],
-    e1_rows: jnp.ndarray,  # int32[S1] live start rows (padding → n_live+1)
+    e1_rows: jnp.ndarray,  # int32[S1] interior start rows (padding → n_int+1)
     e1_words: jnp.ndarray,  # int32[S1] query word index
     e1_masks: jnp.ndarray,  # uint32[S1] query bit mask (padding → 0)
-    e2_rows: jnp.ndarray,  # int32[S2] one-hop rows from static starts
+    e2_rows: jnp.ndarray,  # int32[S2] one-hop interior rows from static starts
     e2_words: jnp.ndarray,  # int32[S2]
     e2_masks: jnp.ndarray,  # uint32[S2]
-    targets: jnp.ndarray,  # int32[B], n_live = unresolved/unreachable
+    a_rows: jnp.ndarray,  # int32[SA] interior in-neighbors of sink targets
+    a_q: jnp.ndarray,  # int32[SA] owning query index (padding → 0 w/ row n_int)
+    targets: jnp.ndarray,  # int32[B] interior target rows, n_int = none
     *,
     n_active: int,
-    n_live: int,
+    n_int: int,
     valid_rows: tuple[int, ...],
     it_cap: int,
     block_iters: int = 8,
     bitmap_sharding=None,  # NamedSharding for the [rows, words] bitmaps
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     B = targets.shape[0]
     W = B // 32
     q = jnp.arange(B)
@@ -117,10 +123,10 @@ def check_step(
     # per (row, word) slot, masks from distinct queries occupy distinct bits
     # and per-query row lists are deduplicated on host, so scatter-add
     # never carries — add on disjoint bits is bitwise OR
-    zero = jnp.zeros((n_live + 1, W), jnp.uint32)
+    zero = jnp.zeros((n_int + 1, W), jnp.uint32)
     # the one-hop term: start bits of static (zero-in-degree) nodes
-    # propagated to their out-neighbors on host. These bits are "reached
-    # via ≥ 1 edge" by construction, so they feed both R0 and the answer.
+    # propagated to their interior out-neighbors on host. These bits are
+    # "reached via ≥ 1 edge" by construction, so they feed R0 and answers.
     ans_base = zero.at[e2_rows, e2_words].add(e2_masks, mode="drop")
     R0 = zero.at[e1_rows, e1_words].add(e1_masks, mode="drop") | ans_base
     if bitmap_sharding is not None:
@@ -131,47 +137,61 @@ def check_step(
         ans_base = lax.with_sharding_constraint(ans_base, bitmap_sharding)
 
     if n_active == 0 or not bucket_nbrs:
-        # no live→live edges: every answer is already in the one-hop term
-        a = ans_base[targets, words]
-        return (a >> bits) & jnp.uint32(1) == 1, jnp.int32(0), jnp.bool_(False)
+        # no interior→interior edges: the fixpoint is R0 itself
+        R_fix = R0
+        pull_p = jnp.zeros((n_active + 1, W), jnp.uint32)
+        iters = jnp.int32(0)
+        truncated = jnp.bool_(False)
+    else:
+        # Only the active prefix R[:n_active] can change; the in-place .set
+        # on the while-loop carry aliases, so passive rows are never copied.
+        def step(st):
+            R, _, _, it = st
+            p = _pull(bucket_nbrs, valid_rows, R)
+            act = R[:n_active]
+            nxt = lax.bitwise_or(p, act)
+            return R.at[:n_active].set(nxt), p, jnp.any(nxt != act), it + 1
 
-    # Only the active prefix R[:n_active] can change; the in-place .set on
-    # the while-loop carry aliases, so passive rows are never copied.
-    def step(st):
-        R, _, _, it = st
-        p = _pull(bucket_nbrs, valid_rows, R)
-        act = R[:n_active]
-        nxt = lax.bitwise_or(p, act)
-        return R.at[:n_active].set(nxt), p, jnp.any(nxt != act), it + 1
+        # The while cond is the only point the runtime must observe a device
+        # value, which costs a full round trip on tunneled devices — so each
+        # while iteration runs a *block* of pulls, each skipped via lax.cond
+        # once the fixpoint is reached (monotone bitmaps: converged stays
+        # converged). Steady state: one observation per batch.
+        def block(st):
+            return lax.fori_loop(
+                0, block_iters, lambda _, s: lax.cond(s[2], step, lambda x: x, s), st
+            )
 
-    # The while cond is the only point the runtime must observe a device
-    # value, which costs a full round trip on tunneled devices — so each
-    # while iteration runs a *block* of pulls, each skipped via lax.cond
-    # once the fixpoint is reached (monotone bitmaps: converged stays
-    # converged). Steady state: one observation per batch.
-    def block(st):
-        return lax.fori_loop(
-            0, block_iters, lambda _, s: lax.cond(s[2], step, lambda x: x, s), st
+        # p0 is shape-placeholder only: changed=True guarantees ≥ 1 real step
+        p0 = R0[:n_active]
+        R_fix, p_fix, truncated, iters = lax.while_loop(
+            lambda st: st[2] & (st[3] < it_cap),
+            block,
+            (R0, p0, jnp.bool_(True), jnp.int32(0)),
         )
+        pull_p = jnp.concatenate([p_fix, jnp.zeros((1, W), jnp.uint32)], axis=0)
 
-    # p0 is shape-placeholder only: changed=True guarantees ≥ 1 real step
-    p0 = R0[:n_active]
-    _, p_fix, truncated, iters = lax.while_loop(
-        lambda st: st[2] & (st[3] < it_cap),
-        block,
-        (R0, p0, jnp.bool_(True), jnp.int32(0)),
-    )
-
-    # answers require "reached via ≥ 1 edge": the pull of the fixpoint —
+    # interior targets: "reached via ≥ 1 edge" = the pull of the fixpoint —
     # already computed by the converging iteration and carried out of the
-    # loop — plus the one-hop term. Passive/unresolved targets read row
-    # n_active of the padded pull (all-zero) and row ≤ n_live of ans_base.
-    pull_p = jnp.concatenate([p_fix, jnp.zeros((1, W), jnp.uint32)], axis=0)
+    # loop — plus the one-hop term. Passive/absent targets read the padded
+    # all-zero rows.
     t_act = jnp.where(targets < n_active, targets, n_active)
     a = pull_p[t_act, words] | ans_base[targets, words]
+    hit = (a >> bits) & jnp.uint32(1)
+
+    # sink targets: gather each entry's (interior in-neighbor row, query
+    # word) from the fixpoint — start bits of the neighbor DO count here
+    # (the neighbor is not the target) — and scatter-OR per query.
+    # Collisions only combine entries of distinct (row, query) pairs: max
+    # on {0,1} is exact.
+    aw = a_q // 32
+    ab = (a_q % 32).astype(jnp.uint32)
+    vals = (R_fix[a_rows, aw] >> ab) & jnp.uint32(1)
+    hit = hit.at[a_q].max(vals)
+
     # truncated: the loop stopped on the iteration cap while the frontier
     # was still growing — converging in exactly it_cap steps is NOT truncation
-    return (a >> bits) & jnp.uint32(1) == 1, iters, truncated
+    return hit == 1, iters, truncated
 
 
 #: jitted entrypoint used by the engine; ``check_step`` stays un-jitted for
@@ -179,7 +199,7 @@ def check_step(
 _check_kernel = partial(
     jax.jit,
     static_argnames=(
-        "n_active", "n_live", "valid_rows", "it_cap", "block_iters", "bitmap_sharding"
+        "n_active", "n_int", "valid_rows", "it_cap", "block_iters", "bitmap_sharding"
     ),
 )(check_step)
 
@@ -234,49 +254,104 @@ def pack_chunk(
 
     ``sd``/``tg``/``multi`` come from ``TpuCheckEngine._resolve_bulk``.
     Single static starts are propagated one hop here via the forward CSR
-    (out-neighbor lists are duplicate-free: both interners dedup edges).
-    Returns ``(e1_rows, e1_words, e1_masks, e2_rows, e2_words, e2_masks,
-    targets)`` numpy arrays, or None when no query has any entry (the whole
-    chunk is a guaranteed deny).
+    (out-neighbor lists are duplicate-free: both interners dedup edges);
+    hops landing on interior rows become device seeds, hops landing
+    directly on the query's sink target are answered on host. Sink targets
+    get answer-gather entries from the snapshot's sink reverse CSR.
+
+    Returns ``(packed, host_ans)`` where ``packed`` is ``(e1_rows,
+    e1_words, e1_masks, e2_rows, e2_words, e2_masks, a_rows, a_q,
+    targets)`` numpy arrays (None when no query has any device entry) and
+    ``host_ans`` is a bool[nq] of host-decided grants to OR into the
+    device answers.
     """
     nq = i1 - i0
     W = force_W or next(w for w in _WORD_WIDTHS if 32 * w >= nq)
     B = 32 * W
+    ni = snap.num_int
     nl = snap.num_live
     qi = np.arange(nq)
     qw = (qi // 32).astype(np.int32)
     qm = (1 << (qi % 32)).astype(np.uint32)
-    targets = np.full(B, nl, dtype=np.int32)
-    targets[:nq] = tg[i0:i1]
+    tgc = tg[i0:i1]
     sdc = sd[i0:i1]
+    host_ans = np.zeros(nq, dtype=bool)
+    targets = np.full(B, ni, dtype=np.int32)
+    targets[:nq] = np.where(tgc < ni, tgc, ni)
 
     e1: tuple[list, list, list] = ([], [], [])
     e2: tuple[list, list, list] = ([], [], [])
-    m_live = (sdc >= 0) & (sdc < nl)
-    if m_live.any():
-        e1[0].append(sdc[m_live])
-        e1[1].append(qw[m_live])
-        e1[2].append(qm[m_live])
+    m_int = (sdc >= 0) & (sdc < ni)
+    if m_int.any():
+        e1[0].append(sdc[m_int])
+        e1[1].append(qw[m_int])
+        e1[2].append(qm[m_int])
+    # sink starts (ni ≤ sd < nl) have no out-edges: nothing to seed
     m_stat = sdc >= nl
     if m_stat.any():
         rows, cnts = _csr_gather(snap.fwd_indptr, snap.fwd_indices, sdc[m_stat])
         if rows.size:
-            e2[0].append(rows)
-            e2[1].append(np.repeat(qw[m_stat], cnts))
-            e2[2].append(np.repeat(qm[m_stat], cnts))
+            gq = np.repeat(qi[m_stat], cnts)
+            m_hop_int = rows < ni
+            if m_hop_int.any():
+                e2[0].append(rows[m_hop_int])
+                e2[1].append(qw[gq[m_hop_int]])
+                e2[2].append(qm[gq[m_hop_int]])
+            # one hop straight onto the query's sink target: decided here
+            m_hop_sink = ~m_hop_int
+            if m_hop_sink.any():
+                gq_s = gq[m_hop_sink]
+                host_ans[gq_s[rows[m_hop_sink] == tgc[gq_s]]] = True
     for i, (live, hop) in multi.items():
         if not (i0 <= i < i1):
             continue
-        w, m = qw[i - i0], qm[i - i0]
-        for (rows_l, words_l, masks_l), arr in ((e1, live), (e2, hop)):
-            if arr.size:
-                rows_l.append(arr)
-                words_l.append(np.full(arr.size, w, np.int32))
-                masks_l.append(np.full(arr.size, m, np.uint32))
+        li = i - i0
+        w, m = qw[li], qm[li]
+        if live.size:
+            e1[0].append(live)
+            e1[1].append(np.full(live.size, w, np.int32))
+            e1[2].append(np.full(live.size, m, np.uint32))
+        if hop.size:
+            h_int = hop[hop < ni]
+            if h_int.size:
+                e2[0].append(h_int)
+                e2[1].append(np.full(h_int.size, w, np.int32))
+                e2[2].append(np.full(h_int.size, m, np.uint32))
+            if ni <= tgc[li] < nl and (hop == tgc[li]).any():
+                host_ans[li] = True
+
+    # answer-gather entries for sink targets of queries that have any start
+    has_start = (sdc >= 0) & (sdc < ni) | (sdc >= nl)
+    for i in multi:
+        if i0 <= i < i1:
+            has_start[i - i0] = multi[i][0].size > 0 or multi[i][1].size > 0
+    ans: tuple[list, list] = ([], [])
+    m_ans = has_start & (tgc >= ni) & (tgc < nl)
+    if m_ans.any():
+        rows, cnts = _csr_gather(snap.sink_indptr, snap.sink_indices, tgc[m_ans] - ni)
+        if rows.size:
+            ans[0].append(rows)
+            ans[1].append(np.repeat(qi[m_ans], cnts).astype(np.int32))
+
     if not e1[0] and not e2[0]:
-        return None
-    # padding row nl+1 is out of range for the [nl+1, W] bitmap → dropped
-    return _pad_entries(*e1, B, nl + 1) + _pad_entries(*e2, B, nl + 1) + (targets,)
+        return None, host_ans
+    if ans[0]:
+        a_rows = np.concatenate(ans[0]).astype(np.int32)
+        a_q = np.concatenate(ans[1])
+    else:
+        a_rows = np.zeros(0, np.int32)
+        a_q = np.zeros(0, np.int32)
+    sp = B if a_rows.size <= B else max(_ceil_pow2(a_rows.size), 32 * _WORD_WIDTHS[-1])
+    pad = sp - a_rows.size
+    # answer padding: in-range all-zero row ni with query 0 — max(0) is a no-op
+    a_rows = np.concatenate([a_rows, np.full(pad, ni, np.int32)])
+    a_q = np.concatenate([a_q, np.zeros(pad, np.int32)])
+    # seed padding row ni+1 is out of range for the [ni+1, W] bitmap → dropped
+    return (
+        _pad_entries(*e1, B, ni + 1) + _pad_entries(*e2, B, ni + 1)
+        + (a_rows, a_q, targets),
+        host_ans,
+    )
 
 
 class TpuCheckEngine:
@@ -366,6 +441,119 @@ class TpuCheckEngine:
     # -- resolution ----------------------------------------------------------
 
     def _resolve_bulk(
+        self, snap: GraphSnapshot, tuples: Sequence[RelationTuple]
+    ) -> tuple[np.ndarray, np.ndarray, dict]:
+        """Resolve every query to device rows (see ``_resolve_bulk_py`` for
+        the result contract). Literal queries go through the C++ intern
+        tables in one bulk call when the native library provides it;
+        wildcard/pattern/unknown-namespace queries and the pure-Python
+        interner use the host loop."""
+        if hasattr(snap.interned, "resolve_queries"):
+            got = self._resolve_bulk_native(snap, tuples)
+            if got is not None:
+                return got
+        return self._resolve_bulk_py(snap, tuples)
+
+    def _resolve_bulk_native(
+        self, snap: GraphSnapshot, tuples: Sequence[RelationTuple]
+    ):
+        """Pack literal queries into the native wire format and resolve them
+        in one C++ pass; route the rest through the per-query Python path.
+        Returns None when the buffer framing is unsafe (separator bytes in
+        strings) — callers fall back to the pure host loop."""
+        n = len(tuples)
+        nl = snap.num_live
+        wild_ids = snap.wild_ns_ids
+        nm = self._nm()
+        ns_cache: dict = {}
+
+        def _ns_bytes(name: str):
+            """namespace name → decimal-ASCII id bytes, _WILD, or None."""
+            hit = ns_cache.get(name, _UNSET)
+            if hit is not _UNSET:
+                return hit
+            if name == "":
+                r: object = _WILD
+            else:
+                try:
+                    ns_id = nm.get_namespace_by_name(name).id
+                    r = _WILD if ns_id in wild_ids else b"%d" % ns_id
+                except ErrNamespaceUnknown:
+                    r = None
+            ns_cache[name] = r
+            return r
+
+        parts: list[bytes] = []
+        ap = parts.append
+        special: list[int] = []
+        dead: list[int] = []  # guaranteed denies; placeholder results ignored
+        for i, rt in enumerate(tuples):
+            ns = _ns_bytes(rt.namespace)
+            if ns is None:
+                dead.append(i)  # unknown namespace → denied
+                ap(_PLACEHOLDER)
+                continue
+            obj, rel = rt.object, rt.relation
+            if ns is _WILD or obj == "" or rel == "":
+                special.append(i)  # wildcard pattern → host resolver
+                ap(_PLACEHOLDER)
+                continue
+            sub = rt.subject
+            if type(sub) is SubjectID:
+                ap(b"%b\x1f%b\x1f%b\x1f1\x1f%b\x1f\x1f\x1e"
+                   % (ns, obj.encode(), rel.encode(), sub.id.encode()))
+            elif isinstance(sub, SubjectSet):
+                sns = _ns_bytes(sub.namespace)
+                if sns is None:
+                    dead.append(i)  # unknown subject namespace → denied
+                    ap(_PLACEHOLDER)
+                    continue
+                if sns is _WILD:
+                    special.append(i)  # wildcard subject namespace
+                    ap(_PLACEHOLDER)
+                    continue
+                ap(b"%b\x1f%b\x1f%b\x1f0\x1f%b\x1f%b\x1f%b\x1e"
+                   % (ns, obj.encode(), rel.encode(), sns,
+                      sub.object.encode(), sub.relation.encode()))
+            else:
+                dead.append(i)  # nil subject → denied
+                ap(_PLACEHOLDER)
+        buf = b"".join(parts)
+        # separator bytes inside strings corrupt framing — detectable as a
+        # field-count mismatch, same check as the ingest path
+        if buf.count(b"\x1f") != 6 * n or buf.count(b"\x1e") != n:
+            return None
+        got = snap.interned.resolve_queries(buf, n)
+        if got is None:
+            return None
+        start_raw, sub_raw = got
+        r2d = snap.raw2dev
+        sd = np.where(start_raw >= 0, r2d[np.clip(start_raw, 0, None)], -1)
+        t = r2d[np.clip(sub_raw, 0, None)]
+        # a target only matters when the query has starts (matches the host
+        # loop, which leaves tg at the unreachable row for start-less denies)
+        tg = np.where((sub_raw >= 0) & (t < nl) & (sd >= 0), t, nl)
+        if dead:
+            # placeholder records may coincide with real nodes — force deny
+            di = np.asarray(dead)
+            sd[di] = -1
+            tg[di] = nl
+        multi: dict = {}
+        if special:
+            self._resolve_specials(snap, tuples, special, sd, tg, multi)
+        return sd, tg, multi
+
+    def _resolve_specials(self, snap, tuples, indices, sd, tg, multi):
+        """Pattern/wildcard queries: reuse the Python resolver per query and
+        splice its results into the bulk arrays."""
+        for i in indices:
+            s1, t1, m1 = self._resolve_bulk_py(snap, [tuples[i]])
+            sd[i] = s1[0]
+            tg[i] = t1[0]
+            if 0 in m1:
+                multi[i] = m1[0]
+
+    def _resolve_bulk_py(
         self, snap: GraphSnapshot, tuples: Sequence[RelationTuple]
     ) -> tuple[np.ndarray, np.ndarray, dict]:
         """One tight host pass resolving every query to device rows.
@@ -459,7 +647,10 @@ class TpuCheckEngine:
                 tg[i] = t
             sd[i] = start_dev
             if starts is not None:
-                live = starts[starts < nl]
+                # interior starts seed the bitmap; sink starts (no
+                # out-edges) contribute nothing; static starts propagate
+                # one hop
+                live = starts[starts < snap.num_int]
                 static = starts[starts >= nl]
                 hop = np.zeros(0, np.int64)
                 if static.size:
@@ -484,20 +675,28 @@ class TpuCheckEngine:
         # constant across calls and every chunk hits the same jit cache entry
         sd, tg, multi = self._resolve_bulk(snap, tuples)
 
-        # per-query device entry counts → greedy chunk boundaries bounded
-        # by both query count and scatter entries
+        # per-query device entry counts (seeds + answer gathers) → greedy
+        # chunk boundaries bounded by both query count and entries
         n = len(tuples)
+        ni = snap.num_int
         nl = snap.num_live
         ip = snap.fwd_indptr
+        sp_ = snap.sink_indptr
         cnt = np.zeros(n, np.int64)
-        m_live = (sd >= 0) & (sd < nl)
-        cnt[m_live] = 1
+        m_int = (sd >= 0) & (sd < ni)
+        cnt[m_int] = 1
         m_stat = sd >= nl
         if m_stat.any():
             s = sd[m_stat]
             cnt[m_stat] = ip[s + 1] - ip[s]
+        has_start = m_int | m_stat
         for i, (live, hop) in multi.items():
             cnt[i] = live.size + hop.size
+            has_start[i] = live.size > 0 or hop.size > 0
+        m_ans = has_start & (tg >= ni) & (tg < nl)
+        if m_ans.any():
+            t = tg[m_ans] - ni
+            cnt[m_ans] += sp_[t + 1] - sp_[t]
         cap = self._max_batch
         csum = np.concatenate([np.zeros(1, np.int64), np.cumsum(cnt)])
         bounds: list[tuple[int, int]] = []
@@ -526,12 +725,12 @@ class TpuCheckEngine:
         for woff in range(0, len(bounds), self._dispatch_window):
             wave = bounds[woff : woff + self._dispatch_window]
             pending = [
-                (self._device_batch(snap, sd, tg, multi, a, b, force_W), b - a)
+                self._device_batch(snap, sd, tg, multi, a, b, force_W) + (b - a,)
                 for a, b in wave
             ]
-            fetched = jax.device_get([d for d, _ in pending])
-            for (arr, iters, trunc), (_, nq) in zip(fetched, pending):
-                out.extend(bool(x) for x in arr[:nq])
+            fetched = jax.device_get([d for d, _, _ in pending])
+            for (arr, iters, trunc), (_, host_ans, nq) in zip(fetched, pending):
+                out.extend(bool(x) or bool(h) for x, h in zip(arr[:nq], host_ans))
                 max_iters = max(max_iters, int(iters))
                 any_truncated = any_truncated or bool(trunc)
         # adapt the pull-block size so the next batch converges within one
@@ -557,27 +756,21 @@ class TpuCheckEngine:
         i1: int,
         force_W: Optional[int] = None,
     ):
-        packed = pack_chunk(snap, sd, tg, multi, i0, i1, force_W)
+        packed, host_ans = pack_chunk(snap, sd, tg, multi, i0, i1, force_W)
         if packed is None:
             W = force_W or next(w for w in _WORD_WIDTHS if 32 * w >= i1 - i0)
-            return np.zeros(32 * W, dtype=bool), np.int32(0), False
-        e1_rows, e1_words, e1_masks, e2_rows, e2_words, e2_masks, targets = packed
-        return _check_kernel(
+            return (np.zeros(32 * W, dtype=bool), np.int32(0), False), host_ans
+        dev = _check_kernel(
             snap.device_buckets,
-            jnp.asarray(e1_rows),
-            jnp.asarray(e1_words),
-            jnp.asarray(e1_masks),
-            jnp.asarray(e2_rows),
-            jnp.asarray(e2_words),
-            jnp.asarray(e2_masks),
-            jnp.asarray(targets),
+            *(jnp.asarray(a) for a in packed),
             n_active=snap.num_active,
-            n_live=snap.num_live,
+            n_int=snap.num_int,
             valid_rows=tuple(b.n for b in snap.buckets),
             it_cap=self._it_cap,
             block_iters=self._block_iters,
             bitmap_sharding=self._bitmap_sharding,
         )
+        return dev, host_ans
 
     def subject_is_allowed(self, requested: RelationTuple) -> bool:
         """Single-query convenience with the oracle engine's signature

@@ -63,6 +63,11 @@ def load_library() -> Optional[ctypes.CDLL]:
         ]
         lib.graph_resolve_set.restype = c
         lib.graph_resolve_set.argtypes = [p, c, ctypes.c_char_p, c, ctypes.c_char_p, c]
+        if hasattr(lib, "graph_resolve_queries"):
+            lib.graph_resolve_queries.restype = c
+            lib.graph_resolve_queries.argtypes = [
+                p, ctypes.c_char_p, c, c, ctypes.POINTER(c), ctypes.POINTER(c),
+            ]
         for fn in ("graph_resolve_leaf", "graph_obj_code", "graph_rel_code"):
             getattr(lib, fn).restype = c
             getattr(lib, fn).argtypes = [p, ctypes.c_char_p, c]
@@ -148,6 +153,26 @@ class NativeInterned:
     def resolve_set(self, ns_id: int, obj: str, rel: str) -> int:
         o, r = obj.encode(), rel.encode()
         return int(self._lib.graph_resolve_set(self._handle, ns_id, o, len(o), r, len(r)))
+
+    def resolve_queries(self, buf: bytes, n: int):
+        """Bulk literal-query resolution: ``buf`` packs ``n`` records in the
+        row wire format (kind 1: f0 = subject id; kind 0: subject set).
+        Returns ``(start_raw, sub_raw)`` int64 arrays (-1 = not present;
+        leaf subjects offset by num_sets), or None when the lib predates
+        the entry point or rejects the buffer framing."""
+        if not hasattr(self._lib, "graph_resolve_queries"):
+            return None
+        c = ctypes.c_int64
+        start = np.empty(n, np.int64)
+        sub = np.empty(n, np.int64)
+        rc = self._lib.graph_resolve_queries(
+            self._handle, buf, len(buf), n,
+            start.ctypes.data_as(ctypes.POINTER(c)),
+            sub.ctypes.data_as(ctypes.POINTER(c)),
+        )
+        if rc != 0:
+            return None
+        return start, sub
 
     def resolve_leaf(self, subject_id: str) -> int:
         s = subject_id.encode()

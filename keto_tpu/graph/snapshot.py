@@ -6,27 +6,35 @@ its *in*-neighbors. A pull step is gather-only — TPUs gather well but
 serialize scatters with colliding indices, so the layout makes the inner
 loop pure gathers + OR-reductions:
 
-- nodes are **renumbered** ("device ids") into three classes, sorted in
+- nodes are **renumbered** ("device ids") into four classes, sorted in
   this order:
 
-  * **active** — has at least one in-edge whose source itself has in-edges
-    (a "live" source). Only active rows can change after the first BFS
-    step, so the iterative pull reads and writes just this prefix.
-  * **passive** — has in-edges, but only from zero-in-degree ("static")
-    sources. Their reached-bitmap is constant after initialization: the
-    start bits propagated one hop from static sources (computed on host
-    per batch, see tpu_engine.pack_chunk).
-  * **static** — no in-edges. Never materialized on device at all; their
-    only effect is the one-hop propagation above.
+  * **active interior** — has in-edges AND out-edges, with ≥ 1 in-edge
+    from another interior node. These are the only rows the BFS loop
+    iterates: everything else is provably constant (or irrelevant) during
+    propagation.
+  * **passive interior** — in/out-edges, but in-edges only from
+    zero-in-degree ("static") sources. Constant after initialization (the
+    one-hop start propagation computed on host per batch,
+    tpu_engine.pack_chunk), yet still gathered as a propagation source.
+  * **sink** — in-edges but NO out-edges (typically subject leaves — the
+    bulk of most graphs, e.g. every user in an RBAC workload). Sinks
+    cannot propagate, so they get **no bitmap row at all**; a sink's
+    answer is resolved per batch by gathering its interior in-neighbors
+    from the fixpoint bitmap (``sink_indptr``/``sink_indices`` below).
+  * **static** — no in-edges. Never materialized on device; their only
+    effect is the host-side one-hop propagation.
 
-- active nodes are grouped into power-of-two **live-in-degree** buckets;
-  each bucket stores a dense ``[rows, degree]`` int32 matrix of *live*
-  in-neighbor device ids (ELL format), padded with sentinel ``n_live``
-  that points at an all-zero bitmap row. Edges from static sources are
-  excluded — the bitmap the kernel iterates is ``[n_live+1, W]``, not
-  ``[n_nodes+1, W]``, and each pull gathers only live→live edges (often a
-  small fraction of the graph: e.g. per-document grant edges all originate
-  at zero-in-degree document nodes);
+  Excluding sinks is the big win: the iterated bitmap is
+  ``[num_int+1, W]`` over interior nodes only (RBAC example: ~10k groups
+  instead of ~110k groups+users), and each pull gathers only
+  interior→interior edges — orders of magnitude fewer rows than the raw
+  edge count.
+
+- active-interior nodes are grouped into power-of-two **interior-in-degree**
+  buckets; each bucket stores a dense ``[rows, degree]`` int32 matrix of
+  interior in-neighbor device ids (ELL format), padded with sentinel
+  ``num_int`` pointing at an all-zero bitmap row;
 - bucket row counts are padded to powers of two so a snapshot rebuild after
   tuple writes usually keeps the same array shapes and hits the jit cache.
 
@@ -81,8 +89,11 @@ class GraphSnapshot:
     num_leaves: int
     #: device ids < num_active are iterated by the BFS loop
     num_active: int
-    #: device ids < num_live have in-edges (active + passive); the device
-    #: bitmap has num_live+1 rows (last row all-zero)
+    #: device ids < num_int are interior (active + passive); the device
+    #: bitmap has num_int+1 rows (last row all-zero)
+    num_int: int
+    #: device ids in [num_int, num_live) are sinks; ids ≥ num_live are
+    #: static (no in-edges)
     num_live: int
     buckets: list[Bucket]
     # string→raw-id resolution: an InternedGraph (Python dicts) or a
@@ -93,6 +104,10 @@ class GraphSnapshot:
     # forward CSR over device ids, host-side (expand assist, debugging)
     fwd_indptr: Optional[np.ndarray] = None  # int64 [n_nodes+1]
     fwd_indices: Optional[np.ndarray] = None  # int32 [E]
+    #: per sink (indexed by device id - num_int): interior in-neighbor
+    #: device ids — the rows gathered to answer a sink-targeted query
+    sink_indptr: Optional[np.ndarray] = None  # int64 [num_live-num_int+1]
+    sink_indices: Optional[np.ndarray] = None  # int32
     device_buckets: Any = None  # jnp arrays, populated lazily by the engine
     _pattern_cache: dict = field(default_factory=dict)
     _cache_lock: threading.Lock = field(default_factory=threading.Lock)
@@ -176,6 +191,7 @@ def build_snapshot(
             num_sets=0,
             num_leaves=0,
             num_active=0,
+            num_int=0,
             num_live=0,
             buckets=[],
             interned=g,
@@ -183,24 +199,29 @@ def build_snapshot(
             wild_ns_ids=wild_ns_ids,
             fwd_indptr=np.zeros(1, np.int64),
             fwd_indices=np.zeros(0, np.int32),
+            sink_indptr=np.zeros(1, np.int64),
+            sink_indices=np.zeros(0, np.int32),
         )
 
     in_deg = np.bincount(dst_raw, minlength=n)
+    out_deg = np.bincount(src_raw, minlength=n)
     has_in = in_deg > 0
-    # live edges: source itself has in-edges, so its bitmap row can change
-    # during BFS. Edges from static (zero-in-degree) sources contribute a
-    # constant one-hop term handled at batch setup (tpu_engine.pack_chunk),
-    # so only live edges are materialized on device.
-    live_edge = has_in[src_raw]
-    live_in_deg = np.bincount(dst_raw[live_edge], minlength=n)
+    has_out = out_deg > 0
+    interior = has_in & has_out
+    sink = has_in & ~has_out
+    # iterated ("ELL") edges: interior → interior. Edges from static
+    # sources are the batch-time one-hop term; edges into sinks are
+    # answer-time gathers — neither is materialized in the loop.
+    ell_edge = has_in[src_raw] & has_out[dst_raw]
+    int_in_deg = np.bincount(dst_raw[ell_edge], minlength=n)
 
-    # bucket key: ceil-log2(live in-degree) + 1 for active rows; passive
-    # rows (in-edges only from static sources) sort after them (key 62),
-    # static rows last (key 63)
+    # bucket key: ceil-log2(interior in-degree) + 1 for active-interior;
+    # passive-interior 61, sinks 62, static 63
     with np.errstate(divide="ignore"):
-        bucket_key = np.ceil(np.log2(np.maximum(live_in_deg, 1))).astype(np.int64) + 1
-    bucket_key[live_in_deg == 1] = 1
-    bucket_key[(live_in_deg == 0) & has_in] = 62
+        bucket_key = np.ceil(np.log2(np.maximum(int_in_deg, 1))).astype(np.int64) + 1
+    bucket_key[int_in_deg == 1] = 1
+    bucket_key[interior & (int_in_deg == 0)] = 61
+    bucket_key[sink] = 62
     bucket_key[~has_in] = 63
 
     # renumber: device order sorts by (bucket, raw id); raw2dev inverts it
@@ -208,13 +229,14 @@ def build_snapshot(
     raw2dev = np.empty(n, dtype=np.int64)
     raw2dev[dev_order] = np.arange(n)
 
-    num_active = int(np.count_nonzero(bucket_key < 62))
+    num_active = int(np.count_nonzero(bucket_key < 61))
+    num_int = int(np.count_nonzero(interior))
     num_live = int(np.count_nonzero(has_in))
 
-    # group live edges by destination device id; cumcount gives the column
-    # slot. Destinations of live edges are active rows by construction.
-    dst_dev = raw2dev[dst_raw[live_edge]]
-    src_dev = raw2dev[src_raw[live_edge]]
+    # group ELL edges by destination device id; cumcount gives the column
+    # slot. Destinations of ELL edges are active-interior by construction.
+    dst_dev = raw2dev[dst_raw[ell_edge]]
+    src_dev = raw2dev[src_raw[ell_edge]]
     order = np.argsort(dst_dev, kind="stable")
     dst_sorted = dst_dev[order]
     src_sorted = src_dev[order].astype(np.int32)
@@ -223,7 +245,7 @@ def build_snapshot(
 
     key_by_dev = bucket_key[dev_order][:num_active]
     buckets: list[Bucket] = []
-    sentinel = np.int32(num_live)  # the bitmap's all-zero row
+    sentinel = np.int32(num_int)  # the bitmap's all-zero row
     for key in np.unique(key_by_dev):
         members = np.nonzero(key_by_dev == key)[0]  # contiguous by construction
         offset, n_rows = int(members[0]), int(members.shape[0])
@@ -243,11 +265,21 @@ def build_snapshot(
     findices = all_dst_dev[forder].astype(np.int32)
     findptr = np.searchsorted(fsrc, np.arange(n + 1))
 
+    # sink reverse CSR: interior in-neighbors per sink, for answer gathers
+    s_edge = has_in[src_raw] & sink[dst_raw]
+    s_dst = raw2dev[dst_raw[s_edge]] - num_int
+    s_src = raw2dev[src_raw[s_edge]].astype(np.int32)
+    sorder = np.argsort(s_dst, kind="stable")
+    n_sink = num_live - num_int
+    sink_indptr = np.searchsorted(s_dst[sorder], np.arange(n_sink + 1))
+    sink_indices = s_src[sorder]
+
     return GraphSnapshot(
         snapshot_id=watermark,
         num_sets=g.num_sets,
         num_leaves=g.num_leaves,
         num_active=num_active,
+        num_int=num_int,
         num_live=num_live,
         buckets=buckets,
         interned=g,
@@ -255,4 +287,6 @@ def build_snapshot(
         wild_ns_ids=wild_ns_ids,
         fwd_indptr=findptr,
         fwd_indices=findices,
+        sink_indptr=sink_indptr,
+        sink_indices=sink_indices,
     )

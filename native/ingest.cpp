@@ -258,6 +258,69 @@ int64_t graph_resolve_leaf(const Graph* g, const char* s, int64_t len) {
     return it == g->leaf_ids.end() ? -1 : it->second;
 }
 
+// Bulk query resolution: the serving hot path. One call resolves n
+// check queries packed in the same 7-field record format as rows
+// (kind "1": f0 = subject id; kind "0": f0/f1/f2 = subject set). Writes
+// out_start[i] = LHS set id or -1, out_sub[i] = subject raw id (leaves
+// offset by num_sets, matching edge dst encoding) or -1. Returns 0 on
+// success, -1 on a malformed buffer. Wildcard/pattern queries never
+// reach this path (keto_tpu/check/tpu_engine.py routes them to the
+// host-side pattern resolver).
+int64_t graph_resolve_queries(const Graph* g, const char* buf, int64_t len,
+                              int64_t n, int64_t* out_start, int64_t* out_sub) {
+    const char* p = buf;
+    const char* end = buf + len;
+    const int64_t num_sets = (int64_t)g->set_ids.size();
+    std::string_view fields[7];
+    SetKey key;
+    std::string leaf;
+    int64_t i = 0;
+    while (p < end && i < n) {
+        int f = 0;
+        const char* field_start = p;
+        while (p < end && f < 7) {
+            if (*p == '\x1f' || *p == '\x1e') {
+                fields[f++] = std::string_view(field_start, (size_t)(p - field_start));
+                bool rec_end = (*p == '\x1e');
+                ++p;
+                field_start = p;
+                if (rec_end) break;
+            } else {
+                ++p;
+            }
+        }
+        if (f != 7) return -1;
+        int64_t ns = 0;
+        for (char c : fields[0]) {
+            if (c < '0' || c > '9') return -1;
+            ns = ns * 10 + (c - '0');
+        }
+        key.ns = ns;
+        key.obj.assign(fields[1]);
+        key.rel.assign(fields[2]);
+        auto it = g->set_ids.find(key);
+        out_start[i] = it == g->set_ids.end() ? -1 : it->second;
+        if (fields[3] == "1") {
+            leaf.assign(fields[4]);
+            auto lt = g->leaf_ids.find(leaf);
+            out_sub[i] = lt == g->leaf_ids.end() ? -1 : lt->second + num_sets;
+        } else {
+            int64_t sns = 0;
+            for (char c : fields[4]) {
+                if (c < '0' || c > '9') return -1;
+                sns = sns * 10 + (c - '0');
+            }
+            key.ns = sns;
+            key.obj.assign(fields[5]);
+            key.rel.assign(fields[6]);
+            auto st = g->set_ids.find(key);
+            out_sub[i] = st == g->set_ids.end() ? -1 : st->second;
+        }
+        ++i;
+    }
+    return (i == n && p >= end) ? 0 : -1;
+}
+
 int64_t graph_obj_code(const Graph* g, const char* s, int64_t len) {
     auto it = g->obj_codes.find(std::string(s, (size_t)len));
     return it == g->obj_codes.end() ? -1 : it->second;

@@ -213,6 +213,52 @@ def test_fuzz_differential(make_persister, seed):
         assert g == w, f"divergence on {q}: tpu={g} oracle={w} (seed={seed})"
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_bulk_resolve_native_parity(make_persister, seed):
+    # the C++ bulk resolver and the Python host loop must agree entry for
+    # entry, including wildcard patterns, unknown namespaces, and subject
+    # sets routed through the special path
+    import numpy as np
+
+    rng = random.Random(seed)
+    p = make_persister([("ns0", 0), ("ns1", 1), ("", 3)])
+    ns_names = ["ns0", "ns1", ""]
+    objects = [f"o{i}" for i in range(6)]
+    relations = ["r0", "r1", ""]
+    users = [f"u{i}" for i in range(5)]
+
+    def rand_set():
+        return SubjectSet(rng.choice(ns_names), rng.choice(objects), rng.choice(relations))
+
+    tuples = []
+    for _ in range(rng.randrange(10, 80)):
+        sub = SubjectID(rng.choice(users)) if rng.random() < 0.4 else rand_set()
+        tuples.append(T(rng.choice(ns_names), rng.choice(objects), rng.choice(relations), sub))
+    p.write_relation_tuples(*tuples)
+
+    tpu = TpuCheckEngine(p, p.namespaces)
+    snap = tpu.snapshot()
+    if not hasattr(snap.interned, "resolve_queries"):
+        pytest.skip("native library not built")
+
+    queries = []
+    for _ in range(128):
+        sub = SubjectID(rng.choice(users + ["ghost"])) if rng.random() < 0.5 else rand_set()
+        queries.append(
+            T(rng.choice(ns_names + ["nope"]), rng.choice(objects), rng.choice(relations), sub)
+        )
+    got_n = tpu._resolve_bulk_native(snap, queries)
+    assert got_n is not None
+    sd_n, tg_n, multi_n = got_n
+    sd_p, tg_p, multi_p = tpu._resolve_bulk_py(snap, queries)
+    assert np.array_equal(sd_n, sd_p)
+    assert np.array_equal(tg_n, tg_p)
+    assert multi_n.keys() == multi_p.keys()
+    for i in multi_n:
+        assert np.array_equal(multi_n[i][0], multi_p[i][0])
+        assert np.array_equal(multi_n[i][1], multi_p[i][1])
+
+
 def test_deep_chain(make_persister):
     # depth beyond anything the fuzzer hits; exercises many BFS iterations
     p = make_persister([("n", 1)])
