@@ -164,15 +164,53 @@ def main():
 
     queries, expected = make_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T)
 
-    # warmup (compile) on a full-width batch
+    # warmup: one full pass compiles every slice geometry the measured
+    # passes will use (slice width is shape-static under jit)
     t0 = time.perf_counter()
-    engine.batch_check(queries[: engine._max_batch])
+    engine.batch_check(queries)
     log(f"warmup/compile: {time.perf_counter()-t0:.1f}s")
 
-    t0 = time.perf_counter()
-    got = engine.batch_check(queries)
-    tpu_s = time.perf_counter() - t0
+    # measured: median of BENCH_REPS full passes (tunneled-device D2H
+    # latency is jittery; a single pass can be off by 2x)
+    reps = int(os.environ.get("BENCH_REPS", 3))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        got = engine.batch_check(queries)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    tpu_s = times[len(times) // 2]
     tpu_qps = n_checks / tpu_s
+    log(f"batch reps: {['%.0f ms' % (t*1e3) for t in times]}")
+
+    # streamed pass: per-slice service latency at flat memory (BASELINE's
+    # target metric is p50 for 1M-check streams). depth=2 keeps the
+    # pipeline saturated but yields in steady state, so the inter-yield
+    # gap (first yield excluded — it absorbs pipeline fill) is the real
+    # per-slice service time; decisions are validated below like the
+    # batch pass.
+    slice_lat = []
+    stream_got = []
+    t0 = time.perf_counter()
+    t_prev = t0
+    for out in engine.batch_check_stream(iter(queries), depth=2):
+        now = time.perf_counter()
+        slice_lat.append(now - t_prev)
+        t_prev = now
+        stream_got.append(out)
+    stream_s = time.perf_counter() - t0
+    import numpy as _np
+
+    stream_got = _np.concatenate(stream_got)
+    n_stream = int(stream_got.shape[0])
+    stream_wrong = int((stream_got != _np.asarray(expected)).sum())
+    steady = sorted(slice_lat[1:]) or slice_lat
+    p50 = steady[len(steady) // 2] * 1e3
+    p99 = steady[min(len(steady) - 1, int(len(steady) * 0.99))] * 1e3
+    log(
+        f"stream: {n_stream/stream_s:,.0f} checks/s; slice p50={p50:.0f} ms "
+        f"p99={p99:.0f} ms ({len(slice_lat)} slices, wrong={stream_wrong})"
+    )
 
     n_wrong = sum(g != e for g, e in zip(got, expected))
     if n_wrong:
@@ -206,6 +244,11 @@ def main():
                     "nodes": snap.n_nodes,
                     "edges": snap.n_edges,
                     "tpu_batch_ms_total": round(tpu_s * 1e3, 1),
+                    "tpu_batch_ms_all_reps": [round(t * 1e3, 1) for t in times],
+                    "stream_checks_per_s": round(n_stream / stream_s, 1),
+                    "stream_slice_p50_ms": round(p50, 1),
+                    "stream_slice_p99_ms": round(p99, 1),
+                    "stream_wrong": stream_wrong,
                     "snapshot_build_s": round(snapshot_s, 2),
                     "ingest_s": round(ingest_s, 2),
                     "oracle_checks_per_s": round(oracle_qps, 1),

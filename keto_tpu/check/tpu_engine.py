@@ -44,6 +44,7 @@ Decision parity with the reference engine:
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 from functools import partial
@@ -70,7 +71,7 @@ _PLACEHOLDER = b"0\x1f\x1f\x1f1\x1f\x1f\x1f\x1e"
 
 # batch widths (in 32-query words) the engine compiles for; a request is
 # padded up to the smallest fitting width so jit caches stay small
-_WORD_WIDTHS = (1, 8, 64, 256)
+_WORD_WIDTHS = (1, 8, 64, 256, 1024, 2048)
 # cap on the [rows, chunk, W] gather intermediate per bucket
 _DEGREE_CHUNK = 1024
 
@@ -114,7 +115,7 @@ def check_step(
     it_cap: int,
     block_iters: int = 8,
     bitmap_sharding=None,  # NamedSharding for the [rows, words] bitmaps
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> jnp.ndarray:
     B = targets.shape[0]
     W = B // 32
     q = jnp.arange(B)
@@ -162,8 +163,11 @@ def check_step(
                 0, block_iters, lambda _, s: lax.cond(s[2], step, lambda x: x, s), st
             )
 
-        # p0 is shape-placeholder only: changed=True guarantees ≥ 1 real step
-        p0 = R0[:n_active]
+        # p0 is shape-placeholder only: changed=True and it_cap ≥ 1 (enforced
+        # by the engine) guarantee ≥ 1 real step replaces it. All-zero — not
+        # an R0 alias — so even a degenerate caller can't leak start bits
+        # (which must never count as "reached via ≥ 1 edge") into answers.
+        p0 = jnp.zeros((n_active, W), jnp.uint32)
         R_fix, p_fix, truncated, iters = lax.while_loop(
             lambda st: st[2] & (st[3] < it_cap),
             block,
@@ -189,9 +193,17 @@ def check_step(
     vals = (R_fix[a_rows, aw] >> ab) & jnp.uint32(1)
     hit = hit.at[a_q].max(vals)
 
-    # truncated: the loop stopped on the iteration cap while the frontier
-    # was still growing — converging in exactly it_cap steps is NOT truncation
-    return hit == 1, iters, truncated
+    # Single packed output ``uint32[W+2]``: per-query decision bits, then
+    # the iteration count, then the truncation flag (the loop stopped on the
+    # cap while the frontier still grew — converging in exactly it_cap steps
+    # is NOT truncation). Device-side bit packing matters: D2H fetch is the
+    # serving path's scarcest resource on tunneled devices, so ship 1 bit
+    # per query in one transfer, not 1 byte in three.
+    packed_bits = lax.reduce(
+        (hit << bits).reshape(W, 32), np.uint32(0), lax.bitwise_or, (1,)
+    )
+    tail = jnp.stack([iters.astype(jnp.uint32), truncated.astype(jnp.uint32)])
+    return jnp.concatenate([packed_bits, tail])
 
 
 #: jitted entrypoint used by the engine; ``check_step`` stays un-jitted for
@@ -219,10 +231,16 @@ def _csr_gather(indptr: np.ndarray, indices: np.ndarray, nodes: np.ndarray):
     return indices[base + within], cnts
 
 
+def _entry_pad(B: int, size: int) -> int:
+    """Scatter/gather entry arrays pad to B·2^k — a couple of geometries per
+    batch width, so chunks of one request hit the same jit cache entry."""
+    sp = B
+    while sp < size:
+        sp *= 2
+    return sp
+
+
 def _pad_entries(rows_l, words_l, masks_l, B: int, drop_row: int):
-    """Concatenate + pad scatter-entry lists to a small set of geometries:
-    size B when entries fit, else the next power of two (≥ the max batch) —
-    so every chunk of a request hits the same jit cache entry."""
     if rows_l:
         rows = np.concatenate(rows_l).astype(np.int32)
         words = np.concatenate(words_l)
@@ -231,8 +249,7 @@ def _pad_entries(rows_l, words_l, masks_l, B: int, drop_row: int):
         rows = np.zeros(0, np.int32)
         words = np.zeros(0, np.int32)
         masks = np.zeros(0, np.uint32)
-    sp = B if rows.size <= B else max(_ceil_pow2(rows.size), 32 * _WORD_WIDTHS[-1])
-    pad = sp - rows.size
+    pad = _entry_pad(B, rows.size) - rows.size
     rows = np.concatenate([rows, np.full(pad, drop_row, np.int32)])
     words = np.concatenate([words, np.zeros(pad, np.int32)])
     masks = np.concatenate([masks, np.zeros(pad, np.uint32)])
@@ -341,8 +358,7 @@ def pack_chunk(
     else:
         a_rows = np.zeros(0, np.int32)
         a_q = np.zeros(0, np.int32)
-    sp = B if a_rows.size <= B else max(_ceil_pow2(a_rows.size), 32 * _WORD_WIDTHS[-1])
-    pad = sp - a_rows.size
+    pad = _entry_pad(B, a_rows.size) - a_rows.size
     # answer padding: in-range all-zero row ni with query 0 — max(0) is a no-op
     a_rows = np.concatenate([a_rows, np.full(pad, ni, np.int32)])
     a_q = np.concatenate([a_q, np.zeros(pad, np.int32)])
@@ -374,7 +390,10 @@ class TpuCheckEngine:
         max_batch: int = 32 * _WORD_WIDTHS[-1],
         mesh=None,
         shard_rows: bool = False,
+        mem_budget_bytes: int = 6 << 30,
     ):
+        if it_cap < 1:
+            raise ValueError("it_cap must be >= 1 (the answer pull needs one step)")
         self._store = store
         if isinstance(namespaces, namespace_pkg.Manager):
             self._nm: Callable[[], namespace_pkg.Manager] = lambda: namespaces
@@ -382,6 +401,10 @@ class TpuCheckEngine:
             self._nm = namespaces
         self._it_cap = it_cap
         self._max_batch = max_batch
+        # bound on the BFS workspace (~3 W-wide uint32 bitmaps over interior
+        # rows); batch width narrows automatically on huge graphs so the
+        # default max_batch can never ask for more HBM than this
+        self._mem_budget = mem_budget_bytes
         # pulls per convergence observation, adapted to the workload's
         # traversal depth from the iteration counts kernels report back
         self._block_iters = 8
@@ -666,18 +689,82 @@ class TpuCheckEngine:
     # -- public API ----------------------------------------------------------
 
     def batch_check(self, tuples: Sequence[RelationTuple]) -> list[bool]:
+        """Answer every query: slices pipeline resolve→pack→dispatch (host
+        work on slice k+1 overlaps device execution of slice k — dispatch is
+        async), then all packed outputs concatenate on device and fetch
+        ONCE. D2H transfer latency (not bandwidth, not dispatch) dominates
+        end-to-end time on tunneled devices, so the whole request ships 1
+        bit per query in a single transfer."""
         snap = self.snapshot()
         if snap.n_nodes == 0 or snap.n_edges == 0 or not tuples:
             return [False] * len(tuples)
+        results = list(self._dispatch_slices(snap, tuples))
+        out, max_iters, any_truncated = self._collect(results, len(tuples))
+        self._after_batch(max_iters, any_truncated)
+        return out.tolist()
 
-        # resolve on host first, then pack chunks so that the start-entry
-        # array stays at its padded size B — chunk geometry (W, SP) is then
-        # constant across calls and every chunk hits the same jit cache entry
-        sd, tg, multi = self._resolve_bulk(snap, tuples)
+    def batch_check_stream(self, tuples_iter, *, depth: Optional[int] = None):
+        """Streaming check: consume an iterable of RelationTuples, yield
+        ``numpy bool[slice]`` decision arrays in order, keeping at most
+        ``depth`` slices in flight (flat memory for arbitrarily long
+        streams — BASELINE config 5's 1M-check batches never materialize
+        device state for more than ``depth`` slices). Each yielded slice
+        pays one D2H transfer, overlapped with later slices' host+device
+        work via ``copy_to_host_async``."""
+        from collections import deque
 
-        # per-query device entry counts (seeds + answer gathers) → greedy
-        # chunk boundaries bounded by both query count and entries
-        n = len(tuples)
+        snap = self.snapshot()
+        depth = depth or self._dispatch_window
+        inflight: deque = deque()
+        max_iters = 0
+        any_truncated = False
+
+        def _land(rec):
+            nonlocal max_iters, any_truncated
+            out, it, tr = self._unpack_slice(*rec)
+            max_iters = max(max_iters, it)
+            any_truncated = any_truncated or tr
+            return out
+
+        it = iter(tuples_iter)
+        while True:
+            batch = list(itertools.islice(it, self._slice_cap(snap)))
+            if not batch:
+                break
+            if snap.n_nodes == 0 or snap.n_edges == 0:
+                yield np.zeros(len(batch), dtype=bool)
+                continue
+            for rec in self._dispatch_slices(snap, batch):
+                if rec[0] is not None:
+                    rec[0].copy_to_host_async()
+                inflight.append(rec)
+                while len(inflight) > depth:
+                    yield _land(inflight.popleft())
+        while inflight:
+            yield _land(inflight.popleft())
+        self._after_batch(max_iters, any_truncated)
+
+    def _slice_cap(self, snap: GraphSnapshot) -> int:
+        """Queries per device slice: the widest bitmap the workspace budget
+        allows (~3 W-wide uint32 bitmaps over interior rows — huge graphs
+        narrow the batch width before the default max_batch could overshoot
+        HBM)."""
+        w_cap = next(
+            (
+                w
+                for w in reversed(_WORD_WIDTHS)
+                if (snap.num_int + 1) * 12 * w <= self._mem_budget
+            ),
+            _WORD_WIDTHS[0],
+        )
+        return min(self._max_batch, 32 * w_cap)
+
+    def _entry_counts(
+        self, snap: GraphSnapshot, sd: np.ndarray, tg: np.ndarray, multi: dict
+    ) -> np.ndarray:
+        """Per-query device entry counts (seeds + answer gathers) of a
+        resolved slice — the scatter/gather work a query adds to a kernel."""
+        n = sd.shape[0]
         ni = snap.num_int
         nl = snap.num_live
         ip = snap.fwd_indptr
@@ -697,45 +784,99 @@ class TpuCheckEngine:
         if m_ans.any():
             t = tg[m_ans] - ni
             cnt[m_ans] += sp_[t + 1] - sp_[t]
-        cap = self._max_batch
-        csum = np.concatenate([np.zeros(1, np.int64), np.cumsum(cnt)])
-        bounds: list[tuple[int, int]] = []
-        i0 = 0
-        while i0 < n:
-            i1 = int(np.searchsorted(csum, csum[i0] + cap, side="right")) - 1
-            i1 = max(i0 + 1, min(i1, i0 + cap, n))
-            bounds.append((i0, i1))
-            i0 = i1
+        return cnt
 
-        # one multi-chunk request keeps a single kernel shape: every chunk
-        # pads to the width fitting the largest one rather than compiling
-        # narrower variants for tails
-        force_W = None
-        if len(bounds) > 1:
-            biggest = max(b - a for a, b in bounds)
-            force_W = next(w for w in _WORD_WIDTHS if 32 * w >= biggest)
+    def _dispatch_slices(self, snap: GraphSnapshot, tuples: Sequence[RelationTuple]):
+        """Resolve + pack + dispatch ``tuples`` in ``_slice_cap`` query
+        slices, yielding ``[dev_out | None, host_ans, nq]`` records as each
+        slice is enqueued (the device chews on earlier slices meanwhile).
 
-        # dispatch every chunk asynchronously (windowed so in-flight bitmap
-        # workspaces stay within HBM), then fetch results in pipelined
-        # device_gets — per-fetch latency dominates on tunneled devices, and
-        # concurrent fetches overlap
-        out: list[bool] = []
+        A slice whose resolved fan-out exceeds 4·B device entries (wildcard
+        patterns, high-out-degree static starts) is sub-chunked so entry
+        arrays stay within the {B, 2B, 4B} pad geometries — workload can't
+        force unbounded allocations or fresh kernel geometries (a single
+        monster query still falls through to ``_entry_pad``'s pow2
+        fallback; there is no smaller unit to split)."""
+        cap_q = self._slice_cap(snap)
+        n = len(tuples)
+        for s0 in range(0, n, cap_q):
+            s1 = min(s0 + cap_q, n)
+            sd, tg, multi = self._resolve_bulk(snap, tuples[s0:s1])
+            nq = s1 - s0
+            W = next(w for w in _WORD_WIDTHS if 32 * w >= nq)
+            cap_e = 4 * 32 * W
+            cnt = self._entry_counts(snap, sd, tg, multi)
+            if int(cnt.sum()) <= cap_e:
+                bounds = [(0, nq)]
+            else:
+                csum = np.concatenate([np.zeros(1, np.int64), np.cumsum(cnt)])
+                bounds = []
+                i0 = 0
+                while i0 < nq:
+                    i1 = int(np.searchsorted(csum, csum[i0] + cap_e, side="right")) - 1
+                    i1 = max(i0 + 1, min(i1, nq))
+                    bounds.append((i0, i1))
+                    i0 = i1
+            for a, b in bounds:
+                # sub-chunks keep the slice width: queries pad, geometry stays
+                dev, host_ans = self._device_batch(snap, sd, tg, multi, a, b, W)
+                yield [dev, host_ans, b - a]
+
+    @staticmethod
+    def _decode_packed(f: np.ndarray, host_ans: np.ndarray, nq: int):
+        """Decode one kernel's packed ``uint32[W+2]`` output (decision
+        bits, iteration count, truncation flag — the single place that
+        knows the layout check_step emits): device bits ∪ host-decided
+        grants. Returns ``(bool[nq], iters, truncated)``."""
+        W = f.shape[0] - 2
+        lanes = np.arange(32, dtype=np.uint32)
+        bits = ((f[:W, None] >> lanes) & 1).astype(bool).ravel()[:nq]
+        return bits | host_ans[:nq], int(f[W]), bool(f[W + 1])
+
+    @classmethod
+    def _unpack_slice(cls, dev, host_ans, nq):
+        """One slice's decisions. Returns ``(bool[nq], iters, truncated)``."""
+        if dev is None:
+            return host_ans[:nq], 0, False
+        return cls._decode_packed(jax.device_get(dev), host_ans, nq)
+
+    def _collect(self, results, n: int):
+        """Fetch every dispatched slice in ONE device transfer and unpack."""
+        devs = [d for d, _, _ in results if d is not None]
+        flat = None
+        if devs:
+            cat = jnp.concatenate(devs) if len(devs) > 1 else devs[0]
+            cat.copy_to_host_async()
+            flat = jax.device_get(cat)
+        out = np.zeros(n, dtype=bool)
         max_iters = 0
         any_truncated = False
-        for woff in range(0, len(bounds), self._dispatch_window):
-            wave = bounds[woff : woff + self._dispatch_window]
-            pending = [
-                self._device_batch(snap, sd, tg, multi, a, b, force_W) + (b - a,)
-                for a, b in wave
-            ]
-            fetched = jax.device_get([d for d, _, _ in pending])
-            for (arr, iters, trunc), (_, host_ans, nq) in zip(fetched, pending):
-                out.extend(bool(x) or bool(h) for x, h in zip(arr[:nq], host_ans))
-                max_iters = max(max_iters, int(iters))
-                any_truncated = any_truncated or bool(trunc)
-        # adapt the pull-block size so the next batch converges within one
-        # convergence observation (clamped to powers of two ≤ 32)
-        self._block_iters = max(2, min(32, _ceil_pow2(max_iters + 1)))
+        pos = 0
+        off = 0
+        for dev, host_ans, nq in results:
+            if dev is None:
+                out[pos : pos + nq] = host_ans[:nq]
+            else:
+                size = dev.shape[0]
+                bits, it, tr = self._decode_packed(
+                    flat[off : off + size], host_ans, nq
+                )
+                off += size
+                out[pos : pos + nq] = bits
+                max_iters = max(max_iters, it)
+                any_truncated = any_truncated or tr
+            pos += nq
+        return out, max_iters, any_truncated
+
+    def _after_batch(self, max_iters: int, any_truncated: bool) -> None:
+        # adapt the pull-block size so deep workloads converge within few
+        # convergence observations. Grow-only: block_iters is a static jit
+        # argname, so shrinking it would recompile every kernel geometry for
+        # a marginal saving (converged pulls inside a block are lax.cond
+        # no-ops) — growing pays one recompile to cut while-loop trips.
+        want = min(32, _ceil_pow2(max_iters + 1))
+        if want > self._block_iters:
+            self._block_iters = want
         if any_truncated:
             # the reference terminates exactly via its visited set; hitting
             # the cap means some deny decisions may come from a truncated
@@ -744,7 +885,6 @@ class TpuCheckEngine:
                 "check BFS hit it_cap=%d before the fixpoint; deny decisions "
                 "in this batch may be incomplete (raise it_cap)", self._it_cap,
             )
-        return out
 
     def _device_batch(
         self,
@@ -758,8 +898,9 @@ class TpuCheckEngine:
     ):
         packed, host_ans = pack_chunk(snap, sd, tg, multi, i0, i1, force_W)
         if packed is None:
-            W = force_W or next(w for w in _WORD_WIDTHS if 32 * w >= i1 - i0)
-            return (np.zeros(32 * W, dtype=bool), np.int32(0), False), host_ans
+            # no query in the chunk reaches the device: host_ans is the
+            # whole answer
+            return None, host_ans
         dev = _check_kernel(
             snap.device_buckets,
             *(jnp.asarray(a) for a in packed),

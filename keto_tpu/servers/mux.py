@@ -77,9 +77,9 @@ class PortMux:
                 continue
             except OSError:
                 return
-            if not self._slots.acquire(timeout=5):
-                conn.close()  # at capacity: shed rather than queue unboundedly
-                continue
+            if not self._slots.acquire(blocking=False):
+                conn.close()  # at capacity: shed immediately — a blocking
+                continue      # wait here would stall accepts of other clients
             threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
 
     def _handle(self, conn: socket.socket) -> None:
@@ -114,8 +114,14 @@ class PortMux:
                 head += data
             conn.settimeout(None)
             backend_port = self.grpc_port if head == b"PRI " else self.rest_port
-            backend = socket.create_connection(("127.0.0.1", backend_port))
-            backend.sendall(head)
+            backend = None
+            try:
+                backend = socket.create_connection(("127.0.0.1", backend_port))
+                backend.sendall(head)
+            except OSError:
+                if backend is not None:
+                    backend.close()
+                raise
         except OSError:
             conn.close()
             return

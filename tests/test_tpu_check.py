@@ -280,3 +280,40 @@ def test_high_degree_node(make_persister):
     assert_same(p, T("n", "o700", "r", SubjectID("u13")), True)
     assert_same(p, T("n", "o700", "r", SubjectID("nope")), False)
     assert_same(p, T("n", "o700", "r", SubjectSet("n", "hub", "member")), True)
+
+
+@pytest.mark.parametrize("depth", [1, 3])
+def test_stream_matches_batch(make_persister, depth):
+    # the streaming API must produce bit-identical decisions to batch_check
+    # across slice boundaries; max_batch=32 forces many slices
+    import numpy as np
+
+    rng = random.Random(99)
+    p = make_persister([("ns0", 0), ("ns1", 1)])
+    objects = [f"o{i}" for i in range(8)]
+    users = [f"u{i}" for i in range(6)]
+    tuples = []
+    for _ in range(120):
+        sub = (
+            SubjectID(rng.choice(users))
+            if rng.random() < 0.5
+            else SubjectSet(rng.choice(["ns0", "ns1"]), rng.choice(objects), "r")
+        )
+        tuples.append(T(rng.choice(["ns0", "ns1"]), rng.choice(objects), "r", sub))
+    p.write_relation_tuples(*tuples)
+
+    queries = []
+    for _ in range(200):
+        sub = (
+            SubjectID(rng.choice(users + ["ghost"]))
+            if rng.random() < 0.6
+            else SubjectSet("ns0", rng.choice(objects), "r")
+        )
+        queries.append(T(rng.choice(["ns0", "ns1", "nope"]), rng.choice(objects), "r", sub))
+
+    tpu = TpuCheckEngine(p, p.namespaces, max_batch=32)
+    want = tpu.batch_check(queries)
+    slices = list(tpu.batch_check_stream(iter(queries), depth=depth))
+    assert len(slices) > 1  # actually exercised slice boundaries
+    got = np.concatenate(slices).tolist()
+    assert got == want
