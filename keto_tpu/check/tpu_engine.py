@@ -108,6 +108,8 @@ def check_step(
     a_rows: jnp.ndarray,  # int32[SA] interior in-neighbors of sink targets
     a_q: jnp.ndarray,  # int32[SA] owning query index (padding → 0 w/ row n_int)
     targets: jnp.ndarray,  # int32[B] interior target rows, n_int = none
+    ov_nbrs: Optional[jnp.ndarray] = None,  # int32[K, C] overlay-ELL gather
+    ov_dst: Optional[jnp.ndarray] = None,  # int32[K] unique active rows (pad → n_active)
     *,
     n_active: int,
     n_int: int,
@@ -149,6 +151,14 @@ def check_step(
         def step(st):
             R, _, _, it = st
             p = _pull(bucket_nbrs, valid_rows, R)
+            if ov_nbrs is not None:
+                # delta-overlay edges (inserts since the base snapshot
+                # build, keto_tpu/graph/overlay.py): OR the overlay
+                # in-neighbors into their unique destination rows. Inside
+                # the loop, so multi-hop paths through delta edges converge
+                # exactly like base edges.
+                ovo = lax.reduce(R[ov_nbrs], np.uint32(0), lax.bitwise_or, (1,))
+                p = p.at[ov_dst].set(p[ov_dst] | ovo, mode="drop")
             act = R[:n_active]
             nxt = lax.bitwise_or(p, act)
             return R.at[:n_active].set(nxt), p, jnp.any(nxt != act), it + 1
@@ -306,7 +316,7 @@ def pack_chunk(
     # sink starts (ni ≤ sd < nl) have no out-edges: nothing to seed
     m_stat = sdc >= nl
     if m_stat.any():
-        rows, cnts = _csr_gather(snap.fwd_indptr, snap.fwd_indices, sdc[m_stat])
+        rows, cnts = snap.out_neighbors_bulk(sdc[m_stat])
         if rows.size:
             gq = np.repeat(qi[m_stat], cnts)
             m_hop_int = rows < ni
@@ -334,7 +344,11 @@ def pack_chunk(
                 e2[0].append(h_int)
                 e2[1].append(np.full(h_int.size, w, np.int32))
                 e2[2].append(np.full(h_int.size, m, np.uint32))
-            if ni <= tgc[li] < nl and (hop == tgc[li]).any():
+            # one hop straight onto a sink-class target (base sink range or
+            # overlay node; the nl sentinel never matches a hop — hops have
+            # in-edges, static ids don't)
+            tgt = tgc[li]
+            if tgt >= ni and tgt != nl and (hop == tgt).any():
                 host_ans[li] = True
 
     # answer-gather entries for sink targets of queries that have any start
@@ -343,9 +357,16 @@ def pack_chunk(
         if i0 <= i < i1:
             has_start[i - i0] = multi[i][0].size > 0 or multi[i][1].size > 0
     ans: tuple[list, list] = ([], [])
-    m_ans = has_start & (tgc >= ni) & (tgc < nl)
+    m_sink_t = (tgc >= ni) & (tgc < nl)
+    if snap.ov_sink_in:
+        # overlay targets (ids ≥ n_base) and base sinks with overlay
+        # in-edges both answer through sink_in_rows_bulk
+        m_sink_t = m_sink_t | np.isin(
+            tgc, np.fromiter(snap.ov_sink_in.keys(), np.int64)
+        )
+    m_ans = has_start & m_sink_t
     if m_ans.any():
-        rows, cnts = _csr_gather(snap.sink_indptr, snap.sink_indices, tgc[m_ans] - ni)
+        rows, cnts = snap.sink_in_rows_bulk(tgc[m_ans])
         if rows.size:
             ans[0].append(rows)
             ans[1].append(np.repeat(qi[m_ans], cnts).astype(np.int32))
@@ -424,42 +445,141 @@ class TpuCheckEngine:
             self._replicated = NamedSharding(mesh, P(None, None))
         self._lock = threading.Lock()
         self._snapshot: Optional[GraphSnapshot] = None
+        # delta overlays beyond this edge count trigger a full rebuild (the
+        # overlay ELL stage and host merge costs grow with overlay size)
+        self._max_overlay_edges = 4096
+        self._bg_rebuild: Optional[threading.Thread] = None
 
     # -- snapshot lifecycle --------------------------------------------------
 
-    def snapshot(self) -> GraphSnapshot:
-        """Current device snapshot, rebuilt iff the store moved past the
-        snapshot's watermark (double-buffered: checks against the old
-        snapshot finish while the new one is prepared)."""
+    def snapshot(self, at_least: Optional[int] = None) -> GraphSnapshot:
+        """Device snapshot current with the store's watermark.
+
+        Freshness contract (the real implementation of what the reference
+        stubs as "snaptoken", internal/check/handler.go:162):
+
+        - ``at_least=None`` — read-your-writes: blocks until the snapshot
+          reflects every acknowledged write. Insert-only advances apply as
+          a delta overlay (milliseconds — no re-intern, no relayout);
+          deletes and class transitions rebuild fully.
+        - ``at_least=w`` — bounded staleness: any snapshot with id ≥ ``w``
+          serves immediately. If the store has moved on, a background
+          rebuild is kicked off and *this* call returns the old snapshot —
+          checks issued mid-rebuild are served from the old snapshot
+          (Zanzibar zookie semantics).
+        """
         snap = self._snapshot
         wm = self._store.watermark()
         if snap is not None and snap.snapshot_id == wm:
             return snap
-        with self._lock:
-            snap = self._snapshot
-            wm = self._store.watermark()
-            if snap is not None and snap.snapshot_id == wm:
-                return snap
-            rows, wm = self._store.snapshot_rows()
-            wild_ns_ids = frozenset(
-                n.id for n in self._nm().namespaces() if n.name == ""
-            )
-            snap = build_snapshot(rows, wm, wild_ns_ids)
-            if self._mesh is None:
-                snap.device_buckets = tuple(jax.device_put(b.nbrs) for b in snap.buckets)
-            else:
-                graph_size = self._mesh.shape.get("graph", 1)
-                snap.device_buckets = tuple(
-                    jax.device_put(
-                        b.nbrs,
-                        self._bucket_sharding
-                        if b.nbrs.shape[0] % graph_size == 0
-                        else self._replicated,
-                    )
-                    for b in snap.buckets
-                )
-            self._snapshot = snap
+        if (
+            at_least is not None
+            and snap is not None
+            and snap.snapshot_id >= at_least
+        ):
+            self._kick_background_refresh()
             return snap
+        with self._lock:
+            return self._refresh_locked()
+
+    def _kick_background_refresh(self) -> None:
+        """Start (at most one) background thread bringing the snapshot up
+        to the store's watermark, so staleness-tolerant readers never pay
+        the rebuild."""
+        t = self._bg_rebuild
+        if t is not None and t.is_alive():
+            return
+
+        def run():
+            with self._lock:
+                self._refresh_locked()
+
+        t = threading.Thread(target=run, name="keto-tpu-snapshot-refresh", daemon=True)
+        self._bg_rebuild = t
+        t.start()
+
+    def _refresh_locked(self) -> GraphSnapshot:
+        """Bring the snapshot to the current watermark (caller holds the
+        lock): delta overlay when possible, full rebuild otherwise."""
+        snap = self._snapshot
+        wm = self._store.watermark()
+        if snap is not None and snap.snapshot_id == wm:
+            return snap
+        wild_ns_ids = frozenset(
+            n.id for n in self._nm().namespaces() if n.name == ""
+        )
+        new = None
+        if snap is not None:
+            new = self._try_delta(snap, wild_ns_ids)
+        if new is None:
+            rows, wm = self._store.snapshot_rows()
+            new = build_snapshot(rows, wm, wild_ns_ids)
+            self._upload_buckets(new)
+        self._upload_overlay(new)
+        self._snapshot = new
+        return new
+
+    def _try_delta(
+        self, base: GraphSnapshot, wild_ns_ids
+    ) -> Optional[GraphSnapshot]:
+        """Apply an insert-only watermark advance as an overlay (no
+        re-intern, no relayout, device buckets untouched). None when the
+        store can't produce a delta (deletes, log overflow, no support) or
+        the delta needs a class change."""
+        rows_since = getattr(self._store, "rows_since", None)
+        if rows_since is None:
+            return None
+        got = rows_since(base.snapshot_id)
+        if got is None:
+            return None
+        rows, new_wm = got
+        n_ov = len(rows) + (base.ov_ell.shape[0] if base.ov_ell is not None else 0)
+        if n_ov > self._max_overlay_edges:
+            return None
+        from keto_tpu.graph.overlay import apply_delta
+
+        return apply_delta(base, rows, new_wm, wild_ns_ids)
+
+    def _upload_buckets(self, snap: GraphSnapshot) -> None:
+        if self._mesh is None:
+            snap.device_buckets = tuple(jax.device_put(b.nbrs) for b in snap.buckets)
+        else:
+            graph_size = self._mesh.shape.get("graph", 1)
+            snap.device_buckets = tuple(
+                jax.device_put(
+                    b.nbrs,
+                    self._bucket_sharding
+                    if b.nbrs.shape[0] % graph_size == 0
+                    else self._replicated,
+                )
+                for b in snap.buckets
+            )
+
+    def _upload_overlay(self, snap: GraphSnapshot) -> None:
+        """Group overlay-ELL edges by destination into a [K, C] gather
+        matrix (pow2-padded so repeated small deltas reuse compiled
+        geometries) and place it on device."""
+        if snap.ov_ell is None or snap.ov_ell.shape[0] == 0:
+            snap.device_overlay = None
+            return
+        src = snap.ov_ell[:, 0]
+        dst = snap.ov_ell[:, 1]
+        order = np.argsort(dst, kind="stable")
+        src, dst = src[order], dst[order]
+        uniq, starts = np.unique(dst, return_index=True)
+        counts = np.diff(np.append(starts, dst.shape[0]))
+        K = _ceil_pow2(uniq.shape[0])
+        C = _ceil_pow2(int(counts.max()))
+        nbrs = np.full((K, C), snap.num_int, np.int32)  # all-zero bitmap row
+        for i, (s0, c) in enumerate(zip(starts, counts)):
+            nbrs[i, :c] = src[s0 : s0 + c]
+        dst_pad = np.full(K, snap.num_active, np.int32)  # scatter-dropped
+        dst_pad[: uniq.shape[0]] = uniq
+        sharding = None if self._mesh is None else self._replicated
+        snap.device_overlay = (
+            jax.device_put(nbrs, sharding) if sharding else jax.device_put(nbrs),
+            jax.device_put(dst_pad, sharding) if sharding else jax.device_put(dst_pad),
+        )
 
     # -- resolution ----------------------------------------------------------
 
@@ -564,6 +684,20 @@ class TpuCheckEngine:
         multi: dict = {}
         if special:
             self._resolve_specials(snap, tuples, special, sd, tg, multi)
+        if snap.ov_set_ids or snap.ov_leaf_ids:
+            # nodes created since the base build are invisible to the
+            # resident C++ tables — re-resolve the (few) queries whose
+            # start or target missed, through the overlay-aware host path
+            done = set(special) | set(dead)
+            miss = np.nonzero((sd == -1) | (tg == nl))[0]
+            for i in miss:
+                if int(i) in done:
+                    continue
+                s1, t1, m1 = self._resolve_bulk_py(snap, [tuples[i]])
+                sd[i] = s1[0]
+                tg[i] = t1[0]
+                if 0 in m1:
+                    multi[i] = m1[0]
         return sd, tg, multi
 
     def _resolve_specials(self, snap, tuples, indices, sd, tg, multi):
@@ -607,6 +741,8 @@ class TpuCheckEngine:
         num_sets = snap.num_sets
         wild_ids = snap.wild_ns_ids
         wild_list = list(wild_ids)
+        ov_set = snap.ov_set_ids or {}
+        ov_leaf = snap.ov_leaf_ids or {}
         nm = self._nm()
         ns_cache: dict = {}
 
@@ -632,9 +768,12 @@ class TpuCheckEngine:
             starts = None
             if ns_id != WILDCARD and ns_id not in wild_ids and obj != "" and rel != "":
                 raw = resolve_set(ns_id, obj, rel)
-                if raw < 0:
-                    continue
-                start_dev = int(raw2dev[raw])
+                if raw >= 0:
+                    start_dev = int(raw2dev[raw])
+                else:
+                    start_dev = ov_set.get((ns_id, obj, rel), -1) if ov_set else -1
+                    if start_dev < 0:
+                        continue
             else:
                 starts = snap.resolve_starts(ns_id, obj, rel)
                 if starts.size == 0:
@@ -647,6 +786,8 @@ class TpuCheckEngine:
                 rawl = resolve_leaf(sub.id)
                 if rawl >= 0:
                     t = int(raw2dev[rawl + num_sets])
+                elif ov_leaf:
+                    t = ov_leaf.get(sub.id, -1)
             elif isinstance(sub, SubjectSet):
                 sns_id = _ns(sub.namespace)
                 if sns_id is None:
@@ -660,13 +801,17 @@ class TpuCheckEngine:
                         if wild_list
                         else -1
                     )
+                    skey = (wild_list[0], sub.object, sub.relation) if wild_list else None
                 else:
                     rawt = resolve_set(sns_id, sub.object, sub.relation)
+                    skey = (sns_id, sub.object, sub.relation)
                 if rawt >= 0:
                     t = int(raw2dev[rawt])
+                elif ov_set and skey is not None:
+                    t = ov_set.get(skey, -1)
             else:
                 continue  # nil subject → denied
-            if 0 <= t < nl:
+            if 0 <= t < nl or (t >= nl and snap.is_answerable_target(t)):
                 tg[i] = t
             sd[i] = start_dev
             if starts is not None:
@@ -677,7 +822,7 @@ class TpuCheckEngine:
                 static = starts[starts >= nl]
                 hop = np.zeros(0, np.int64)
                 if static.size:
-                    nbrs, _ = _csr_gather(snap.fwd_indptr, snap.fwd_indices, static)
+                    nbrs, _ = snap.out_neighbors_bulk(static)
                     if nbrs.size:
                         # cross-start dedup: two static starts of one query
                         # may share an out-neighbor, and scatter-add bits
@@ -775,7 +920,11 @@ class TpuCheckEngine:
         m_stat = sd >= nl
         if m_stat.any():
             s = sd[m_stat]
-            cnt[m_stat] = ip[s + 1] - ip[s]
+            in_b = s < snap.n_base_nodes
+            c = np.ones(s.shape[0], np.int64)  # overlay adjacency ≈ small
+            sb = s[in_b]
+            c[in_b] = ip[sb + 1] - ip[sb]
+            cnt[m_stat] = c
         has_start = m_int | m_stat
         for i, (live, hop) in multi.items():
             cnt[i] = live.size + hop.size
@@ -901,9 +1050,12 @@ class TpuCheckEngine:
             # no query in the chunk reaches the device: host_ans is the
             # whole answer
             return None, host_ans
+        ov = snap.device_overlay
         dev = _check_kernel(
             snap.device_buckets,
             *(jnp.asarray(a) for a in packed),
+            ov_nbrs=None if ov is None else ov[0],
+            ov_dst=None if ov is None else ov[1],
             n_active=snap.num_active,
             n_int=snap.num_int,
             valid_rows=tuple(b.n for b in snap.buckets),

@@ -1,0 +1,234 @@
+"""Insert-only delta application: extend a snapshot without rebuilding it.
+
+The reference serves reads during writes through SQL MVCC — a transactional
+insert never stalls readers (reference
+internal/persistence/sql/relationtuples.go:271-278). The TPU analog cannot
+re-intern and re-lay-out the device graph per write (seconds at 1M+ tuples),
+so insert-only watermark advances apply as an **overlay** on the immutable
+base snapshot:
+
+- new nodes get device ids ≥ ``base.n_base_nodes``. They never need bitmap
+  rows: a brand-new set key seen as a tuple's LHS has only out-edges
+  (static-class), one seen as a subject has only in-edges (sink-class), and
+  new subject-ID leaves are always sinks;
+- new edges partition by endpoint class:
+
+  * static source → host one-hop adjacency (``ov_out``), consulted by the
+    engine's batch-setup propagation;
+  * interior source → active-interior destination → the **overlay ELL**: a
+    tiny ``[K, C]`` gather matrix applied as an extra scatter-OR stage in
+    every BFS pull (tpu_engine.check_step), so multi-hop paths through delta
+    edges converge exactly like base edges;
+  * interior source → sink destination → answer-gather overlay
+    (``ov_sink_in``);
+
+- a delta tuple also attaches to every **existing wildcard set node** whose
+  pattern matches it, mirroring the base builder's wildcard expansion
+  (keto_tpu/graph/interner.py intern_rows pass 2);
+- anything that would change an existing node's class — a sink gaining an
+  out-edge, a static node gaining an in-edge, an edge into a
+  passive-interior row (which the BFS loop never updates), a new
+  wildcard-bearing key (whose out-edges require a full tuple scan), an
+  overlay node transitioning class — and any delete returns ``None``:
+  the caller falls back to a full rebuild.
+
+``apply_delta`` is pure: it returns a NEW GraphSnapshot sharing the base's
+arrays (in-flight batches keep using the old object), with the overlay
+containers copied-and-extended.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import FrozenSet, Iterable, Optional
+
+import numpy as np
+
+from keto_tpu.graph.snapshot import GraphSnapshot
+
+
+def _merged(old: Optional[dict]) -> dict:
+    return dict(old) if old else {}
+
+
+def apply_delta(
+    base: GraphSnapshot,
+    rows: Iterable,
+    new_watermark: int,
+    wild_ns_ids: FrozenSet[int],
+) -> Optional[GraphSnapshot]:
+    """Overlay ``rows`` (InternalRow-shaped inserts since the base
+    watermark) onto ``base``. Returns the extended snapshot, or ``None``
+    when the delta needs a full rebuild."""
+    if wild_ns_ids != base.wild_ns_ids:
+        return None  # namespace config changed — wildcard expansion differs
+    rows = list(rows)
+    ni = base.num_int
+    na = base.num_active
+    nl = base.num_live
+    nb = base.n_base_nodes
+
+    ov_set = _merged(base.ov_set_ids)
+    ov_leaf = _merged(base.ov_leaf_ids)
+    ov_out = {k: v for k, v in (base.ov_out or {}).items()}
+    ov_sink_in = {k: v for k, v in (base.ov_sink_in or {}).items()}
+    ell = [tuple(e) for e in (base.ov_ell or ())]
+    nxt = base.ov_next or nb
+
+    # overlay node classes: "static" = out-edges only, "sink" = in-edges only
+    ov_class: dict[int, str] = dict(base.ov_class or {})
+
+    interned = base.interned
+    raw2dev = base.raw2dev
+
+    def resolve_or_new_set(ns_id: int, obj: str, rel: str):
+        raw = interned.resolve_set(ns_id, obj, rel)
+        if raw >= 0:
+            return int(raw2dev[raw]), False
+        dev = ov_set.get((ns_id, obj, rel))
+        if dev is not None:
+            return dev, False
+        return None, True
+
+    def resolve_or_new_leaf(s: str):
+        raw = interned.resolve_leaf(s)
+        if raw >= 0:
+            return int(raw2dev[raw + base.num_sets]), False
+        dev = ov_leaf.get(s)
+        if dev is not None:
+            return dev, False
+        return None, True
+
+    # wildcard base set nodes, for per-row attach matching
+    wild_idx = np.nonzero(np.asarray(interned.key_wild))[0]
+    if wild_idx.size:
+        w_ns = np.asarray(interned.key_ns)[wild_idx]
+        w_obj = np.asarray(interned.key_obj)[wild_idx]
+        w_rel = np.asarray(interned.key_rel)[wild_idx]
+        w_dev = raw2dev[wild_idx]
+        wild_ns_arr = np.asarray(sorted(wild_ns_ids), np.int64)
+        empty_obj = interned.obj_code("")
+        empty_rel = interned.rel_code("")
+
+    new_edges: list[tuple[int, int]] = []
+
+    for r in rows:
+        lhs_wild = (
+            r.namespace_id in wild_ns_ids or r.object == "" or r.relation == ""
+        )
+        # subject node
+        if r.subject_id is not None:
+            sub_dev, is_new = resolve_or_new_leaf(r.subject_id)
+            if is_new:
+                sub_dev = nxt
+                nxt += 1
+                ov_leaf[r.subject_id] = sub_dev
+                ov_class[sub_dev] = "sink"
+        else:
+            sub_wild = (
+                r.sset_namespace_id in wild_ns_ids
+                or r.sset_object == ""
+                or r.sset_relation == ""
+            )
+            sub_key = (r.sset_namespace_id, r.sset_object, r.sset_relation)
+            sub_dev, is_new = resolve_or_new_set(*sub_key)
+            if is_new:
+                if sub_wild:
+                    # a new wildcard key's out-edges need a full tuple scan
+                    return None
+                sub_dev = nxt
+                nxt += 1
+                ov_set[sub_key] = sub_dev
+                ov_class[sub_dev] = "sink"
+            elif sub_dev >= nb and ov_class.get(sub_dev) == "static":
+                return None  # overlay static node gains an in-edge
+        # LHS node
+        lhs_key = (r.namespace_id, r.object, r.relation)
+        lhs_dev, lhs_new = resolve_or_new_set(*lhs_key)
+        if lhs_new:
+            if lhs_wild:
+                return None  # new wildcard LHS: out-edges need a full scan
+            lhs_dev = nxt
+            nxt += 1
+            ov_set[lhs_key] = lhs_dev
+            ov_class[lhs_dev] = "static"
+        elif lhs_dev >= nb and ov_class.get(lhs_dev) == "sink":
+            return None  # overlay sink node gains an out-edge
+        elif ni <= lhs_dev < nl:
+            return None  # base sink gains an out-edge: needs a bitmap row
+        if lhs_dev != sub_dev:
+            # a self-loop adds nothing to reachability — but wildcard
+            # attachment below still applies to the tuple
+            new_edges.append((lhs_dev, sub_dev))
+
+        # attach to every existing wildcard set node matching this tuple
+        # (the base builder's pass-2 expansion, incrementally)
+        if wild_idx.size:
+            m = np.isin(w_ns, wild_ns_arr) | (w_ns == r.namespace_id)
+            oc = interned.obj_code(r.object)
+            m &= (w_obj == empty_obj) | ((w_obj == oc) if oc >= 0 else False)
+            rc = interned.rel_code(r.relation)
+            m &= (w_rel == empty_rel) | ((w_rel == rc) if rc >= 0 else False)
+            for wdev in w_dev[m]:
+                wdev = int(wdev)
+                if wdev == lhs_dev or wdev == sub_dev:
+                    continue
+                if ni <= wdev < nl:
+                    return None  # wildcard node is a base sink (shouldn't
+                    # happen: it has out-edges) — be safe
+                new_edges.append((wdev, sub_dev))
+
+    # classify + partition the new edges
+    add_out: dict[int, list[int]] = {}
+    add_sink_in: dict[int, list[int]] = {}
+    for src, dst in new_edges:
+        dst_interior = dst < ni
+        dst_sinkish = (ni <= dst < nl) or (dst >= nb and ov_class.get(dst) == "sink")
+        if dst >= nl and dst < nb:
+            return None  # base static node gains an in-edge
+        src_interior = src < ni
+        src_staticish = (nl <= src < nb) or (src >= nb and ov_class.get(src) == "static")
+        if not (src_interior or src_staticish):
+            return None  # source would need class change
+        if src_interior and dst_interior:
+            if dst >= na:
+                return None  # passive-interior row: the BFS loop never
+                # updates it, so a new in-edge from an interior source
+                # needs a relayout
+            ell.append((src, dst))
+        elif src_staticish:
+            add_out.setdefault(src, []).append(dst)
+        else:  # interior src → sink-class dst
+            add_sink_in.setdefault(dst, []).append(src)
+
+    for src, dsts in add_out.items():
+        old = ov_out.get(src)
+        merged = np.asarray(dsts, np.int64) if old is None else np.concatenate(
+            [old, np.asarray(dsts, np.int64)]
+        )
+        ov_out[src] = np.unique(merged)
+    for dst, srcs in add_sink_in.items():
+        old = ov_sink_in.get(dst)
+        merged = np.asarray(srcs, np.int32) if old is None else np.concatenate(
+            [old, np.asarray(srcs, np.int32)]
+        )
+        ov_sink_in[dst] = np.unique(merged)
+
+    ell_arr = None
+    if ell:
+        ell_arr = np.unique(np.asarray(ell, np.int64), axis=0)
+
+    return dataclasses.replace(
+        base,
+        snapshot_id=new_watermark,
+        ov_set_ids=ov_set,
+        ov_leaf_ids=ov_leaf,
+        ov_class=ov_class,
+        ov_next=nxt,
+        ov_out=ov_out,
+        ov_sink_in=ov_sink_in,
+        ov_ell=ell_arr,
+        device_overlay=None,  # engine re-uploads (cheap: overlay is small)
+        _pattern_cache={},
+        _cache_lock=__import__("threading").Lock(),
+    )

@@ -64,6 +64,26 @@ def _ceil_pow2(x: int) -> int:
     return 1 if x <= 1 else 1 << (int(x) - 1).bit_length()
 
 
+def _csr_gather_host(indptr: np.ndarray, indices: np.ndarray, nodes: np.ndarray):
+    """(all out-neighbors of ``nodes`` concatenated, per-node counts)."""
+    cnts = indptr[nodes + 1] - indptr[nodes]
+    return _csr_gather_counts(indptr, indices, nodes, cnts)
+
+
+def _csr_gather_counts(
+    indptr: np.ndarray, indices: np.ndarray, nodes: np.ndarray, cnts: np.ndarray
+):
+    """CSR gather with caller-supplied per-node counts (callers zero the
+    counts of nodes that must contribute nothing, e.g. overlay ids that are
+    out of the base CSR's range)."""
+    total = int(cnts.sum())
+    if not total:
+        return np.zeros(0, indices.dtype), cnts
+    base = np.repeat(indptr[nodes], cnts)
+    within = np.arange(total) - np.repeat(np.cumsum(cnts) - cnts, cnts)
+    return indices[base + within], cnts
+
+
 @dataclass
 class Bucket:
     """One live-in-degree bucket: ``nbrs[i, j]`` is the device id of the
@@ -109,6 +129,24 @@ class GraphSnapshot:
     sink_indptr: Optional[np.ndarray] = None  # int64 [num_live-num_int+1]
     sink_indices: Optional[np.ndarray] = None  # int32
     device_buckets: Any = None  # jnp arrays, populated lazily by the engine
+
+    # -- delta overlay (keto_tpu/graph/overlay.py) ---------------------------
+    # Insert-only writes since the base build live in a small overlay
+    # instead of forcing a full re-intern + relayout: new nodes get fresh
+    # device ids ≥ ``n_base_nodes`` (they never need bitmap rows — class
+    # transitions that would require one trigger a full rebuild), new
+    # static→x edges extend the host one-hop adjacency, new edges into
+    # sinks extend the answer gathers, and new interior→interior edges form
+    # a tiny device-side "overlay ELL" applied as an extra scatter stage in
+    # every BFS pull (tpu_engine.check_step).
+    ov_set_ids: Optional[dict] = None  # (ns_id, obj, rel) → overlay dev id
+    ov_leaf_ids: Optional[dict] = None  # subject str → overlay dev id
+    ov_class: Optional[dict] = None  # overlay dev id → "static" | "sink"
+    ov_next: int = 0  # next free overlay device id
+    ov_out: Optional[dict] = None  # src dev → np.int64[...] out-neighbor devs
+    ov_sink_in: Optional[dict] = None  # sink dev → np.int32[...] interior srcs
+    ov_ell: Optional[np.ndarray] = None  # int64 [K, 2] (src, dst) edges
+    device_overlay: Any = None  # (ov_nbrs, ov_dst) jnp arrays or None
     _pattern_cache: dict = field(default_factory=dict)
     _cache_lock: threading.Lock = field(default_factory=threading.Lock)
 
@@ -117,17 +155,109 @@ class GraphSnapshot:
         return self.num_sets + self.num_leaves
 
     @property
+    def n_base_nodes(self) -> int:
+        """Device ids below this are base nodes (classifiable by range);
+        ids in [n_base_nodes, ov_next) are overlay nodes."""
+        return self.n_nodes
+
+    @property
     def n_edges(self) -> int:
-        return 0 if self.fwd_indices is None else int(self.fwd_indices.shape[0])
+        base = 0 if self.fwd_indices is None else int(self.fwd_indices.shape[0])
+        ov = 0
+        if self.ov_out:
+            ov = sum(v.size for v in self.ov_out.values())
+        if self.ov_ell is not None:
+            ov += int(self.ov_ell.shape[0])
+        if self.ov_sink_in:
+            ov += sum(v.size for v in self.ov_sink_in.values())
+        return base + ov
 
 
     def resolve_set(self, ns_id: int, obj: str, rel: str) -> Optional[int]:
         raw = self.interned.resolve_set(ns_id, obj, rel)
-        return None if raw < 0 else int(self.raw2dev[raw])
+        if raw >= 0:
+            return int(self.raw2dev[raw])
+        if self.ov_set_ids is not None:
+            return self.ov_set_ids.get((ns_id, obj, rel))
+        return None
 
     def resolve_leaf(self, subject_id: str) -> Optional[int]:
         raw = self.interned.resolve_leaf(subject_id)
-        return None if raw < 0 else int(self.raw2dev[raw + self.num_sets])
+        if raw >= 0:
+            return int(self.raw2dev[raw + self.num_sets])
+        if self.ov_leaf_ids is not None:
+            return self.ov_leaf_ids.get(subject_id)
+        return None
+
+    def is_answerable_target(self, dev: int) -> bool:
+        """True when a query targeting device node ``dev`` can be granted:
+        the node has in-edges AND either a bitmap row (interior), answer
+        gathers (sink), or overlay in-edges (sink-class overlay nodes —
+        whose in-edges may live purely in the host one-hop adjacency)."""
+        if dev < self.num_live:
+            return True
+        if self.ov_class is not None and self.ov_class.get(dev) == "sink":
+            return True
+        if self.ov_sink_in is not None and dev in self.ov_sink_in:
+            return True
+        return False
+
+    def out_neighbors_bulk(self, nodes: np.ndarray):
+        """(concatenated out-neighbor devs of ``nodes``, per-node counts) —
+        base forward CSR merged with the delta overlay's adjacency (new
+        tuples since the base build). Node order is preserved; neighbor
+        order within a node is unspecified."""
+        nodes = np.asarray(nodes)
+        nb = self.n_base_nodes
+        if self.ov_out is None or not self.ov_out:
+            return _csr_gather_host(self.fwd_indptr, self.fwd_indices, nodes)
+        in_base = nodes < nb
+        base_nodes = np.where(in_base, nodes, 0)
+        cnts = np.where(
+            in_base, self.fwd_indptr[base_nodes + 1] - self.fwd_indptr[base_nodes], 0
+        )
+        rows, cnts = _csr_gather_counts(self.fwd_indptr, self.fwd_indices, base_nodes, cnts)
+        ov = self.ov_out
+        member = np.asarray([int(n) in ov for n in nodes], bool)
+        if not member.any():
+            return rows, cnts
+        ends = np.cumsum(cnts)
+        mi = np.nonzero(member)[0]
+        extras = [np.asarray(ov[int(nodes[i])], rows.dtype) for i in mi]
+        lens = np.asarray([e.size for e in extras], np.int64)
+        rows = np.insert(rows, np.repeat(ends[mi], lens), np.concatenate(extras))
+        cnts = cnts.copy()
+        cnts[mi] += lens
+        return rows, cnts
+
+    def sink_in_rows_bulk(self, sinks: np.ndarray):
+        """(concatenated interior in-neighbor rows of sink-class targets,
+        per-target counts) — base sink reverse CSR merged with overlay
+        in-edges. ``sinks`` are device ids (base sinks or overlay nodes)."""
+        sinks = np.asarray(sinks)
+        ni, nl = self.num_int, self.num_live
+        if self.ov_sink_in is None or not self.ov_sink_in:
+            return _csr_gather_host(self.sink_indptr, self.sink_indices, sinks - ni)
+        in_base = (sinks >= ni) & (sinks < nl)
+        base_idx = np.where(in_base, sinks - ni, 0)
+        cnts = np.where(
+            in_base,
+            self.sink_indptr[base_idx + 1] - self.sink_indptr[base_idx],
+            0,
+        )
+        rows, cnts = _csr_gather_counts(self.sink_indptr, self.sink_indices, base_idx, cnts)
+        ov = self.ov_sink_in
+        member = np.asarray([int(s) in ov for s in sinks], bool)
+        if not member.any():
+            return rows, cnts
+        ends = np.cumsum(cnts)
+        mi = np.nonzero(member)[0]
+        extras = [np.asarray(ov[int(sinks[i])], rows.dtype) for i in mi]
+        lens = np.asarray([e.size for e in extras], np.int64)
+        rows = np.insert(rows, np.repeat(ends[mi], lens), np.concatenate(extras))
+        cnts = cnts.copy()
+        cnts[mi] += lens
+        return rows, cnts
 
     def resolve_starts(self, ns_id: int, obj: str, rel: str) -> np.ndarray:
         """Device ids of the set nodes a check starting at ``(ns, obj, rel)``
@@ -161,6 +291,18 @@ class GraphSnapshot:
             code = self.interned.rel_code(rel)
             m &= (self.interned.key_rel == code) if code >= 0 else False
         starts = self.raw2dev[: self.num_sets][np.nonzero(m)[0]]
+        if self.ov_set_ids:
+            # overlay keys are always fully literal (a new wildcard key
+            # forces a full rebuild), so pattern-match them directly
+            extra = [
+                dev
+                for (k_ns, k_obj, k_rel), dev in self.ov_set_ids.items()
+                if (ns_wild or k_ns == ns_id)
+                and (obj == "" or k_obj == obj)
+                and (rel == "" or k_rel == rel)
+            ]
+            if extra:
+                starts = np.concatenate([starts, np.asarray(extra, np.int64)])
         with self._cache_lock:
             self._pattern_cache[key] = starts
         return starts

@@ -90,6 +90,9 @@ class InternalRow:
 class _SharedState:
     """Rows shared across per-network persister views."""
 
+    #: insert-log rows kept for delta snapshots; past this, readers rebuild
+    LOG_CAP = 65536
+
     def __init__(self):
         self.lock = threading.RLock()
         self.rows: dict[str, list[InternalRow]] = {}  # nid -> rows
@@ -101,6 +104,12 @@ class _SharedState:
         # the engines' fully-literal traversal queries without a scan.
         # Rebuilt lazily after writes.
         self.lhs_index: Optional[dict[tuple, list[InternalRow]]] = None
+        # insert log for delta snapshots (keto_tpu/graph/overlay.py):
+        # (watermark, row) per inserted row, per network; any delete bumps
+        # delete_wm, invalidating deltas from before it
+        self.insert_log: dict[str, list[tuple[int, InternalRow]]] = {}
+        self.delete_wm: dict[str, int] = {}
+        self.log_floor: dict[str, int] = {}
 
 
 class MemoryPersister(Manager):
@@ -271,6 +280,18 @@ class MemoryPersister(Manager):
                 ]
             self._shared.lhs_index = None
             self._shared.watermark += 1
+            wm = self._shared.watermark
+            nid = self.network_id
+            if delete_keys:
+                # deletes invalidate any delta from before this point
+                self._shared.delete_wm[nid] = wm
+            if new_rows:
+                log = self._shared.insert_log.setdefault(nid, [])
+                log.extend((wm, r) for r in new_rows)
+                if len(log) > self._shared.LOG_CAP:
+                    drop = len(log) - self._shared.LOG_CAP
+                    self._shared.log_floor[nid] = log[drop - 1][0]
+                    del log[:drop]
 
     def watermark(self) -> int:
         with self._shared.lock:
@@ -282,3 +303,17 @@ class MemoryPersister(Manager):
         """Consistent (rows, watermark) view for the TPU graph builder."""
         with self._shared.lock:
             return list(self._rows()), self._shared.watermark
+
+    def rows_since(self, watermark: int):
+        """Rows inserted after ``watermark`` as ``(rows, new_watermark)``,
+        or ``None`` when a delta can't be produced (a delete happened since,
+        or the insert log no longer reaches back that far) — the seam the
+        TPU engine's delta-overlay snapshot path builds on."""
+        nid = self.network_id
+        with self._shared.lock:
+            if self._shared.delete_wm.get(nid, 0) > watermark:
+                return None
+            if self._shared.log_floor.get(nid, 0) > watermark:
+                return None
+            log = self._shared.insert_log.get(nid, ())
+            return [r for w, r in log if w > watermark], self._shared.watermark

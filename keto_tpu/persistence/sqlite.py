@@ -103,6 +103,14 @@ MIGRATIONS: list[tuple[str, str, str]] = [
         """,
         "DROP TABLE keto_watermarks",
     ),
+    (
+        # delete watermark: lets snapshot readers tell insert-only advances
+        # (delta-overlayable, keto_tpu/graph/overlay.py) from ones that
+        # removed rows (full rebuild) in O(1)
+        "20210623000005_delete_watermark",
+        "ALTER TABLE keto_watermarks ADD COLUMN delete_wm INTEGER NOT NULL DEFAULT 0",
+        "ALTER TABLE keto_watermarks DROP COLUMN delete_wm",
+    ),
 ]
 
 _ORDER = (
@@ -351,6 +359,12 @@ class SQLitePersister(Manager):
                         "ON CONFLICT(nid) DO UPDATE SET watermark = watermark + 1",
                         (self.network_id,),
                     )
+                    if del_rows:
+                        self._conn.execute(
+                            "UPDATE keto_watermarks SET delete_wm = watermark "
+                            "WHERE nid = ?",
+                            (self.network_id,),
+                        )
                 self._conn.execute("COMMIT")
             except Exception:
                 self._conn.execute("ROLLBACK")
@@ -375,6 +389,30 @@ class SQLitePersister(Manager):
                 (self.network_id,),
             ).fetchall()
             wm = self.watermark()
+        return [InternalRow(*r[:7], seq=r[7]) for r in rows], wm
+
+    def rows_since(self, watermark: int):
+        """Rows inserted after ``watermark`` as ``(rows, new_watermark)``,
+        or ``None`` when a delete happened since (the delta-overlay seam —
+        commit_time doubles as the insert log, so this is one indexed
+        range read plus an O(1) delete-watermark check)."""
+        with self._lock:
+            meta = self._conn.execute(
+                "SELECT watermark, delete_wm FROM keto_watermarks WHERE nid = ?",
+                (self.network_id,),
+            ).fetchone()
+            if meta is None:
+                return [], 0
+            wm, delete_wm = meta
+            if delete_wm > watermark:
+                return None
+            rows = self._conn.execute(
+                "SELECT namespace_id, object, relation, subject_id, "
+                "subject_set_namespace_id, subject_set_object, subject_set_relation, "
+                "commit_time FROM keto_relation_tuples "
+                "WHERE nid = ? AND commit_time > ?",
+                (self.network_id, watermark),
+            ).fetchall()
         return [InternalRow(*r[:7], seq=r[7]) for r in rows], wm
 
 

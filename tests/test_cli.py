@@ -64,12 +64,12 @@ def test_migrate_cycle(tmp_path):
     cfgf.write_text(yaml.safe_dump({"dsn": f"sqlite://{db}", "namespaces": [{"id": 0, "name": "n"}]}))
 
     result = run(["migrate", "status", "-c", str(cfgf)])
-    assert result.output.count("pending") == 5
+    assert result.output.count("pending") == 6
 
     result = run(["migrate", "up", "-c", str(cfgf), "--yes"])
-    assert "applied 5 migrations" in result.output
+    assert "applied 6 migrations" in result.output
     result = run(["migrate", "status", "-c", str(cfgf)])
-    assert result.output.count("applied") >= 5 and "pending" not in result.output
+    assert result.output.count("applied") >= 6 and "pending" not in result.output
 
     result = run(["migrate", "up", "-c", str(cfgf), "--yes"])
     assert "nothing to do" in result.output
