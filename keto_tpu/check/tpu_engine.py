@@ -442,7 +442,9 @@ class TpuCheckEngine:
             row_axis = GRAPH_AXIS if shard_rows else None
             self._bitmap_sharding = NamedSharding(mesh, P(row_axis, DATA_AXIS))
             self._bucket_sharding = NamedSharding(mesh, P(GRAPH_AXIS, None))
-            self._replicated = NamedSharding(mesh, P(None, None))
+            # P() is rank-agnostic full replication — the overlay upload
+            # puts both 2-D (nbrs) and 1-D (dst_pad) arrays through it
+            self._replicated = NamedSharding(mesh, P())
         self._lock = threading.Lock()
         self._snapshot: Optional[GraphSnapshot] = None
         # delta overlays beyond this edge count trigger a full rebuild (the
@@ -686,18 +688,23 @@ class TpuCheckEngine:
             self._resolve_specials(snap, tuples, special, sd, tg, multi)
         if snap.ov_set_ids or snap.ov_leaf_ids:
             # nodes created since the base build are invisible to the
-            # resident C++ tables — re-resolve the (few) queries whose
-            # start or target missed, through the overlay-aware host path
+            # resident C++ tables — re-resolve the queries whose start or
+            # target missed through the overlay-aware host path, in ONE
+            # bulk call (tg == nl includes every guaranteed deny, so
+            # deny-heavy workloads would otherwise loop per query)
             done = set(special) | set(dead)
-            miss = np.nonzero((sd == -1) | (tg == nl))[0]
-            for i in miss:
-                if int(i) in done:
-                    continue
-                s1, t1, m1 = self._resolve_bulk_py(snap, [tuples[i]])
-                sd[i] = s1[0]
-                tg[i] = t1[0]
-                if 0 in m1:
-                    multi[i] = m1[0]
+            miss = [
+                int(i)
+                for i in np.nonzero((sd == -1) | (tg == nl))[0]
+                if int(i) not in done
+            ]
+            if miss:
+                s1, t1, m1 = self._resolve_bulk_py(snap, [tuples[i] for i in miss])
+                for j, i in enumerate(miss):
+                    sd[i] = s1[j]
+                    tg[i] = t1[j]
+                    if j in m1:
+                        multi[i] = m1[j]
         return sd, tg, multi
 
     def _resolve_specials(self, snap, tuples, indices, sd, tg, multi):

@@ -72,7 +72,7 @@ def apply_delta(
     ov_leaf = _merged(base.ov_leaf_ids)
     ov_out = {k: v for k, v in (base.ov_out or {}).items()}
     ov_sink_in = {k: v for k, v in (base.ov_sink_in or {}).items()}
-    ell = [tuple(e) for e in (base.ov_ell or ())]
+    ell = [tuple(e) for e in (() if base.ov_ell is None else base.ov_ell)]
     nxt = base.ov_next or nb
 
     # overlay node classes: "static" = out-edges only, "sink" = in-edges only
@@ -181,7 +181,21 @@ def apply_delta(
     # classify + partition the new edges
     add_out: dict[int, list[int]] = {}
     add_sink_in: dict[int, list[int]] = {}
+    fwd_indptr = base.fwd_indptr
+    fwd_indices = base.fwd_indices
+
+    def in_base_csr(src: int, dst: int) -> bool:
+        # re-inserting an existing tuple (legal: duplicate inserts create
+        # additional store rows) must not duplicate the graph edge —
+        # out-neighbor lists feed pack_chunk's disjoint-bit scatter-ADD
+        if src >= nb:
+            return False
+        a, b = fwd_indptr[src], fwd_indptr[src + 1]
+        return bool(np.any(fwd_indices[a:b] == dst))
+
     for src, dst in new_edges:
+        if in_base_csr(src, dst):
+            continue
         dst_interior = dst < ni
         dst_sinkish = (ni <= dst < nl) or (dst >= nb and ov_class.get(dst) == "sink")
         if dst >= nl and dst < nb:

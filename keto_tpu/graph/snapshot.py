@@ -209,15 +209,24 @@ class GraphSnapshot:
         order within a node is unspecified."""
         nodes = np.asarray(nodes)
         nb = self.n_base_nodes
-        if self.ov_out is None or not self.ov_out:
-            return _csr_gather_host(self.fwd_indptr, self.fwd_indices, nodes)
-        in_base = nodes < nb
-        base_nodes = np.where(in_base, nodes, 0)
-        cnts = np.where(
-            in_base, self.fwd_indptr[base_nodes + 1] - self.fwd_indptr[base_nodes], 0
-        )
-        rows, cnts = _csr_gather_counts(self.fwd_indptr, self.fwd_indices, base_nodes, cnts)
+        if nodes.size and int(nodes.max()) >= nb:
+            # overlay ids are out of the base CSR's range — contribute 0
+            # base neighbors (their adjacency, if any, lives in ov_out)
+            in_base = nodes < nb
+            base_nodes = np.where(in_base, nodes, 0)
+            cnts = np.where(
+                in_base,
+                self.fwd_indptr[base_nodes + 1] - self.fwd_indptr[base_nodes],
+                0,
+            )
+            rows, cnts = _csr_gather_counts(
+                self.fwd_indptr, self.fwd_indices, base_nodes, cnts
+            )
+        else:
+            rows, cnts = _csr_gather_host(self.fwd_indptr, self.fwd_indices, nodes)
         ov = self.ov_out
+        if ov is None or not ov:
+            return rows, cnts
         member = np.asarray([int(n) in ov for n in nodes], bool)
         if not member.any():
             return rows, cnts

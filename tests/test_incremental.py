@@ -35,7 +35,11 @@ def make_store():
 
 def is_delta(snap):
     return snap.ov_set_ids is not None and (
-        snap.ov_set_ids or snap.ov_leaf_ids or snap.ov_out or snap.ov_sink_in
+        snap.ov_set_ids
+        or snap.ov_leaf_ids
+        or snap.ov_out
+        or snap.ov_sink_in
+        or snap.ov_ell is not None
     )
 
 
@@ -155,6 +159,85 @@ def test_wildcard_node_attaches_delta_tuples():
             T("d", "doc", "view", SubjectID("eve")),
         ],
     )
+
+
+def test_reinserted_tuple_does_not_duplicate_edge():
+    # re-inserting an existing tuple is legal (duplicate inserts create
+    # additional store rows) and must NOT duplicate the graph edge: the
+    # out-neighbor lists feed pack_chunk's disjoint-bit scatter-ADD, so a
+    # duplicate neighbor would carry the bit into the adjacent query
+    p = make_store()
+    p.write_relation_tuples(
+        T("d", "doc", "view", SubjectSet("g", "team", "member")),
+        T("g", "team", "member", SubjectID("alice")),
+    )
+    engine = TpuCheckEngine(p, p.namespaces)
+    engine.snapshot()
+    # re-insert the static→interior tuple as a delta
+    p.write_relation_tuples(T("d", "doc", "view", SubjectSet("g", "team", "member")))
+    snap = engine.snapshot()
+    assert snap.ov_set_ids is not None  # delta path taken, not a rebuild
+    rows, cnts = snap.out_neighbors_bulk(
+        __import__("numpy").asarray([snap.resolve_set(2, "doc", "view")])
+    )
+    assert cnts.tolist() == [1], "duplicate edge in merged out-neighbors"
+    assert_parity(
+        engine,
+        p,
+        [
+            T("d", "doc", "view", SubjectID("alice")),
+            T("d", "doc", "view", SubjectID("bob")),
+        ],
+    )
+
+
+def test_overlay_lhs_with_empty_ov_out_does_not_crash():
+    # a delta adding interior-lhs → NEW subject set populates only
+    # ov_sink_in (ov_out stays empty); a later check using the new set key
+    # as LHS must not index the base CSR with the overlay id
+    p = make_store()
+    p.write_relation_tuples(
+        T("d", "doc", "view", SubjectSet("g", "team", "member")),
+        T("g", "team", "member", SubjectID("alice")),
+    )
+    engine = TpuCheckEngine(p, p.namespaces)
+    engine.snapshot()
+    p.write_relation_tuples(T("g", "team", "member", SubjectSet("g", "newset", "x")))
+    snap = engine.snapshot()
+    assert is_delta(snap) and not snap.ov_out
+    assert_parity(
+        engine,
+        p,
+        [
+            T("g", "newset", "x", SubjectID("alice")),  # overlay id as LHS
+            T("d", "doc", "view", SubjectSet("g", "newset", "x")),
+            T("g", "newset", "x", SubjectSet("g", "newset", "x")),
+        ],
+    )
+
+
+def test_overlay_upload_sharding_rank():
+    # the overlay ELL upload places a 1-D dst_pad array — the replication
+    # spec must be rank-agnostic or every mesh deployment crashes on the
+    # first delta refresh carrying overlay-ELL edges
+    from keto_tpu.parallel.mesh import make_mesh
+
+    p = make_store()
+    p.write_relation_tuples(
+        T("d", "doc", "view", SubjectSet("g", "g1", "m")),
+        T("g", "g1", "m", SubjectSet("g", "g2", "m")),
+        T("g", "g2", "m", SubjectID("u1")),
+        T("d", "doc2", "view", SubjectSet("g", "h1", "m")),
+        T("g", "h1", "m", SubjectSet("g", "h2", "m")),
+        T("g", "h2", "m", SubjectID("u2")),
+    )
+    mesh = make_mesh()
+    engine = TpuCheckEngine(p, p.namespaces, mesh=mesh, shard_rows=True)
+    engine.snapshot()
+    p.write_relation_tuples(T("g", "g2", "m", SubjectSet("g", "h2", "m")))
+    snap = engine.snapshot()  # crashed with ValueError before the fix
+    assert snap.ov_ell is not None and snap.device_overlay is not None
+    assert_parity(engine, p, [T("d", "doc", "view", SubjectID("u2"))])
 
 
 @pytest.mark.parametrize(
