@@ -441,10 +441,12 @@ class TpuCheckEngine:
 
             row_axis = GRAPH_AXIS if shard_rows else None
             self._bitmap_sharding = NamedSharding(mesh, P(row_axis, DATA_AXIS))
+            # narrow batches (W words < data-axis size) can't meaningfully
+            # split words: constraining them anyway sends SPMD down its
+            # replicate-then-partition fallback on every BFS-step gather
+            self._bitmap_sharding_rows_only = NamedSharding(mesh, P(row_axis))
             self._bucket_sharding = NamedSharding(mesh, P(GRAPH_AXIS, None))
-            # P() is rank-agnostic full replication — the overlay upload
-            # puts both 2-D (nbrs) and 1-D (dst_pad) arrays through it
-            self._replicated = NamedSharding(mesh, P())
+            self._ov_dst_sharding = NamedSharding(mesh, P(GRAPH_AXIS))
         self._lock = threading.Lock()
         self._snapshot: Optional[GraphSnapshot] = None
         # delta overlays beyond this edge count trigger a full rebuild (the
@@ -545,17 +547,23 @@ class TpuCheckEngine:
     def _upload_buckets(self, snap: GraphSnapshot) -> None:
         if self._mesh is None:
             snap.device_buckets = tuple(jax.device_put(b.nbrs) for b in snap.buckets)
-        else:
-            graph_size = self._mesh.shape.get("graph", 1)
-            snap.device_buckets = tuple(
-                jax.device_put(
-                    b.nbrs,
-                    self._bucket_sharding
-                    if b.nbrs.shape[0] % graph_size == 0
-                    else self._replicated,
-                )
-                for b in snap.buckets
-            )
+            return
+        # every bucket row-shards over the graph axis — rows pad up to a
+        # multiple of the axis size with sentinel rows (gathered from the
+        # all-zero bitmap row, discarded by the _pull valid-row slice).
+        # Replicating instead (the old fallback for non-divisible buckets)
+        # made SPMD materialize cross-shard gathers via select+all-reduce
+        # with an "Involuntary full rematerialization" on every BFS step.
+        g = self._mesh.shape.get("graph", 1)
+        dev = []
+        for b in snap.buckets:
+            nbrs = b.nbrs
+            rem = (-nbrs.shape[0]) % g
+            if rem:
+                pad = np.full((rem, nbrs.shape[1]), snap.num_int, np.int32)
+                nbrs = np.concatenate([nbrs, pad], axis=0)
+            dev.append(jax.device_put(nbrs, self._bucket_sharding))
+        snap.device_buckets = tuple(dev)
 
     def _upload_overlay(self, snap: GraphSnapshot) -> None:
         """Group overlay-ELL edges by destination into a [K, C] gather
@@ -572,16 +580,24 @@ class TpuCheckEngine:
         counts = np.diff(np.append(starts, dst.shape[0]))
         K = _ceil_pow2(uniq.shape[0])
         C = _ceil_pow2(int(counts.max()))
+        if self._mesh is not None:
+            # overlay rows shard over the graph axis exactly like buckets
+            # (replicated indices into the row-sharded bitmap would trip
+            # SPMD's full-rematerialization fallback every BFS step)
+            g = self._mesh.shape.get("graph", 1)
+            K += (-K) % g
         nbrs = np.full((K, C), snap.num_int, np.int32)  # all-zero bitmap row
         for i, (s0, c) in enumerate(zip(starts, counts)):
             nbrs[i, :c] = src[s0 : s0 + c]
         dst_pad = np.full(K, snap.num_active, np.int32)  # scatter-dropped
         dst_pad[: uniq.shape[0]] = uniq
-        sharding = None if self._mesh is None else self._replicated
-        snap.device_overlay = (
-            jax.device_put(nbrs, sharding) if sharding else jax.device_put(nbrs),
-            jax.device_put(dst_pad, sharding) if sharding else jax.device_put(dst_pad),
-        )
+        if self._mesh is None:
+            snap.device_overlay = (jax.device_put(nbrs), jax.device_put(dst_pad))
+        else:
+            snap.device_overlay = (
+                jax.device_put(nbrs, self._bucket_sharding),
+                jax.device_put(dst_pad, self._ov_dst_sharding),
+            )
 
     # -- resolution ----------------------------------------------------------
 
@@ -1057,6 +1073,11 @@ class TpuCheckEngine:
             # no query in the chunk reaches the device: host_ans is the
             # whole answer
             return None, host_ans
+        sharding = self._bitmap_sharding
+        if self._mesh is not None:
+            W = packed[-1].shape[0] // 32
+            if W % self._mesh.shape.get("data", 1):
+                sharding = self._bitmap_sharding_rows_only
         ov = snap.device_overlay
         dev = _check_kernel(
             snap.device_buckets,
@@ -1068,7 +1089,7 @@ class TpuCheckEngine:
             valid_rows=tuple(b.n for b in snap.buckets),
             it_cap=self._it_cap,
             block_iters=self._block_iters,
-            bitmap_sharding=self._bitmap_sharding,
+            bitmap_sharding=sharding,
         )
         return dev, host_ans
 
