@@ -110,6 +110,194 @@ def build_workload(rng, n_tuples):
     return tuples, doc_grant, membership, user_reaches, member_of, n_users, T
 
 
+def build_workload_github(rng, n_tuples):
+    """BASELINE config 4: GitHub-style org/team/repo — 5 namespaces with
+    userset rewrites, grant chains up to depth 8.
+
+    Shape: users join teams; teams nest in forests of depth ≤ 4
+    (``teams:team-P#member@teams:team-C#member``); root teams attach to
+    orgs; repos grant ``reader``/``maintainer`` to an org's members or a
+    team's members; issues and pulls grant ``view`` through the repo's
+    reader/maintainer set. The deepest chain is
+    issue→reader→org→root-team→(3 nested teams)→user = 7 edges.
+
+    Returns ``(tuples, ctx)`` where ``ctx`` has the analytic membership
+    maps query construction and expected answers use.
+    """
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    def T(ns, obj, rel, sub):
+        return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+    scale = n_tuples / 10_000_000
+    n_users = max(1_000, int(800_000 * scale))
+    n_teams = max(64, int(120_000 * scale))
+    n_orgs = max(8, int(5_000 * scale))
+    n_repos = max(64, int(250_000 * scale))
+    levels = 4  # team nesting depth
+
+    tuples = []
+    # team forest: contiguous level blocks; level-k teams parent into k-1
+    lvl_bounds = [i * n_teams // levels for i in range(levels + 1)]
+
+    def level_of(t):
+        for k in range(levels):
+            if t < lvl_bounds[k + 1]:
+                return k
+        return levels - 1
+
+    team_parent = {}
+    team_children = {}
+    for t in range(lvl_bounds[1], n_teams):
+        k = level_of(t)
+        parent = rng.randrange(lvl_bounds[k - 1], lvl_bounds[k])
+        team_parent[t] = parent
+        team_children.setdefault(parent, []).append(t)
+        tuples.append(
+            T("teams", f"team-{parent}", "member", SubjectSet("teams", f"team-{t}", "member"))
+        )
+
+    # memoized ancestor chains (self included) + root per team
+    anc_cache = {}
+
+    def ancestors(t):
+        got = anc_cache.get(t)
+        if got is None:
+            chain = [t]
+            while chain[-1] in team_parent:
+                chain.append(team_parent[chain[-1]])
+            got = anc_cache[t] = (frozenset(chain), chain[-1])
+        return got
+
+    # root teams attach to orgs
+    org_roots = {o: [] for o in range(n_orgs)}
+    root_org = {}
+    for r in range(lvl_bounds[1]):
+        o = rng.randrange(n_orgs)
+        org_roots[o].append(r)
+        root_org[r] = o
+        tuples.append(
+            T("orgs", f"org-{o}", "member", SubjectSet("teams", f"team-{r}", "member"))
+        )
+
+    # direct team memberships: the tuple bulk; sized so the total lands
+    # on n_tuples after repos/issues/pulls
+    n_issueish = int(n_tuples * 0.30)
+    budget_members = n_tuples - len(tuples) - 2 * n_repos - n_issueish
+    per_user = max(1, budget_members // n_users)
+    team_users = {}
+    user_teams = {}
+    for u in range(n_users):
+        for _ in range(per_user):
+            t = rng.randrange(n_teams)
+            user_teams.setdefault(u, []).append(t)
+            team_users.setdefault(t, []).append(u)
+            tuples.append(T("teams", f"team-{t}", "member", SubjectID(f"user-{u}")))
+
+    # repos: reader ← org members or a team; maintainer ← a team
+    repo_reader = {}
+    repo_maint = {}
+    for r in range(n_repos):
+        if rng.random() < 0.5:
+            grant = ("org", rng.randrange(n_orgs))
+            sub = SubjectSet("orgs", f"org-{grant[1]}", "member")
+        else:
+            grant = ("team", rng.randrange(n_teams))
+            sub = SubjectSet("teams", f"team-{grant[1]}", "member")
+        repo_reader[r] = grant
+        tuples.append(T("repos", f"repo-{r}", "reader", sub))
+        mt = rng.randrange(n_teams)
+        repo_maint[r] = ("team", mt)
+        tuples.append(
+            T("repos", f"repo-{r}", "maintainer", SubjectSet("teams", f"team-{mt}", "member"))
+        )
+
+    # issues + pulls fill to n_tuples through the repo's reader/maintainer
+    issue_repo = []
+    pull_repo = []
+    while len(tuples) < n_tuples:
+        r = rng.randrange(n_repos)
+        if len(issue_repo) <= len(pull_repo):
+            tuples.append(
+                T("issues", f"issue-{len(issue_repo)}", "view",
+                  SubjectSet("repos", f"repo-{r}", "reader"))
+            )
+            issue_repo.append(r)
+        else:
+            tuples.append(
+                T("pulls", f"pull-{len(pull_repo)}", "view",
+                  SubjectSet("repos", f"repo-{r}", "maintainer"))
+            )
+            pull_repo.append(r)
+
+    def reaches_team(u, t):
+        return any(t in ancestors(dt)[0] for dt in user_teams.get(u, ()))
+
+    def in_org(u, o):
+        roots = set(org_roots[o])
+        return any(ancestors(dt)[1] in roots for dt in user_teams.get(u, ()))
+
+    def grant_ok(u, grant):
+        kind, x = grant
+        return in_org(u, x) if kind == "org" else reaches_team(u, x)
+
+    def member_of_grant(grant):
+        """A user holding ``grant``, or None."""
+        kind, x = grant
+        if kind == "org":
+            roots = org_roots[x]
+            if not roots:
+                return None
+            x = rng.choice(roots)
+        # random downward walk from team x; direct users at any stop
+        for _ in range(8):
+            us = team_users.get(x)
+            if us and rng.random() < 0.5:
+                return rng.choice(us)
+            kids = team_children.get(x)
+            if not kids:
+                return rng.choice(us) if us else None
+            x = rng.choice(kids)
+        us = team_users.get(x)
+        return rng.choice(us) if us else None
+
+    ctx = dict(
+        n_users=n_users,
+        issue_repo=issue_repo,
+        pull_repo=pull_repo,
+        repo_reader=repo_reader,
+        repo_maint=repo_maint,
+        grant_ok=grant_ok,
+        member_of_grant=member_of_grant,
+        T=T,
+    )
+    return tuples, ctx
+
+
+def make_queries_github(rng, n_checks, ctx):
+    """Half engineered grants, half uniform users (mostly denials), over
+    the deepest objects (issues and pulls)."""
+    from keto_tpu.relationtuple.model import SubjectID
+
+    T = ctx["T"]
+    queries, expected = [], []
+    for i in range(n_checks):
+        if i % 2 == 0:
+            j = rng.randrange(len(ctx["issue_repo"]))
+            ns, obj = "issues", f"issue-{j}"
+            grant = ctx["repo_reader"][ctx["issue_repo"][j]]
+        else:
+            j = rng.randrange(len(ctx["pull_repo"]))
+            ns, obj = "pulls", f"pull-{j}"
+            grant = ctx["repo_maint"][ctx["pull_repo"][j]]
+        u = ctx["member_of_grant"](grant) if i % 4 < 2 else None
+        if u is None:
+            u = rng.randrange(ctx["n_users"])
+        queries.append(T(ns, obj, "view", SubjectID(f"user-{u}")))
+        expected.append(ctx["grant_ok"](u, grant))
+    return queries, expected
+
+
 def make_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T):
     """Half the queries target users constructed to hold the grant, half are
     uniform random (almost always denials) — so the analytic expectations
@@ -127,6 +315,121 @@ def make_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T):
         queries.append(T("docs", f"doc-{d}", "view", SubjectID(f"user-{u}")))
         expected.append(user_reaches(u, kind, g))
     return queries, expected
+
+
+def run_config4(rng):
+    """BASELINE config 4: 10M tuples, GitHub-style, depth ≤ 8. Returns a
+    metrics dict (embedded in the headline JSON, plus one JSON line on
+    stderr so the driver tail carries it verbatim)."""
+    import numpy as _np
+
+    from keto_tpu import namespace as namespace_pkg
+    from keto_tpu.check import CheckEngine
+    from keto_tpu.check.tpu_engine import TpuCheckEngine
+    from keto_tpu.persistence.memory import MemoryPersister
+
+    n_tuples = int(os.environ.get("BENCH4_TUPLES", 10_000_000))
+    n_checks = int(os.environ.get("BENCH4_CHECKS", 100_000))
+    oracle_sample = int(os.environ.get("BENCH4_ORACLE_SAMPLE", 500))
+
+    t0 = time.perf_counter()
+    tuples, ctx = build_workload_github(rng, n_tuples)
+    log(f"[c4] workload: {len(tuples)} tuples in {time.perf_counter()-t0:.1f}s")
+
+    nm = namespace_pkg.MemoryManager(
+        [
+            namespace_pkg.Namespace(id=i + 1, name=n)
+            for i, n in enumerate(("orgs", "teams", "repos", "issues", "pulls"))
+        ]
+    )
+    store = MemoryPersister(nm)
+    t0 = time.perf_counter()
+    store.write_relation_tuples(*tuples)
+    ingest_s = time.perf_counter() - t0
+    log(f"[c4] ingest: {ingest_s:.1f}s")
+
+    engine = TpuCheckEngine(store, store.namespaces)
+    t0 = time.perf_counter()
+    snap = engine.snapshot()
+    snapshot_s = time.perf_counter() - t0
+    hbm_buckets = sum(int(b.nbrs.nbytes) for b in snap.buckets)
+    w_max = engine._slice_cap(snap) // 32
+    hbm_bitmaps = 3 * (snap.num_int + 1) * 4 * w_max
+    log(
+        f"[c4] snapshot: {snap.n_nodes} nodes, {snap.n_edges} edges, "
+        f"{snap.num_active} active / {snap.num_int} interior rows in "
+        f"{snapshot_s:.1f}s; HBM ≈ {(hbm_buckets+hbm_bitmaps)/2**30:.2f} GiB "
+        f"(buckets {hbm_buckets/2**30:.2f} + bitmaps {hbm_bitmaps/2**30:.2f} @W={w_max})"
+    )
+
+    queries, expected = make_queries_github(rng, n_checks, ctx)
+
+    t0 = time.perf_counter()
+    engine.batch_check(queries)
+    log(f"[c4] warmup/compile: {time.perf_counter()-t0:.1f}s")
+
+    reps = int(os.environ.get("BENCH_REPS", 3))
+    times = []
+    got = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        got = engine.batch_check(queries)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    tpu_s = times[len(times) // 2]
+    tpu_qps = n_checks / tpu_s
+    log(f"[c4] batch reps: {['%.0f ms' % (t*1e3) for t in times]}")
+
+    # streamed per-slice latency (p50/p99), pipeline-fill slice excluded
+    slice_lat = []
+    stream_got = []
+    t_prev = time.perf_counter()
+    t_start = t_prev
+    for out in engine.batch_check_stream(iter(queries), depth=2):
+        now = time.perf_counter()
+        slice_lat.append(now - t_prev)
+        t_prev = now
+        stream_got.append(out)
+    stream_s = time.perf_counter() - t_start
+    stream_got = _np.concatenate(stream_got)
+    stream_wrong = int((stream_got != _np.asarray(expected)).sum())
+    steady = sorted(slice_lat[1:]) or slice_lat
+    p50 = steady[len(steady) // 2] * 1e3
+    p99 = steady[min(len(steady) - 1, int(len(steady) * 0.99))] * 1e3
+
+    n_wrong = sum(g != e for g, e in zip(got, expected))
+    oracle = CheckEngine(store)
+    sample = queries[:oracle_sample]
+    t0 = time.perf_counter()
+    oracle_got = [oracle.subject_is_allowed(q) for q in sample]
+    oracle_qps = len(sample) / (time.perf_counter() - t0)
+    mismatch = sum(g != o for g, o in zip(got[: len(sample)], oracle_got))
+    log(
+        f"[c4] tpu: {tpu_qps:,.0f} checks/s ({tpu_s*1e3:.1f} ms for {n_checks}); "
+        f"stream p50={p50:.0f} ms p99={p99:.0f} ms wrong={stream_wrong}; "
+        f"oracle: {oracle_qps:,.0f} checks/s; wrong_vs_expected={n_wrong} "
+        f"tpu_vs_oracle_mismatch={mismatch}"
+    )
+    metrics = {
+        "tuples": len(tuples),
+        "checks": n_checks,
+        "nodes": snap.n_nodes,
+        "edges": snap.n_edges,
+        "interior_rows": snap.num_int,
+        "checks_per_s": round(tpu_qps, 1),
+        "tpu_batch_ms_all_reps": [round(t * 1e3, 1) for t in times],
+        "stream_slice_p50_ms": round(p50, 1),
+        "stream_slice_p99_ms": round(p99, 1),
+        "stream_wrong": stream_wrong,
+        "ingest_s": round(ingest_s, 2),
+        "snapshot_build_s": round(snapshot_s, 2),
+        "hbm_bytes_est": hbm_buckets + hbm_bitmaps,
+        "oracle_checks_per_s": round(oracle_qps, 1),
+        "correct_vs_expected": n_wrong == 0,
+        "tpu_oracle_mismatches": mismatch,
+    }
+    log("[c4] " + json.dumps({"metric": "check_throughput_10m_depth8", "value": metrics["checks_per_s"], "unit": "checks/s", "detail": metrics}))
+    return metrics
 
 
 def main():
@@ -231,6 +534,25 @@ def main():
         f"tpu_vs_oracle_mismatch={mismatch_vs_oracle}"
     )
 
+    # BASELINE config 4 (10M tuples, depth ≤ 8) — failures must not lose
+    # the headline JSON line
+    config4 = None
+    n_tuples_built = len(tuples)
+    snap_nodes, snap_edges = snap.n_nodes, snap.n_edges
+    if os.environ.get("BENCH_CONFIG4", "1") != "0":
+        # free config-3's device state (snapshot buckets + jit workspaces)
+        # before the 10M-tuple config claims HBM
+        del tuples, doc_grant, membership, user_reaches, member_of
+        del engine, snap, queries, store
+        import gc
+
+        gc.collect()
+        try:
+            config4 = run_config4(random.Random(1042))
+        except Exception as e:  # pragma: no cover - diagnostic path
+            log(f"[c4] FAILED: {e!r}")
+            config4 = {"error": repr(e)}
+
     print(
         json.dumps(
             {
@@ -239,10 +561,10 @@ def main():
                 "unit": "checks/s",
                 "vs_baseline": round(tpu_qps / oracle_qps, 2),
                 "detail": {
-                    "tuples": len(tuples),
+                    "tuples": n_tuples_built,
                     "checks": n_checks,
-                    "nodes": snap.n_nodes,
-                    "edges": snap.n_edges,
+                    "nodes": snap_nodes,
+                    "edges": snap_edges,
                     "tpu_batch_ms_total": round(tpu_s * 1e3, 1),
                     "tpu_batch_ms_all_reps": [round(t * 1e3, 1) for t in times],
                     "stream_checks_per_s": round(n_stream / stream_s, 1),
@@ -255,6 +577,7 @@ def main():
                     "correct_vs_expected": n_wrong == 0,
                     "tpu_oracle_mismatches": mismatch_vs_oracle,
                     "device": str(jax.devices()[0]),
+                    "config4_10m_depth8": config4,
                 },
             }
         )

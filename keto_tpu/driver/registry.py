@@ -100,8 +100,20 @@ class Registry:
 
         return self._memo("permission_engine", build)
 
-    def expand_engine(self) -> ExpandEngine:
-        return self._memo("expand_engine", lambda: ExpandEngine(self.relation_tuple_manager()))
+    def expand_engine(self):
+        """The expand engine: snapshot-backed (sharing the TPU check
+        engine's device snapshots and freshness semantics) when the check
+        engine is the TPU one, else the Manager-backed recursion."""
+
+        def build():
+            check = self.permission_engine()
+            if hasattr(check, "snapshot"):
+                from keto_tpu.expand.tpu_engine import SnapshotExpandEngine
+
+                return SnapshotExpandEngine(check, self.namespaces_source())
+            return ExpandEngine(self.relation_tuple_manager())
+
+        return self._memo("expand_engine", build)
 
     def check_batcher(self) -> CheckBatcher:
         def build():

@@ -109,6 +109,28 @@ class InternedGraph:
     def rel_code(self, s: str) -> int:
         return self.rel_codes.get(s, -1)
 
+    # -- reverse lookups (expand-tree reconstruction) ------------------------
+
+    def set_key_of(self, raw_id: int):
+        """``(ns_id, object, relation)`` of set node ``raw_id``."""
+        inv = self.__dict__.get("_set_by_id")
+        if inv is None:
+            inv = [None] * len(self.set_ids)
+            for k, i in self.set_ids.items():
+                inv[i] = k
+            self.__dict__["_set_by_id"] = inv
+        return inv[raw_id]
+
+    def leaf_str(self, idx: int) -> str:
+        """Subject-id string of leaf ``idx`` (not offset by num_sets)."""
+        inv = self.__dict__.get("_leaf_by_id")
+        if inv is None:
+            inv = [None] * len(self.leaf_ids)
+            for s, i in self.leaf_ids.items():
+                inv[i] = s
+            self.__dict__["_leaf_by_id"] = inv
+        return inv[idx]
+
 
 def intern_rows(rows: Iterable, wild_ns_ids: FrozenSet[int] = frozenset()) -> InternedGraph:
     """Intern ``persistence.memory.InternalRow``-shaped rows (attributes:
@@ -199,10 +221,15 @@ def intern_rows(rows: Iterable, wild_ns_ids: FrozenSet[int] = frozenset()) -> In
     if src.size:
         # duplicate tuples produce duplicate store rows (random shard_id PK,
         # reference internal/persistence/sql/relationtuples.go:135-138) but
-        # add nothing to reachability — dedup edges.
+        # add nothing to reachability — dedup edges, keeping the FIRST
+        # occurrence in emission order. Rows arrive sorted in the store's
+        # ORDER BY (memory.InternalRow.sort_key), so a set node's surviving
+        # out-edge order is exactly the order the Manager pages that node's
+        # tuples — the expand engine's tree-child order rides on this
+        # (keto_tpu/expand/tpu_engine.py).
         packed = src * np.int64(num_sets + len(leaf_ids)) + dst
         _, keep = np.unique(packed, return_index=True)
-        src, dst = src[keep], dst[keep]
+        src, dst = src[np.sort(keep)], dst[np.sort(keep)]
 
     return InternedGraph(
         set_ids=set_ids,

@@ -71,6 +71,10 @@ def load_library() -> Optional[ctypes.CDLL]:
         for fn in ("graph_resolve_leaf", "graph_obj_code", "graph_rel_code"):
             getattr(lib, fn).restype = c
             getattr(lib, fn).argtypes = [p, ctypes.c_char_p, c]
+        for fn in ("graph_obj_str", "graph_rel_str", "graph_leaf_str"):
+            if hasattr(lib, fn):
+                getattr(lib, fn).restype = p
+                getattr(lib, fn).argtypes = [p, c, ctypes.POINTER(c)]
         _lib = lib
         return _lib
     return None
@@ -185,6 +189,37 @@ class NativeInterned:
     def rel_code(self, s: str) -> int:
         b = s.encode()
         return int(self._lib.graph_rel_code(self._handle, b, len(b)))
+
+    # -- reverse lookups (expand-tree reconstruction) ------------------------
+
+    def _str_at(self, fn_name: str, idx: int) -> str:
+        fn = getattr(self._lib, fn_name, None)
+        if fn is None:
+            # silently returning None would embed null strings in expand
+            # trees; fail loud with the remedy instead
+            raise RuntimeError(
+                "libketoingest.so predates the expand reverse-lookup "
+                "exports — rebuild it with `make native` (or set "
+                "KETO_TPU_NATIVE=0 to use the Python interner)"
+            )
+        n = ctypes.c_int64()
+        ptr = fn(self._handle, idx, ctypes.byref(n))
+        if not ptr:
+            raise IndexError(f"{fn_name}({idx}) out of range")
+        return ctypes.string_at(ptr, n.value).decode()
+
+    def set_key_of(self, raw_id: int):
+        """``(ns_id, object, relation)`` of set node ``raw_id`` — field
+        codes come from the resident key arrays, strings from the C tables."""
+        return (
+            int(self.key_ns[raw_id]),
+            self._str_at("graph_obj_str", int(self.key_obj[raw_id])),
+            self._str_at("graph_rel_str", int(self.key_rel[raw_id])),
+        )
+
+    def leaf_str(self, idx: int) -> Optional[str]:
+        """Subject-id string of leaf ``idx`` (not offset by num_sets)."""
+        return self._str_at("graph_leaf_str", idx)
 
 
 def native_intern_rows(rows: Iterable, wild_ns_ids=frozenset()) -> Optional[NativeInterned]:

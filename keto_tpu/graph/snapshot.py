@@ -202,11 +202,63 @@ class GraphSnapshot:
             return True
         return False
 
+    def key_of_dev(self, dev: int):
+        """``("set", (ns_id, object, relation))`` or ``("leaf",
+        subject_id)`` for any device id, base or overlay — the reverse of
+        ``resolve_set``/``resolve_leaf``, used by the expand engine to
+        reconstruct tree-node subjects from BFS-captured device ids."""
+        nb = self.n_base_nodes
+        if dev >= nb:
+            with self._cache_lock:
+                inv = self._pattern_cache.get("_ov_inv")
+                if inv is None:
+                    inv = {}
+                    for k, d in (self.ov_set_ids or {}).items():
+                        inv[d] = ("set", k)
+                    for s, d in (self.ov_leaf_ids or {}).items():
+                        inv[d] = ("leaf", s)
+                    self._pattern_cache["_ov_inv"] = inv
+            return inv[dev]
+        raw = int(self._dev2raw()[dev])
+        if raw < self.num_sets:
+            return ("set", self.interned.set_key_of(raw))
+        return ("leaf", self.interned.leaf_str(raw - self.num_sets))
+
+    def _dev2raw(self) -> np.ndarray:
+        """Lazily cached inverse of the raw2dev permutation."""
+        with self._cache_lock:
+            d2r = self._pattern_cache.get("_dev2raw")
+            if d2r is None:
+                nb = self.n_base_nodes
+                d2r = np.empty(nb, np.int64)
+                d2r[self.raw2dev] = np.arange(nb)
+                self._pattern_cache["_dev2raw"] = d2r
+            return d2r
+
+    def is_set_dev_bulk(self, devs: np.ndarray) -> np.ndarray:
+        """bool[len(devs)] — True where the device id is a set node (base
+        or overlay); False for subject-id leaves."""
+        devs = np.asarray(devs)
+        nb = self.n_base_nodes
+        d2r = self._dev2raw()
+        in_base = devs < nb
+        out = np.zeros(devs.shape[0], bool)
+        out[in_base] = d2r[devs[in_base]] < self.num_sets
+        if not in_base.all():
+            ov_sets = set((self.ov_set_ids or {}).values())
+            for i in np.nonzero(~in_base)[0]:
+                out[i] = int(devs[i]) in ov_sets
+        return out
+
     def out_neighbors_bulk(self, nodes: np.ndarray):
         """(concatenated out-neighbor devs of ``nodes``, per-node counts) —
         base forward CSR merged with the delta overlay's adjacency (new
-        tuples since the base build). Node order is preserved; neighbor
-        order within a node is unspecified."""
+        tuples since the base build). Node order is preserved. Base
+        neighbor order within a node is GUARANTEED to be store row order
+        (= the Manager's page order; interner dedup keeps first occurrence
+        — the expand engine's tree-child parity depends on this,
+        keto_tpu/expand/tpu_engine.py); overlay extras append after base
+        neighbors."""
         nodes = np.asarray(nodes)
         nb = self.n_base_nodes
         if nodes.size and int(nodes.max()) >= nb:

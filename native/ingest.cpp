@@ -50,6 +50,9 @@ struct Graph {
     std::unordered_map<std::string, int64_t> leaf_ids;
     std::unordered_map<std::string, int64_t> obj_codes;
     std::unordered_map<std::string, int64_t> rel_codes;
+    // reverse tables for expand-tree reconstruction: pointers into the
+    // node-based unordered_maps above (stable for the Graph's lifetime)
+    std::vector<const std::string*> leaf_by_id, obj_by_code, rel_by_code;
     // per set node, aligned with set id
     std::vector<int64_t> key_ns, key_obj, key_rel;
     std::vector<uint8_t> wild;
@@ -61,11 +64,13 @@ struct Graph {
     std::vector<int64_t> wild_ns_ids;
 };
 
-int64_t intern_code(std::unordered_map<std::string, int64_t>& table, std::string_view s) {
+int64_t intern_code(std::unordered_map<std::string, int64_t>& table, std::string_view s,
+                    std::vector<const std::string*>& by_code) {
     auto it = table.find(std::string(s));
     if (it != table.end()) return it->second;
     int64_t code = (int64_t)table.size();
-    table.emplace(std::string(s), code);
+    auto ins = table.emplace(std::string(s), code);
+    by_code.push_back(&ins.first->first);
     return code;
 }
 
@@ -77,8 +82,8 @@ int64_t set_node(Graph& g, int64_t ns, std::string_view obj, std::string_view re
     int64_t id = (int64_t)g.set_ids.size();
     g.set_ids.emplace(std::move(key), id);
     g.key_ns.push_back(ns);
-    g.key_obj.push_back(intern_code(g.obj_codes, obj));
-    g.key_rel.push_back(intern_code(g.rel_codes, rel));
+    g.key_obj.push_back(intern_code(g.obj_codes, obj, g.obj_by_code));
+    g.key_rel.push_back(intern_code(g.rel_codes, rel, g.rel_by_code));
     g.wild.push_back(ns_wild || obj.empty() || rel.empty());
     return id;
 }
@@ -87,7 +92,8 @@ int64_t leaf_node(Graph& g, std::string_view s) {
     auto it = g.leaf_ids.find(std::string(s));
     if (it != g.leaf_ids.end()) return it->second;
     int64_t id = (int64_t)g.leaf_ids.size();
-    g.leaf_ids.emplace(std::string(s), id);
+    auto ins = g.leaf_ids.emplace(std::string(s), id);
+    g.leaf_by_id.push_back(&ins.first->first);
     return id;
 }
 
@@ -138,8 +144,8 @@ Graph* graph_build(const char* buf, int64_t len, const int64_t* wild_ns_ids,
         int64_t lhs = set_node(*g, ns, fields[1], fields[2], is_wild_ns(*g, ns));
         g->t_lhs.push_back(lhs);
         g->t_ns.push_back(ns);
-        g->t_obj.push_back(intern_code(g->obj_codes, fields[1]));
-        g->t_rel.push_back(intern_code(g->rel_codes, fields[2]));
+        g->t_obj.push_back(intern_code(g->obj_codes, fields[1], g->obj_by_code));
+        g->t_rel.push_back(intern_code(g->rel_codes, fields[2], g->rel_by_code));
         if (fields[3] == "1") {
             g->t_sub_kind.push_back(1);
             g->t_sub_idx.push_back(leaf_node(*g, fields[4]));
@@ -189,22 +195,31 @@ Graph* graph_build(const char* buf, int64_t len, const int64_t* wild_ns_ids,
         }
     }
 
-    // dedup edges (duplicate tuples add nothing to reachability)
+    // dedup edges (duplicate tuples add nothing to reachability), keeping
+    // the FIRST occurrence in emission order: rows arrive in the store's
+    // ORDER BY, so each set node's surviving out-edge order is the order
+    // the Manager pages that node's tuples — the expand engine's
+    // tree-child order depends on this (keto_tpu/expand/tpu_engine.py,
+    // mirrored in interner.py intern_rows)
     if (!g->src.empty()) {
-        std::vector<size_t> order(g->src.size());
-        for (size_t i = 0; i < order.size(); ++i) order[i] = i;
         const int64_t n_nodes = num_sets + (int64_t)g->leaf_ids.size();
-        std::vector<int64_t> packed(g->src.size());
+        std::vector<std::pair<int64_t, size_t>> packed(g->src.size());
         for (size_t i = 0; i < packed.size(); ++i)
-            packed[i] = g->src[i] * n_nodes + g->dst[i];
+            packed[i] = {g->src[i] * n_nodes + g->dst[i], i};
         std::sort(packed.begin(), packed.end());
-        packed.erase(std::unique(packed.begin(), packed.end()), packed.end());
-        g->src.resize(packed.size());
-        g->dst.resize(packed.size());
-        for (size_t i = 0; i < packed.size(); ++i) {
-            g->src[i] = packed[i] / n_nodes;
-            g->dst[i] = packed[i] % n_nodes;
+        std::vector<size_t> keep;
+        keep.reserve(packed.size());
+        for (size_t i = 0; i < packed.size(); ++i)
+            if (i == 0 || packed[i].first != packed[i - 1].first)
+                keep.push_back(packed[i].second);
+        std::sort(keep.begin(), keep.end());
+        std::vector<int64_t> src2(keep.size()), dst2(keep.size());
+        for (size_t i = 0; i < keep.size(); ++i) {
+            src2[i] = g->src[keep[i]];
+            dst2[i] = g->dst[keep[i]];
         }
+        g->src.swap(src2);
+        g->dst.swap(dst2);
     }
 
     // per-tuple build temporaries are dead once edges exist; the handle
@@ -329,6 +344,30 @@ int64_t graph_obj_code(const Graph* g, const char* s, int64_t len) {
 int64_t graph_rel_code(const Graph* g, const char* s, int64_t len) {
     auto it = g->rel_codes.find(std::string(s, (size_t)len));
     return it == g->rel_codes.end() ? -1 : it->second;
+}
+
+// Reverse lookups (expand-tree reconstruction): pointer into the resident
+// intern table + length, or nullptr when out of range. The pointer stays
+// valid for the Graph's lifetime.
+const char* graph_obj_str(const Graph* g, int64_t code, int64_t* out_len) {
+    if (code < 0 || (size_t)code >= g->obj_by_code.size()) return nullptr;
+    const std::string& s = *g->obj_by_code[(size_t)code];
+    *out_len = (int64_t)s.size();
+    return s.data();
+}
+
+const char* graph_rel_str(const Graph* g, int64_t code, int64_t* out_len) {
+    if (code < 0 || (size_t)code >= g->rel_by_code.size()) return nullptr;
+    const std::string& s = *g->rel_by_code[(size_t)code];
+    *out_len = (int64_t)s.size();
+    return s.data();
+}
+
+const char* graph_leaf_str(const Graph* g, int64_t idx, int64_t* out_len) {
+    if (idx < 0 || (size_t)idx >= g->leaf_by_id.size()) return nullptr;
+    const std::string& s = *g->leaf_by_id[(size_t)idx];
+    *out_len = (int64_t)s.size();
+    return s.data();
 }
 
 }  // extern "C"

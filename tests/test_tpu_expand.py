@@ -1,0 +1,248 @@
+"""Snapshot-backed expand vs the Manager-backed host engine (the oracle).
+
+Tier 1: literal-key stores without duplicate tuples — trees must match
+node-for-node INCLUDING child order (the snapshot's edge order preserves
+store row order, keto_tpu/graph/interner.py).
+
+Tier 2: wildcard-heavy stores — the snapshot dedups a wildcard node's
+children across matching tuples (documented divergence,
+keto_tpu/expand/tpu_engine.py), so trees compare after multiplicity
+normalization, order-insensitively (the reference's e2e suite compares
+trees order-insensitively too).
+"""
+
+import random
+
+import pytest
+
+from keto_tpu.check.tpu_engine import TpuCheckEngine
+from keto_tpu.expand.engine import ExpandEngine
+from keto_tpu.expand.tpu_engine import SnapshotExpandEngine
+from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+from keto_tpu.x.errors import ErrNamespaceUnknown
+
+
+def T(ns, obj, rel, sub):
+    return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+
+def engines(p):
+    check = TpuCheckEngine(p, p.namespaces)
+    return ExpandEngine(p), SnapshotExpandEngine(check, p.namespaces)
+
+
+def assert_tree_identical(a, b, path="root"):
+    assert (a is None) == (b is None), f"{path}: {a} vs {b}"
+    if a is None:
+        return
+    assert a.type == b.type, f"{path}: type {a.type} != {b.type}"
+    assert a.subject == b.subject, f"{path}: subject {a.subject} != {b.subject}"
+    assert len(a.children) == len(b.children), (
+        f"{path} ({a.subject}): {len(a.children)} vs {len(b.children)} children: "
+        f"{[str(c.subject) for c in a.children]} vs {[str(c.subject) for c in b.children]}"
+    )
+    for i, (ca, cb) in enumerate(zip(a.children, b.children)):
+        assert_tree_identical(ca, cb, f"{path}.{i}")
+
+
+def normalize(tree):
+    """Collapse duplicate siblings (same subject): keep the expanded
+    occurrence if any — the multiplicity the snapshot engine collapses by
+    construction."""
+    if tree is None:
+        return None
+    by_subject = {}
+    order = []
+    for c in tree.children:
+        nc = normalize(c)
+        k = str(nc.subject)
+        prev = by_subject.get(k)
+        if prev is None:
+            by_subject[k] = nc
+            order.append(k)
+        elif nc.children and not prev.children:
+            by_subject[k] = nc
+    tree.children = [by_subject[k] for k in order]
+    return tree
+
+
+# -- tier 1: exact node-for-node (ordered) parity ---------------------------
+
+
+def _literal_store(make_persister, seed):
+    rng = random.Random(seed)
+    p = make_persister([("ns0", 1), ("ns1", 2)])
+    names = ["ns0", "ns1"]
+    objs = [f"o{i}" for i in range(8)]
+    rels = ["r0", "r1", "r2"]
+    users = [f"u{i}" for i in range(6)]
+    seen = set()
+    tuples = []
+    for _ in range(rng.randrange(30, 150)):
+        sub = (
+            SubjectID(rng.choice(users))
+            if rng.random() < 0.4
+            else SubjectSet(rng.choice(names), rng.choice(objs), rng.choice(rels))
+        )
+        t = T(rng.choice(names), rng.choice(objs), rng.choice(rels), sub)
+        key = str(t)
+        if key not in seen:  # duplicates collapse in the graph — tier 2 topic
+            seen.add(key)
+            tuples.append(t)
+    p.write_relation_tuples(*tuples)
+    return p, names, objs, rels, users
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_exact_parity_literal_fuzz(make_persister, seed):
+    p, names, objs, rels, users = _literal_store(make_persister, seed)
+    rng = random.Random(1000 + seed)
+    host, tpu = engines(p)
+    for _ in range(60):
+        sub = SubjectSet(rng.choice(names), rng.choice(objs), rng.choice(rels))
+        depth = rng.choice([1, 2, 3, 5, 100])
+        assert_tree_identical(
+            host.build_tree(sub, depth), tpu.build_tree(sub, depth), f"{sub}@{depth}"
+        )
+    # SubjectID roots are leaves in both
+    leaf = SubjectID(users[0])
+    assert_tree_identical(host.build_tree(leaf, 5), tpu.build_tree(leaf, 5))
+
+
+# -- tier 2: wildcard stores, normalized order-insensitive parity -----------
+
+
+def _wild_store(make_persister, seed):
+    rng = random.Random(seed)
+    p = make_persister([("ns0", 1), ("ns1", 2), ("", 3)])
+    names = ["ns0", "ns1", ""]
+    objs = [f"o{i}" for i in range(6)]
+    rels = ["r0", "r1", ""]
+    users = [f"u{i}" for i in range(5)]
+    tuples = []
+    for _ in range(rng.randrange(20, 120)):
+        sub = (
+            SubjectID(rng.choice(users))
+            if rng.random() < 0.4
+            else SubjectSet(rng.choice(names), rng.choice(objs), rng.choice(rels))
+        )
+        tuples.append(T(rng.choice(names), rng.choice(objs), rng.choice(rels), sub))
+    p.write_relation_tuples(*tuples)
+    return p, names, objs, rels
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_normalized_parity_wildcard_fuzz(make_persister, seed):
+    p, names, objs, rels = _wild_store(make_persister, seed)
+    rng = random.Random(2000 + seed)
+    host, tpu = engines(p)
+    for _ in range(50):
+        sub = SubjectSet(rng.choice(names), rng.choice(objs), rng.choice(rels))
+        depth = rng.choice([1, 2, 3, 5, 100])
+        h = normalize(host.build_tree(sub, depth))
+        t = normalize(tpu.build_tree(sub, depth))
+        if h is None or t is None:
+            assert h is None and t is None, f"{sub}@{depth}: {h} vs {t}"
+        else:
+            assert h.equals(t), f"{sub}@{depth}:\n{h}\nvs\n{t}"
+
+
+# -- semantics spot checks ---------------------------------------------------
+
+
+def test_depth_and_cycle_semantics(make_persister):
+    p = make_persister([("g", 1)])
+    p.write_relation_tuples(
+        T("g", "a", "m", SubjectSet("g", "b", "m")),
+        T("g", "b", "m", SubjectSet("g", "a", "m")),
+        T("g", "b", "m", SubjectID("u")),
+    )
+    host, tpu = engines(p)
+    for depth in (1, 2, 3, 4, 10):
+        assert_tree_identical(
+            host.build_tree(SubjectSet("g", "a", "m"), depth),
+            tpu.build_tree(SubjectSet("g", "a", "m"), depth),
+            f"depth={depth}",
+        )
+    # depth 0 → None; SubjectID → leaf
+    assert tpu.build_tree(SubjectSet("g", "a", "m"), 0) is None
+    assert tpu.build_tree(SubjectID("u"), 3).type == "leaf"
+    # empty set → None
+    assert tpu.build_tree(SubjectSet("g", "nope", "m"), 5) is None
+
+
+def test_unknown_namespace_raises(make_persister):
+    p = make_persister([("g", 1)])
+    p.write_relation_tuples(T("g", "a", "m", SubjectID("u")))
+    _, tpu = engines(p)
+    with pytest.raises(ErrNamespaceUnknown):
+        tpu.build_tree(SubjectSet("ghost", "a", "m"), 5)
+
+
+def test_expand_sees_delta_overlay(make_persister):
+    """Read-your-writes through the shared check-engine snapshot: an
+    insert applied as a delta overlay (no rebuild) must appear in the next
+    expand, including overlay edge classes out_neighbors_bulk alone does
+    not carry (interior→interior, interior→sink)."""
+    p = make_persister([("g", 1)])
+    p.write_relation_tuples(
+        T("g", "root", "m", SubjectSet("g", "mid", "m")),
+        T("g", "mid", "m", SubjectSet("g", "leafgrp", "m")),
+        T("g", "leafgrp", "m", SubjectID("u1")),
+    )
+    host, tpu = engines(p)
+    base = tpu.build_tree(SubjectSet("g", "root", "m"), 10)
+    assert base is not None
+    # interior → sink (new user under an interior group) and
+    # interior → interior (mid gains a second interior child)
+    p.write_relation_tuples(
+        T("g", "mid", "m", SubjectID("u2")),
+        T("g", "mid", "m", SubjectSet("g", "root", "m")),  # cycle via delta
+    )
+    h = normalize(host.build_tree(SubjectSet("g", "root", "m"), 10))
+    t = normalize(tpu.build_tree(SubjectSet("g", "root", "m"), 10))
+    assert h is not None and t is not None and h.equals(t), f"{h}\nvs\n{t}"
+
+
+def test_pattern_root_sees_delta_overlay(make_persister):
+    """Regression: a pattern root (no node of its own) must include
+    pending delta-overlay children — ov_ell and ov_sink_in edges that
+    out_neighbors_bulk alone does not carry."""
+    p = make_persister([("g", 1)])
+    p.write_relation_tuples(
+        T("g", "r", "m", SubjectSet("g", "a", "m")),
+        T("g", "a", "m", SubjectSet("g", "b", "m")),
+        T("g", "b", "m", SubjectSet("g", "c", "m")),
+        T("g", "c", "m", SubjectID("u")),
+    )
+    host, tpu = engines(p)
+    tpu.build_tree(SubjectSet("g", "c", "m"), 5)  # build the base snapshot
+    # interior→interior overlay edge: c gains child b
+    p.write_relation_tuples(T("g", "c", "m", SubjectSet("g", "b", "m")))
+    h = normalize(host.build_tree(SubjectSet("g", "c", ""), 3))
+    t = normalize(tpu.build_tree(SubjectSet("g", "c", ""), 3))
+    assert h is not None and t is not None and h.equals(t), f"{h}\nvs\n{t}"
+
+
+def test_pattern_root_without_node(make_persister):
+    """Expanding a wildcard pattern that exists as no set node
+    concatenates the matching keys' children (normalized compare)."""
+    p = make_persister([("a", 1), ("b", 2)])
+    p.write_relation_tuples(
+        T("a", "o1", "r", SubjectID("u1")),
+        T("a", "o2", "r", SubjectID("u2")),
+        T("b", "o1", "r", SubjectID("u3")),
+    )
+    host, tpu = engines(p)
+    for sub in (
+        SubjectSet("", "o1", "r"),
+        SubjectSet("a", "", "r"),
+        SubjectSet("", "", "r"),
+        SubjectSet("", "", ""),
+    ):
+        h = normalize(host.build_tree(sub, 5))
+        t = normalize(tpu.build_tree(sub, 5))
+        if h is None or t is None:
+            assert h is None and t is None, f"{sub}: {h} vs {t}"
+        else:
+            assert h.equals(t), f"{sub}:\n{h}\nvs\n{t}"
