@@ -213,7 +213,16 @@ def check_step(
         (hit << bits).reshape(W, 32), np.uint32(0), lax.bitwise_or, (1,)
     )
     tail = jnp.stack([iters.astype(jnp.uint32), truncated.astype(jnp.uint32)])
-    return jnp.concatenate([packed_bits, tail])
+    out = jnp.concatenate([packed_bits, tail])
+    if bitmap_sharding is not None:
+        # fully replicate the packed result so every host of a
+        # multi-controller mesh can fetch it directly (W+2 words — cheap)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        out = lax.with_sharding_constraint(
+            out, NamedSharding(bitmap_sharding.mesh, PartitionSpec())
+        )
+    return out
 
 
 #: jitted entrypoint used by the engine; ``check_step`` stays un-jitted for
@@ -400,6 +409,16 @@ class TpuCheckEngine:
     (hot-reload safe). This object is the TPU implementation behind the
     registry's ``PermissionEngine()`` seam (reference
     internal/driver/registry_default.go:158-163).
+
+    **Multi-controller (multi-host mesh) lockstep contract:** when
+    ``mesh`` spans more than one process, every host executes one SPMD
+    program — so every host must call ``batch_check``/``snapshot`` with
+    identical inputs in identical order over identical store contents
+    (same batches, same write points). Divergent per-host traffic or
+    store state produces mismatched collective programs (hangs or
+    corrupt results). See ``parallel/mesh.py init_distributed`` and the
+    README's multi-host section for the serving pattern that provides
+    this.
     """
 
     def __init__(
@@ -433,6 +452,7 @@ class TpuCheckEngine:
         self._dispatch_window = 16
         self._mesh = mesh
         self._shard_rows = shard_rows
+        self._multiprocess = mesh is not None and jax.process_count() > 1
         self._bitmap_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1078,10 +1098,20 @@ class TpuCheckEngine:
             W = packed[-1].shape[0] // 32
             if W % self._mesh.shape.get("data", 1):
                 sharding = self._bitmap_sharding_rows_only
+        if self._multiprocess:
+            # multi-controller runtime: jit inputs must be global arrays;
+            # every process holds identical host data (the lockstep
+            # contract, parallel/mesh.py init_distributed), so replicate
+            # the packed entry arrays onto the mesh in one batched call
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            args = tuple(jax.device_put(packed, NamedSharding(self._mesh, P())))
+        else:
+            args = tuple(jnp.asarray(a) for a in packed)
         ov = snap.device_overlay
         dev = _check_kernel(
             snap.device_buckets,
-            *(jnp.asarray(a) for a in packed),
+            *args,
             ov_nbrs=None if ov is None else ov[0],
             ov_dst=None if ov is None else ov[1],
             n_active=snap.num_active,

@@ -1,7 +1,20 @@
-"""Mesh construction helpers."""
+"""Mesh construction helpers — single-host and multi-host (DCN plane).
+
+The reference scales out as stateless server replicas over one SQL
+database (reference internal/driver/registry_default.go:206-224,
+persister.go:94-96). The TPU-native analog is a **multi-controller JAX
+runtime**: every host runs the same serving process over the same tuple
+store, `init_distributed` joins them into one runtime, and `make_mesh`
+then builds a global ``(graph, data)`` mesh spanning every host's chips —
+graph rows sharded across the pod, collectives riding ICI within a host
+and DCN between hosts. Each process feeds identical host-side arrays
+(the store is shared/replicated exactly like the reference's database),
+so the SPMD program is the same everywhere; XLA keeps the processes in
+lockstep."""
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -10,6 +23,51 @@ from jax.sharding import Mesh
 
 GRAPH_AXIS = "graph"
 DATA_AXIS = "data"
+
+
+def init_distributed(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    local_device_count: Optional[int] = None,
+    platform: Optional[str] = None,
+) -> None:
+    """Join this process into a multi-controller JAX runtime.
+
+    Call once per process before any device use; afterwards
+    ``jax.devices()`` is global across hosts and ``make_mesh()`` builds a
+    pod-wide mesh. ``local_device_count`` forces N virtual CPU devices
+    per host (testing without a pod); ``platform`` pins the backend (e.g.
+    ``"cpu"``). Both apply via jax's config/flag machinery, which is read
+    at BACKEND initialization — they work after ``import jax`` but must
+    run before the first device use in the process.
+
+    **Lockstep contract:** a multi-controller engine executes one SPMD
+    program across every host. All hosts must issue the same engine calls
+    with identical inputs in identical order — same store contents, same
+    batches, same write points (see the serving note in README.md). A
+    front-end that replicates requests to every host in order provides
+    this; independently load-balanced traffic does NOT.
+    """
+    if platform:
+        # env-var writes are useless here — jax snapshots JAX_PLATFORMS at
+        # import — but the config entry is read at backend init
+        jax.config.update("jax_platforms", platform)
+    if local_device_count is not None:
+        flag = "--xla_force_host_platform_device_count"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if flag in flags:
+            import re
+
+            flags = re.sub(rf"{flag}=\d+", f"{flag}={local_device_count}", flags)
+        else:
+            flags = f"{flags} {flag}={local_device_count}"
+        os.environ["XLA_FLAGS"] = flags.strip()
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
 
 
 def make_mesh(

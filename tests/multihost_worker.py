@@ -1,0 +1,92 @@
+"""Worker for the two-process multi-host test (tests/test_multihost.py).
+
+Usage: python multihost_worker.py <process_id> <coordinator_port>
+
+Joins a 2-process multi-controller runtime (4 virtual CPU devices per
+"host" → one global 8-device mesh), builds the SAME seeded store in each
+process (the analog of the reference's replicas sharing one database),
+answers an identical check batch over the pod-wide (graph=2, data=4)
+mesh, and compares every decision with the local recursive oracle.
+"""
+
+import os
+import random
+import sys
+
+
+def main() -> int:
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    from keto_tpu.parallel.mesh import init_distributed
+
+    # platform/device-count go through init_distributed itself (applied
+    # via jax config/flags, which are read at backend init — after import
+    # is fine, before first device use is required)
+    init_distributed(
+        f"127.0.0.1:{port}", num_processes=2, process_id=pid,
+        local_device_count=4, platform="cpu",
+    )
+    import jax
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+    from keto_tpu import namespace as namespace_pkg
+    from keto_tpu.check import CheckEngine
+    from keto_tpu.check.tpu_engine import TpuCheckEngine
+    from keto_tpu.parallel import make_mesh
+    from keto_tpu.persistence.memory import MemoryPersister
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    def T(ns, obj, rel, sub):
+        return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+    # deterministic store — identical in both processes
+    rng = random.Random(7)
+    nm = namespace_pkg.MemoryManager(
+        [namespace_pkg.Namespace(id=1, name="g"), namespace_pkg.Namespace(id=2, name="d")]
+    )
+    p = MemoryPersister(nm)
+    names, objs, rels = ["g", "d"], [f"o{i}" for i in range(10)], ["r0", "r1"]
+    users = [f"u{i}" for i in range(8)]
+    tuples = []
+    for _ in range(200):
+        sub = (
+            SubjectID(rng.choice(users))
+            if rng.random() < 0.4
+            else SubjectSet(rng.choice(names), rng.choice(objs), rng.choice(rels))
+        )
+        tuples.append(T(rng.choice(names), rng.choice(objs), rng.choice(rels), sub))
+    p.write_relation_tuples(*tuples)
+
+    mesh = make_mesh(graph=2)  # pod-wide: 2×4 over both processes
+    engine = TpuCheckEngine(p, p.namespaces, mesh=mesh, shard_rows=True)
+    assert engine._multiprocess
+
+    queries = []
+    for _ in range(100):
+        sub = (
+            SubjectID(rng.choice(users + ["ghost"]))
+            if rng.random() < 0.5
+            else SubjectSet(rng.choice(names), rng.choice(objs), rng.choice(rels))
+        )
+        queries.append(T(rng.choice(names + ["nope"]), rng.choice(objs), rng.choice(rels), sub))
+
+    got = engine.batch_check(queries)
+    oracle = CheckEngine(p)
+    for q, g in zip(queries, got):
+        w = oracle.subject_is_allowed(q)
+        assert g == w, f"p{pid} divergence on {q}: mesh={g} oracle={w}"
+
+    # write path: both processes apply the same delta, snapshot refreshes
+    # (delta overlay or rebuild), answers flip identically pod-wide
+    p.write_relation_tuples(T("g", "o0", "r0", SubjectID("newbie")))
+    assert engine.subject_is_allowed(T("g", "o0", "r0", SubjectID("newbie")))
+
+    print(f"MULTIHOST_OK p{pid}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Two-process multi-host mesh: checks answer identically pod-wide.
+
+The reference tests multi-node behavior through database semantics
+(stateless replicas over one store — SURVEY §4); the TPU analog is a
+multi-controller JAX runtime. This boots TWO OS processes, each posing as
+one host with 4 virtual CPU devices, joined via
+``jax.distributed.initialize`` into one global 8-device (graph=2,
+data=4) mesh, and asserts every sharded check decision matches the
+recursive oracle in both processes — including a post-write refresh.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_two_process_mesh_matches_oracle():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_"))
+    }
+    # the worker sets its own XLA_FLAGS/JAX_PLATFORMS via init_distributed;
+    # drop the conftest's 8-device forcing so each process gets exactly 4
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "multihost_worker.py"), str(i), str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out[-4000:]}"
+        assert f"MULTIHOST_OK p{i}" in out, out[-2000:]
