@@ -46,8 +46,7 @@ _DEFAULTS: dict[str, Any] = {
     "engine": {
         "backend": "auto",
         "batch_size": 4096,
-        "reach_capacity": 512,
-        "max_degree": 32,
+        "it_cap": 4096,
         "batch_window_ms": 1.0,
     },
     "limit": {"max_read_depth": 5},
@@ -66,8 +65,7 @@ _ENV_KEYS = [
     "namespaces",
     "engine.backend",
     "engine.batch_size",
-    "engine.reach_capacity",
-    "engine.max_degree",
+    "engine.it_cap",
     "engine.batch_window_ms",
     "limit.max_read_depth",
     "log.level",
@@ -104,7 +102,7 @@ def _get_path(cfg: dict, dotted: str, default: Any = None) -> Any:
 
 
 def _coerce(dotted: str, raw: str) -> Any:
-    if dotted.endswith((".port", "_size", "_capacity", "_degree", "max_read_depth")):
+    if dotted.endswith((".port", "_size", "_cap", "max_read_depth")):
         return int(raw)
     if dotted.endswith("_ms"):
         return float(raw)

@@ -64,15 +64,24 @@ CONFIG_SCHEMA = {
             "properties": {
                 "backend": {"type": "string", "enum": ["tpu", "oracle", "auto"], "default": "auto"},
                 "batch_size": {"type": "integer", "default": 4096},
-                "reach_capacity": {"type": "integer", "default": 512},
-                "max_degree": {"type": "integer", "default": 32},
+                "it_cap": {
+                    "type": "integer",
+                    "default": 4096,
+                    "description": "BFS iteration cap per device batch; hitting it logs a truncation warning.",
+                },
                 "batch_window_ms": {"type": "number", "default": 1.0},
             },
         },
         "limit": {
             "type": "object",
             "additionalProperties": False,
-            "properties": {"max_read_depth": {"type": "integer", "default": 5}},
+            "properties": {
+                "max_read_depth": {
+                    "type": "integer",
+                    "default": 5,
+                    "description": "Global expand depth cap; requests asking for 0 or more than this get this.",
+                }
+            },
         },
         "log": {
             "type": "object",

@@ -95,10 +95,21 @@ class Registry:
             if backend != "oracle" and hasattr(store, "snapshot_rows"):
                 from keto_tpu.check.tpu_engine import TpuCheckEngine
 
-                return TpuCheckEngine(store, self.namespaces_source())
+                return TpuCheckEngine(
+                    store,
+                    self.namespaces_source(),
+                    it_cap=int(self._config.get("engine.it_cap", 4096)),
+                )
             return CheckEngine(store)
 
         return self._memo("permission_engine", build)
+
+    def expand_depth(self, requested: int) -> int:
+        """Clamp a request's max-depth to the configured global cap
+        (``limit.max_read_depth``): a request asking for 0 — or more than
+        the cap — gets the cap."""
+        cap = int(self._config.get("limit.max_read_depth", 5))
+        return cap if requested <= 0 or requested > cap else requested
 
     def expand_engine(self):
         """The expand engine: snapshot-backed (sharing the TPU check

@@ -119,7 +119,9 @@ class ExpandService:
 
     def Expand(self, request, context):
         subject = subject_from_proto(request.subject)
-        tree = self.registry.expand_engine().build_tree(subject, request.max_depth)
+        tree = self.registry.expand_engine().build_tree(
+            subject, self.registry.expand_depth(request.max_depth)
+        )
         return expand_service_pb2.ExpandResponse(tree=tree_to_proto(tree))
 
     def register(self, server):

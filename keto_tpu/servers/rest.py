@@ -119,13 +119,19 @@ class RestApp:
         return self._check(RelationTuple.from_json(obj))
 
     def _get_expand(self, query):
+        # the reference parses max-depth unconditionally — absent/invalid
+        # is a 400 (tests/test_rest_api.py asserts this). An explicit 0
+        # means "use the configured limit.max_read_depth", matching the
+        # gRPC path where 0 is the proto default for an omitted field.
         raw_depth = (query.get("max-depth") or [""])[0]
         try:
             depth = int(raw_depth)
         except ValueError:
             raise ErrBadRequest(f"invalid max-depth {raw_depth!r}") from None
         subject = subject_set_from_url_query(query)
-        tree = self.registry.expand_engine().build_tree(subject, depth)
+        tree = self.registry.expand_engine().build_tree(
+            subject, self.registry.expand_depth(depth)
+        )
         if tree is None:
             return 200, None, {}
         return 200, tree.to_json(), {}

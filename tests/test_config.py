@@ -101,3 +101,53 @@ def test_config_watcher_integration(tmp_path):
     assert cfg.namespace_manager().get_namespace_by_name("watched").id == 8
     assert fired
     cfg.close()
+
+
+def test_engine_config_keys_are_wired():
+    """engine.it_cap reaches the TPU engine; limit.max_read_depth caps
+    expand depth at the handler seam (no dead config keys)."""
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 1, "name": "g"}],
+            "engine.it_cap": 77,
+            "limit.max_read_depth": 3,
+        }
+    )
+    reg = Registry(cfg)
+    assert reg.permission_engine()._it_cap == 77
+    # requests asking for 0 or more than the cap get the cap
+    assert reg.expand_depth(0) == 3
+    assert reg.expand_depth(2) == 2
+    assert reg.expand_depth(3) == 3
+    assert reg.expand_depth(100) == 3
+    cfg.close()
+
+
+def test_max_read_depth_caps_rest_expand():
+    """A deep chain expands only to the configured global depth cap."""
+    from keto_tpu.driver.registry import Registry
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+    from keto_tpu.servers.rest import RestApp
+
+    cfg = Config(
+        overrides={"namespaces": [{"id": 1, "name": "g"}], "limit.max_read_depth": 2}
+    )
+    reg = Registry(cfg)
+    p = reg.relation_tuple_manager()
+    p.write_relation_tuples(
+        RelationTuple(namespace="g", object="a", relation="m", subject=SubjectSet("g", "b", "m")),
+        RelationTuple(namespace="g", object="b", relation="m", subject=SubjectSet("g", "c", "m")),
+        RelationTuple(namespace="g", object="c", relation="m", subject=SubjectID("u")),
+    )
+    status, tree, _ = RestApp(reg, "read").handle(
+        "GET", "/expand", {"namespace": ["g"], "object": ["a"], "relation": ["m"], "max-depth": ["50"]}, b""
+    )
+    assert status == 200
+    # depth 2: root union → child b truncated to a leaf (no grandchildren)
+    assert tree["type"] == "union"
+    child = tree["children"][0]
+    assert child["subject_set"]["object"] == "b"
+    assert child["type"] == "leaf" and "children" not in child
+    cfg.close()

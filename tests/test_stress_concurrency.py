@@ -1,0 +1,195 @@
+"""Threaded stress: the analog of the reference's `go test -race -short`
+CI job (reference .circleci/config.yml:54-63).
+
+The serving stack is thread-heavy — mux splice threads, ThreadingHTTPServer,
+the check batcher's window thread, the engine's snapshot lock and background
+refresh — and the reference's race detector has no Python equivalent, so
+this drives the real concurrency instead:
+
+- N client threads hammer one daemon through the multiplexed port (REST
+  checks) while a writer thread mutates tuples (inserts AND deletes, so
+  both the delta-overlay path and full rebuilds run under load);
+- every response must be a decision (200/403), never a 5xx, never a hang;
+- after the writer quiesces, a final sweep must match the recursive
+  oracle decision-for-decision;
+- the engine-level variant does the same against TpuCheckEngine directly
+  (no HTTP), catching snapshot/overlay races the servers might mask.
+"""
+
+import os
+import random
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from keto_tpu.check import CheckEngine
+from keto_tpu.check.tpu_engine import TpuCheckEngine
+from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+
+#: heavier settings in CI's dedicated race job
+HEAVY = os.environ.get("KETO_STRESS_HEAVY", "0") == "1"
+N_CLIENTS = 8 if HEAVY else 4
+N_REQUESTS = 60 if HEAVY else 25
+N_WRITES = 40 if HEAVY else 15
+
+
+def T(ns, obj, rel, sub):
+    return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+
+def _seed_store(p, rng):
+    users = [f"u{i}" for i in range(12)]
+    tuples = []
+    for g in range(10):
+        tuples.append(T("g", f"grp{g}", "m", SubjectSet("g", f"grp{(g + 1) % 10}", "m")))
+        for u in rng.sample(users, 4):
+            tuples.append(T("g", f"grp{g}", "m", SubjectID(u)))
+    for d in range(10):
+        tuples.append(T("d", f"doc{d}", "view", SubjectSet("g", f"grp{d % 10}", "m")))
+    p.write_relation_tuples(*tuples)
+    return users
+
+
+def _rand_query(rng, users):
+    return T(
+        rng.choice(["d", "g", "nope"]),
+        rng.choice([f"doc{i}" for i in range(10)] + [f"grp{i}" for i in range(10)]),
+        rng.choice(["view", "m"]),
+        SubjectID(rng.choice(users + ["ghost"])),
+    )
+
+
+def _writer(p, rng, stop, errors):
+    """Inserts AND deletes: deltas exercise the overlay, deletes force
+    full rebuilds mid-flight."""
+    try:
+        for i in range(N_WRITES):
+            if stop.is_set():
+                return
+            u = f"w{i}"
+            g = rng.randrange(10)
+            t = T("g", f"grp{g}", "m", SubjectID(u))
+            p.write_relation_tuples(t)
+            if i % 4 == 3:
+                p.delete_relation_tuples(t)
+    except Exception as e:  # pragma: no cover - the assertion IS the test
+        errors.append(("writer", repr(e)))
+
+
+def test_engine_level_stress(make_persister):
+    """Client threads batch-check against the engine while a writer
+    mutates the store; decisions after quiesce match the oracle."""
+    rng = random.Random(5)
+    p = make_persister([("g", 1), ("d", 2)])
+    users = _seed_store(p, rng)
+    engine = TpuCheckEngine(p, p.namespaces)
+
+    errors: list = []
+    stop = threading.Event()
+
+    def client(seed):
+        crng = random.Random(seed)
+        try:
+            for _ in range(N_REQUESTS):
+                qs = [_rand_query(crng, users) for _ in range(crng.randrange(1, 16))]
+                got = engine.batch_check(qs)
+                assert len(got) == len(qs)
+        except Exception as e:
+            errors.append(("client", repr(e)))
+            stop.set()  # abort the writer early on client failure
+
+    threads = [threading.Thread(target=client, args=(100 + i,)) for i in range(N_CLIENTS)]
+    threads.append(threading.Thread(target=_writer, args=(p, random.Random(9), stop, errors)))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+        assert not t.is_alive(), "stress thread hung (deadlock)"
+    stop.set()
+    assert not errors, errors
+
+    # quiesced: every decision must match the oracle
+    oracle = CheckEngine(p)
+    sweep = [_rand_query(rng, users) for _ in range(150)]
+    got = engine.batch_check(sweep)
+    for q, g in zip(sweep, got):
+        assert g == oracle.subject_is_allowed(q), f"post-quiesce divergence on {q}"
+
+
+@pytest.fixture()
+def stress_daemon():
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 1, "name": "g"}, {"id": 2, "name": "d"}],
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+        }
+    )
+    reg = Registry(cfg)
+    d = Daemon(reg)
+    d.serve_all(block=False)
+    yield d, reg
+    d.shutdown()
+
+
+def test_daemon_mux_stress(stress_daemon):
+    """Clients through the real multiplexed port while the store mutates:
+    every response is a decision (200/403) — no 5xx, no hang — and the
+    post-quiesce sweep matches the oracle."""
+    d, reg = stress_daemon
+    rng = random.Random(6)
+    p = reg.relation_tuple_manager()
+    users = _seed_store(p, rng)
+
+    errors: list = []
+    stop = threading.Event()
+
+    def rest_check(q: RelationTuple) -> bool:
+        params = urllib.parse.urlencode(
+            {
+                "namespace": q.namespace,
+                "object": q.object,
+                "relation": q.relation,
+                "subject_id": q.subject.id,
+            }
+        )
+        try:
+            r = urllib.request.urlopen(
+                f"http://127.0.0.1:{d.read_port}/check?{params}", timeout=60
+            )
+            assert r.status == 200
+            return True
+        except urllib.error.HTTPError as e:
+            assert e.code == 403, f"unexpected status {e.code}"
+            return False
+
+    def client(seed):
+        crng = random.Random(seed)
+        try:
+            for _ in range(N_REQUESTS):
+                rest_check(_rand_query(crng, users))
+        except Exception as e:
+            errors.append(("client", repr(e)))
+            stop.set()  # abort the writer early on client failure
+
+    threads = [threading.Thread(target=client, args=(200 + i,)) for i in range(N_CLIENTS)]
+    threads.append(threading.Thread(target=_writer, args=(p, random.Random(11), stop, errors)))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+        assert not t.is_alive(), "stress thread hung (deadlock)"
+    stop.set()
+    assert not errors, errors
+
+    oracle = CheckEngine(p)
+    for _ in range(60):
+        q = _rand_query(rng, users)
+        assert rest_check(q) == oracle.subject_is_allowed(q), f"divergence on {q}"
