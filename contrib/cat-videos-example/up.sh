@@ -1,0 +1,36 @@
+#!/bin/bash
+# No-docker variant of the demo (reference contrib/cat-videos-example/up.sh):
+# serve, load the tuples, print the play-around commands. Run from the
+# repository root.
+set -euo pipefail
+
+python -m keto_tpu.cmd serve -c contrib/cat-videos-example/keto.yml &
+keto_server_pid=$!
+
+function teardown() {
+    kill $keto_server_pid || true
+}
+trap teardown EXIT
+
+export KETO_WRITE_REMOTE="127.0.0.1:4467"
+
+# retry until the write API accepts the tuples (server startup race)
+for i in $(seq 1 50); do
+    if python -m keto_tpu.cmd relation-tuple parse contrib/cat-videos-example/relation-tuples/tuples.txt --format json \
+        | python -m keto_tpu.cmd relation-tuple create -; then
+        break
+    fi
+    sleep 0.2
+done
+
+echo "
+
+Created all relation tuples. Now you can play around:
+
+export KETO_READ_REMOTE=\"127.0.0.1:4466\"
+python -m keto_tpu.cmd relation-tuple get videos
+python -m keto_tpu.cmd check '*' view videos /cats/1.mp4
+python -m keto_tpu.cmd expand view videos /cats/2.mp4
+"
+
+wait $keto_server_pid

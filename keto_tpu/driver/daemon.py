@@ -51,6 +51,11 @@ class Daemon:
 
     def serve_all(self, block: bool = True) -> None:
         cfg = self.registry.config()
+        # prime the namespace manager before accepting traffic: a watched
+        # source (file/dir/websocket URI) connects and loads at BOOT, the
+        # way the reference resolves config during registry Init
+        # (reference registry_default.go:240-261) — not on first request
+        self.registry.namespace_manager()
         read_host, read_port = cfg.read_api_address()
         write_host, write_port = cfg.write_api_address()
         self._roles[READ] = self._start_role(READ, read_host, read_port)

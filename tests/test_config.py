@@ -151,3 +151,103 @@ def test_max_read_depth_caps_rest_expand():
     assert child["subject_set"]["object"] == "b"
     assert child["type"] == "leaf" and "children" not in child
     cfg.close()
+
+
+def _wait_for(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_websocket_namespace_source():
+    """ws:// namespace URI: snapshots push over a live websocket, parse
+    errors keep last-good, and the watcher survives a dropped connection
+    (reference namespace_watcher.go:47-88 watches file/dir/ws URIs)."""
+    from tests.ws_test_server import WsTestServer
+    from keto_tpu.config.provider import NamespaceWatcher
+
+    srv = WsTestServer()
+    try:
+        w = NamespaceWatcher(srv.url, ws_initial_wait=0.1)
+        assert srv.wait_client(), "watcher never connected"
+        assert w.manager().namespaces() == []
+
+        srv.send_text(yaml.safe_dump([{"id": 1, "name": "alpha"}]))
+        assert _wait_for(lambda: [n.name for n in w.manager().namespaces()] == ["alpha"])
+
+        # malformed snapshot → keep last-good
+        srv.send_text("{not yaml::")
+        srv.send_text(yaml.safe_dump({"id": 2}))  # schema-invalid (no name)
+        time.sleep(0.3)
+        assert [n.name for n in w.manager().namespaces()] == ["alpha"]
+
+        # update pushes through
+        srv.send_text(yaml.safe_dump([{"id": 1, "name": "alpha"}, {"id": 2, "name": "beta"}]))
+        assert _wait_for(lambda: len(w.manager().namespaces()) == 2)
+
+        # server drops the connection → watcher reconnects and new
+        # snapshots still apply
+        srv.drop_client()
+        assert srv.wait_client(10), "watcher did not reconnect"
+        srv.send_text(yaml.safe_dump([{"id": 9, "name": "gamma"}]))
+        assert _wait_for(lambda: [n.name for n in w.manager().namespaces()] == ["gamma"], 10)
+        w.stop()
+    finally:
+        srv.close()
+
+
+def test_websocket_namespace_source_through_config():
+    """Config routes a ws:// namespaces URI through the watcher and fires
+    namespace-change callbacks on pushed snapshots."""
+    from tests.ws_test_server import WsTestServer
+
+    srv = WsTestServer()
+    try:
+        cfg = Config(overrides={"namespaces": srv.url})
+        fired = []
+        cfg.on_namespace_change(lambda: fired.append(1))
+        cfg.namespace_manager()  # watcher is constructed lazily
+        assert srv.wait_client(), "watcher never connected"
+        srv.send_text(yaml.safe_dump([{"id": 4, "name": "pushed"}]))
+        assert _wait_for(
+            lambda: [n.name for n in cfg.namespace_manager().namespaces()] == ["pushed"]
+        )
+        assert fired
+        cfg.close()
+    finally:
+        srv.close()
+
+
+def test_websocket_survives_mid_frame_timeout():
+    """Regression: a read timeout while a frame is partially delivered
+    must not desynchronize the stream — later snapshots still apply
+    (frame parsing is peek-based; no bytes consumed until the whole
+    frame is buffered)."""
+    import socket as socket_mod
+    import struct
+    from tests.ws_test_server import WsTestServer
+    from keto_tpu.config.provider import NamespaceWatcher
+
+    srv = WsTestServer()
+    try:
+        w = NamespaceWatcher(srv.url, ws_initial_wait=0.1)
+        assert srv.wait_client()
+        # deliver one frame split across a >0.5s gap (the watcher's read
+        # timeout), header+partial payload first
+        payload = yaml.safe_dump([{"id": 1, "name": "slow"}]).encode()
+        frame = bytes([0x81, len(payload)]) + payload
+        with srv._lock:
+            conn = srv._conn
+        conn.sendall(frame[:5])
+        time.sleep(1.2)  # the watcher times out mid-frame at least once
+        conn.sendall(frame[5:])
+        assert _wait_for(lambda: [n.name for n in w.manager().namespaces()] == ["slow"])
+        # stream must still be in sync: the next snapshot applies too
+        srv.send_text(yaml.safe_dump([{"id": 2, "name": "after"}]))
+        assert _wait_for(lambda: [n.name for n in w.manager().namespaces()] == ["after"])
+        w.stop()
+    finally:
+        srv.close()

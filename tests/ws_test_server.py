@@ -1,0 +1,104 @@
+"""Tiny RFC 6455 websocket SERVER for namespace-watcher tests.
+
+Accepts one client at a time, performs the upgrade handshake, and lets
+the test push text frames (server→client frames are unmasked per spec).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from keto_tpu.x.ws import accept_key
+
+
+class WsTestServer:
+    def __init__(self):
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(4)
+        self.port = self._srv.getsockname()[1]
+        self._conn: socket.socket | None = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._connected = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"ws://127.0.0.1:{self.port}/namespaces"
+
+    def _accept_loop(self):
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except TimeoutError:
+                continue
+            except OSError:
+                return
+            try:
+                self._handshake(conn)
+            except OSError:
+                conn.close()
+                continue
+            with self._lock:
+                old, self._conn = self._conn, conn
+            if old:
+                old.close()
+            self._connected.set()
+
+    @staticmethod
+    def _handshake(conn: socket.socket):
+        conn.settimeout(5)
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            got = conn.recv(4096)
+            if not got:
+                raise OSError("client vanished")
+            buf += got
+        key = ""
+        for line in buf.split(b"\r\n"):
+            k, _, v = line.partition(b":")
+            if k.strip().lower() == b"sec-websocket-key":
+                key = v.strip().decode()
+        conn.sendall(
+            (
+                "HTTP/1.1 101 Switching Protocols\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Accept: {accept_key(key)}\r\n\r\n"
+            ).encode()
+        )
+
+    def wait_client(self, timeout: float = 5.0) -> bool:
+        got = self._connected.wait(timeout)
+        self._connected.clear()
+        return got
+
+    def send_text(self, text: str):
+        payload = text.encode()
+        n = len(payload)
+        if n < 126:
+            head = bytes([0x81, n])
+        elif n < 1 << 16:
+            head = bytes([0x81, 126]) + struct.pack(">H", n)
+        else:
+            head = bytes([0x81, 127]) + struct.pack(">Q", n)
+        with self._lock:
+            assert self._conn is not None, "no client connected"
+            self._conn.sendall(head + payload)
+
+    def drop_client(self):
+        with self._lock:
+            if self._conn:
+                self._conn.close()
+                self._conn = None
+
+    def close(self):
+        self._stop.set()
+        self.drop_client()
+        self._srv.close()
+        self._thread.join(timeout=2)
