@@ -385,7 +385,7 @@ def run_config4(rng):
     stream_got = []
     t_prev = time.perf_counter()
     t_start = t_prev
-    for out in engine.batch_check_stream(iter(queries), depth=2):
+    for out in engine.batch_check_stream(iter(queries), depth=2, slice_cap=16384):
         now = time.perf_counter()
         slice_lat.append(now - t_prev)
         t_prev = now
@@ -496,7 +496,7 @@ def main():
     stream_got = []
     t0 = time.perf_counter()
     t_prev = t0
-    for out in engine.batch_check_stream(iter(queries), depth=2):
+    for out in engine.batch_check_stream(iter(queries), depth=2, slice_cap=16384):
         now = time.perf_counter()
         slice_lat.append(now - t_prev)
         t_prev = now

@@ -71,7 +71,7 @@ _PLACEHOLDER = b"0\x1f\x1f\x1f1\x1f\x1f\x1f\x1e"
 
 # batch widths (in 32-query words) the engine compiles for; a request is
 # padded up to the smallest fitting width so jit caches stay small
-_WORD_WIDTHS = (1, 8, 64, 256, 1024, 2048)
+_WORD_WIDTHS = (1, 8, 64, 256, 1024, 2048, 4096)
 # cap on the [rows, chunk, W] gather intermediate per bucket
 _DEGREE_CHUNK = 1024
 
@@ -99,18 +99,11 @@ def _pull(
 
 def check_step(
     bucket_nbrs: tuple[jnp.ndarray, ...],
-    e1_rows: jnp.ndarray,  # int32[S1] interior start rows (padding → n_int+1)
-    e1_words: jnp.ndarray,  # int32[S1] query word index
-    e1_masks: jnp.ndarray,  # uint32[S1] query bit mask (padding → 0)
-    e2_rows: jnp.ndarray,  # int32[S2] one-hop interior rows from static starts
-    e2_words: jnp.ndarray,  # int32[S2]
-    e2_masks: jnp.ndarray,  # uint32[S2]
-    a_rows: jnp.ndarray,  # int32[SA] interior in-neighbors of sink targets
-    a_q: jnp.ndarray,  # int32[SA] owning query index (padding → 0 w/ row n_int)
-    targets: jnp.ndarray,  # int32[B] interior target rows, n_int = none
+    entries: jnp.ndarray,  # int32[2·S1+2·S2+2·SA+B] packed entry arrays
     ov_nbrs: Optional[jnp.ndarray] = None,  # int32[K, C] overlay-ELL gather
     ov_dst: Optional[jnp.ndarray] = None,  # int32[K] unique active rows (pad → n_active)
     *,
+    sizes: tuple[int, int, int, int],  # (S1, S2, SA, B)
     n_active: int,
     n_int: int,
     valid_rows: tuple[int, ...],
@@ -118,7 +111,31 @@ def check_step(
     block_iters: int = 8,
     bitmap_sharding=None,  # NamedSharding for the [rows, words] bitmaps
 ) -> jnp.ndarray:
-    B = targets.shape[0]
+    # ``entries`` ships every per-batch host-built array in ONE H2D
+    # transfer — on tunneled devices transfer count pays round trips and
+    # transfer BYTES pay the tunnel's thin bandwidth, so seeds travel as
+    # 8-byte (row, query) pairs and the word index / bit mask derive on
+    # device. The layout (concatenated int32) is produced by
+    # pack_entries(); split points are static per kernel geometry:
+    #   e1_rows  int32[S1] interior start rows (padding → n_int+1)
+    #   e1_q     int32[S1] owning query index (padding → 0)
+    #   e2_*               same pair for host-propagated seeds
+    #   a_rows   int32[SA] interior in-neighbors of sink targets
+    #   a_q      int32[SA] owning query index (padding → 0 w/ row n_int)
+    #   targets  int32[B]  interior target rows, n_int = none
+    S1, S2, SA, B = sizes
+    o = 0
+    e1_rows = entries[o : o + S1]; o += S1
+    e1_q = entries[o : o + S1]; o += S1
+    e2_rows = entries[o : o + S2]; o += S2
+    e2_q = entries[o : o + S2]; o += S2
+    a_rows = entries[o : o + SA]; o += SA
+    a_q = entries[o : o + SA]; o += SA
+    targets = entries[o : o + B]
+    e1_words = e1_q >> 5
+    e1_masks = jnp.uint32(1) << (e1_q & 31).astype(jnp.uint32)
+    e2_words = e2_q >> 5
+    e2_masks = jnp.uint32(1) << (e2_q & 31).astype(jnp.uint32)
     W = B // 32
     q = jnp.arange(B)
     words = q // 32
@@ -230,9 +247,18 @@ def check_step(
 _check_kernel = partial(
     jax.jit,
     static_argnames=(
-        "n_active", "n_int", "valid_rows", "it_cap", "block_iters", "bitmap_sharding"
+        "sizes", "n_active", "n_int", "valid_rows", "it_cap", "block_iters",
+        "bitmap_sharding",
     ),
 )(check_step)
+
+
+def pack_entries(packed) -> tuple[np.ndarray, tuple[int, int, int, int]]:
+    """Concatenate pack_chunk's seven arrays into check_step's single
+    int32 ``entries`` buffer + static split sizes."""
+    (e1r, e1q, e2r, e2q, ar, aq, targets) = packed
+    buf = np.concatenate([e1r, e1q, e2r, e2q, ar, aq, targets])
+    return buf, (e1r.shape[0], e2r.shape[0], ar.shape[0], targets.shape[0])
 
 
 def _ceil_pow2(x: int) -> int:
@@ -259,20 +285,17 @@ def _entry_pad(B: int, size: int) -> int:
     return sp
 
 
-def _pad_entries(rows_l, words_l, masks_l, B: int, drop_row: int):
+def _pad_entries(rows_l, qs_l, B: int, drop_row: int):
     if rows_l:
         rows = np.concatenate(rows_l).astype(np.int32)
-        words = np.concatenate(words_l)
-        masks = np.concatenate(masks_l)
+        qs = np.concatenate(qs_l).astype(np.int32)
     else:
         rows = np.zeros(0, np.int32)
-        words = np.zeros(0, np.int32)
-        masks = np.zeros(0, np.uint32)
+        qs = np.zeros(0, np.int32)
     pad = _entry_pad(B, rows.size) - rows.size
     rows = np.concatenate([rows, np.full(pad, drop_row, np.int32)])
-    words = np.concatenate([words, np.zeros(pad, np.int32)])
-    masks = np.concatenate([masks, np.zeros(pad, np.uint32)])
-    return rows, words, masks
+    qs = np.concatenate([qs, np.zeros(pad, np.int32)])
+    return rows, qs
 
 
 def pack_chunk(
@@ -289,84 +312,116 @@ def pack_chunk(
     replacing the reference's per-traversal-step SQL round trips).
 
     ``sd``/``tg``/``multi`` come from ``TpuCheckEngine._resolve_bulk``.
-    Single static starts are propagated one hop here via the forward CSR
-    (out-neighbor lists are duplicate-free: both interners dedup edges);
-    hops landing on interior rows become device seeds, hops landing
-    directly on the query's sink target are answered on host. Sink targets
-    get answer-gather entries from the snapshot's sink reverse CSR.
+    Starts in the host-propagated classes (static, or peeled interior —
+    see the peel note in keto_tpu/graph/snapshot.py) expand here through
+    the forward CSR, one vectorized gather per hop over the whole chunk's
+    frontier: reached bitmap rows become device seeds (e2), reached
+    query targets are decided on host, and reached peeled rows continue
+    the frontier (the peeled subgraph is a DAG among base nodes; the
+    per-(query, row) visited filter also terminates cycles a delta
+    overlay may close). Sink targets get answer-gather entries from the
+    snapshot's sink reverse CSR.
 
-    Returns ``(packed, host_ans)`` where ``packed`` is ``(e1_rows,
-    e1_words, e1_masks, e2_rows, e2_words, e2_masks, a_rows, a_q,
-    targets)`` numpy arrays (None when no query has any device entry) and
-    ``host_ans`` is a bool[nq] of host-decided grants to OR into the
-    device answers.
+    Returns ``(packed, host_ans)`` where ``packed`` is ``(e1_rows, e1_q,
+    e2_rows, e2_q, a_rows, a_q, targets)`` numpy arrays (None when no
+    query has any device entry; pack_entries concatenates them into the
+    kernel's single buffer) and ``host_ans`` is a bool[nq] of
+    host-decided grants to OR into the device answers.
     """
     nq = i1 - i0
     W = force_W or next(w for w in _WORD_WIDTHS if 32 * w >= nq)
     B = 32 * W
     ni = snap.num_int
+    sb = snap.sink_base
     nl = snap.num_live
     qi = np.arange(nq)
-    qw = (qi // 32).astype(np.int32)
-    qm = (1 << (qi % 32)).astype(np.uint32)
     tgc = tg[i0:i1]
     sdc = sd[i0:i1]
     host_ans = np.zeros(nq, dtype=bool)
     targets = np.full(B, ni, dtype=np.int32)
-    targets[:nq] = np.where(tgc < ni, tgc, ni)
+    targets[:nq] = np.where((tgc >= 0) & (tgc < ni), tgc, ni)
 
-    e1: tuple[list, list, list] = ([], [], [])
-    e2: tuple[list, list, list] = ([], [], [])
+    e1: tuple[list, list] = ([], [])
+    e2: tuple[list, list] = ([], [])
     m_int = (sdc >= 0) & (sdc < ni)
     if m_int.any():
         e1[0].append(sdc[m_int])
-        e1[1].append(qw[m_int])
-        e1[2].append(qm[m_int])
-    # sink starts (ni ≤ sd < nl) have no out-edges: nothing to seed
-    m_stat = sdc >= nl
-    if m_stat.any():
-        rows, cnts = snap.out_neighbors_bulk(sdc[m_stat])
-        if rows.size:
-            gq = np.repeat(qi[m_stat], cnts)
-            m_hop_int = rows < ni
-            if m_hop_int.any():
-                e2[0].append(rows[m_hop_int])
-                e2[1].append(qw[gq[m_hop_int]])
-                e2[2].append(qm[gq[m_hop_int]])
-            # one hop straight onto the query's sink target: decided here
-            m_hop_sink = ~m_hop_int
-            if m_hop_sink.any():
-                gq_s = gq[m_hop_sink]
-                host_ans[gq_s[rows[m_hop_sink] == tgc[gq_s]]] = True
-    for i, (live, hop) in multi.items():
+        e1[1].append(qi[m_int])
+    # host-propagated starts: peeled interior, static, and overlay nodes
+    # (an overlay sink start has no out-edges and yields nothing). Base
+    # sink starts [sb, nl) have no out-edges: nothing to seed.
+    m_host = ((sdc >= ni) & (sdc < sb)) | (sdc >= nl)
+    prop_rows = [sdc[m_host]] if m_host.any() else []
+    prop_q = [qi[m_host]] if m_host.any() else []
+    for i, (live, hostp) in multi.items():
         if not (i0 <= i < i1):
             continue
         li = i - i0
-        w, m = qw[li], qm[li]
         if live.size:
             e1[0].append(live)
-            e1[1].append(np.full(live.size, w, np.int32))
-            e1[2].append(np.full(live.size, m, np.uint32))
-        if hop.size:
-            h_int = hop[hop < ni]
-            if h_int.size:
-                e2[0].append(h_int)
-                e2[1].append(np.full(h_int.size, w, np.int32))
-                e2[2].append(np.full(h_int.size, m, np.uint32))
-            # one hop straight onto a sink-class target (base sink range or
-            # overlay node; the nl sentinel never matches a hop — hops have
-            # in-edges, static ids don't)
-            tgt = tgc[li]
-            if tgt >= ni and tgt != nl and (hop == tgt).any():
-                host_ans[li] = True
+            e1[1].append(np.full(live.size, li, np.int64))
+        if hostp.size:
+            prop_rows.append(hostp)
+            prop_q.append(np.full(hostp.size, li, np.int64))
+
+    if prop_rows:
+        # multi-hop frontier propagation, (query, row)-deduplicated. The
+        # visited set stays SORTED so each hop's membership test is one
+        # searchsorted pass — np.isin against an unsorted history would
+        # re-sort the whole set every hop on this hot path.
+        rows = np.concatenate(prop_rows).astype(np.int64)
+        pq = np.concatenate(prop_q).astype(np.int64)
+        seen = np.zeros(0, np.int64)
+        seed_rows: list = []
+        seed_q: list = []
+        while rows.size:
+            key = (pq << 32) | rows
+            _, first = np.unique(key, return_index=True)
+            keep = np.sort(first)
+            rows, pq, key = rows[keep], pq[keep], key[keep]
+            if seen.size:
+                pos = np.clip(np.searchsorted(seen, key), 0, seen.size - 1)
+                fresh = seen[pos] != key
+                rows, pq, key = rows[fresh], pq[fresh], key[fresh]
+            if not rows.size:
+                break
+            ks = np.sort(key)
+            seen = np.insert(seen, np.searchsorted(seen, ks), ks)
+            nbrs, cnts = snap.out_neighbors_bulk(rows)
+            if not nbrs.size:
+                break
+            gq = np.repeat(pq, cnts)
+            nbrs = nbrs.astype(np.int64)
+            # a traversed edge landing on the query's target decides it
+            # ("reached via ≥ 1 edge" — real edges only). The -1 no-target
+            # sentinel can never match a neighbor id.
+            hit = nbrs == tgc[gq]
+            if hit.any():
+                host_ans[gq[hit]] = True
+            m_seed = nbrs < ni
+            if m_seed.any():
+                seed_rows.append(nbrs[m_seed])
+                seed_q.append(gq[m_seed])
+            m_next = (nbrs >= ni) & (nbrs < sb)
+            rows, pq = nbrs[m_next], gq[m_next]
+        if seed_rows:
+            # global (query, row) dedup: e2 scatter-adds per-bit, so a row
+            # seeded twice for one query would carry into the next bit
+            srows = np.concatenate(seed_rows)
+            sq = np.concatenate(seed_q)
+            skey = (sq << 32) | srows
+            _, sfirst = np.unique(skey, return_index=True)
+            keep = np.sort(sfirst)
+            e2[0].append(srows[keep])
+            e2[1].append(sq[keep])
 
     # answer-gather entries for sink targets of queries that have any start
-    has_start = (sdc >= 0) & (sdc < ni) | (sdc >= nl)
+    has_start = m_int | m_host
     for i in multi:
         if i0 <= i < i1:
             has_start[i - i0] = multi[i][0].size > 0 or multi[i][1].size > 0
     ans: tuple[list, list] = ([], [])
-    m_sink_t = (tgc >= ni) & (tgc < nl)
+    m_sink_t = (tgc >= sb) & (tgc < nl)
     if snap.ov_sink_in:
         # overlay targets (ids ≥ n_base) and base sinks with overlay
         # in-edges both answer through sink_in_rows_bulk
@@ -430,7 +485,7 @@ class TpuCheckEngine:
         max_batch: int = 32 * _WORD_WIDTHS[-1],
         mesh=None,
         shard_rows: bool = False,
-        mem_budget_bytes: int = 6 << 30,
+        mem_budget_bytes: int = 10 << 30,
     ):
         if it_cap < 1:
             raise ValueError("it_cap must be >= 1 (the answer pull needs one step)")
@@ -713,12 +768,12 @@ class TpuCheckEngine:
         t = r2d[np.clip(sub_raw, 0, None)]
         # a target only matters when the query has starts (matches the host
         # loop, which leaves tg at the unreachable row for start-less denies)
-        tg = np.where((sub_raw >= 0) & (t < nl) & (sd >= 0), t, nl)
+        tg = np.where((sub_raw >= 0) & (t < nl) & (sd >= 0), t, -1)
         if dead:
             # placeholder records may coincide with real nodes — force deny
             di = np.asarray(dead)
             sd[di] = -1
-            tg[di] = nl
+            tg[di] = -1
         multi: dict = {}
         if special:
             self._resolve_specials(snap, tuples, special, sd, tg, multi)
@@ -726,12 +781,12 @@ class TpuCheckEngine:
             # nodes created since the base build are invisible to the
             # resident C++ tables — re-resolve the queries whose start or
             # target missed through the overlay-aware host path, in ONE
-            # bulk call (tg == nl includes every guaranteed deny, so
+            # bulk call (tg == -1 includes every guaranteed deny, so
             # deny-heavy workloads would otherwise loop per query)
             done = set(special) | set(dead)
             miss = [
                 int(i)
-                for i in np.nonzero((sd == -1) | (tg == nl))[0]
+                for i in np.nonzero((sd == -1) | (tg == -1))[0]
                 if int(i) not in done
             ]
             if miss:
@@ -764,10 +819,15 @@ class TpuCheckEngine:
           (guaranteed deny: unknown namespace per engine.go:76-77, or no
           matching node), ``-2`` multi-start (wildcard pattern, rows in
           ``multi``), else a device id (live or static);
-        - ``tg[i]`` — target row, mapped to the all-zero row ``num_live``
-          when unreachable (static row, or no such node);
-        - ``multi`` — ``{i: (live start rows, deduplicated one-hop rows)}``
-          for wildcard-pattern queries.
+        - ``tg[i]`` — target row, or ``-1`` when unreachable (static row,
+          or no such node). -1 — not a node-id sentinel like ``num_live``
+          — because every id can be legitimate: in a base graph with zero
+          static nodes the first overlay node gets device id num_live,
+          and a node-id sentinel would collide with it in the walk's
+          target-hit check and the answer-gather key match;
+        - ``multi`` — ``{i: (live start rows, host-propagated start rows
+          — peeled/static, expanded at pack time)}`` for wildcard-pattern
+          queries.
 
         The common case (literal query, SubjectID) costs two intern-table
         lookups and two ``raw2dev`` reads — no numpy allocation.
@@ -775,7 +835,7 @@ class TpuCheckEngine:
         n = len(tuples)
         nl = snap.num_live
         sd = np.full(n, -1, np.int64)
-        tg = np.full(n, nl, np.int64)
+        tg = np.full(n, -1, np.int64)
         multi: dict = {}
         interned = snap.interned
         resolve_set = interned.resolve_set
@@ -859,19 +919,13 @@ class TpuCheckEngine:
             sd[i] = start_dev
             if starts is not None:
                 # interior starts seed the bitmap; sink starts (no
-                # out-edges) contribute nothing; static starts propagate
-                # one hop
-                live = starts[starts < snap.num_int]
-                static = starts[starts >= nl]
-                hop = np.zeros(0, np.int64)
-                if static.size:
-                    nbrs, _ = snap.out_neighbors_bulk(static)
-                    if nbrs.size:
-                        # cross-start dedup: two static starts of one query
-                        # may share an out-neighbor, and scatter-add bits
-                        # must stay disjoint per (row, query)
-                        hop = np.unique(nbrs).astype(np.int64)
-                multi[i] = (live, hop)
+                # out-edges) contribute nothing; peeled/static starts are
+                # host-propagated at pack time (pack_chunk)
+                ni = snap.num_int
+                sbase = snap.sink_base
+                live = starts[starts < ni]
+                hostp = starts[((starts >= ni) & (starts < sbase)) | (starts >= nl)]
+                multi[i] = (live, hostp)
         return sd, tg, multi
 
     # -- public API ----------------------------------------------------------
@@ -891,14 +945,22 @@ class TpuCheckEngine:
         self._after_batch(max_iters, any_truncated)
         return out.tolist()
 
-    def batch_check_stream(self, tuples_iter, *, depth: Optional[int] = None):
+    def batch_check_stream(
+        self,
+        tuples_iter,
+        *,
+        depth: Optional[int] = None,
+        slice_cap: Optional[int] = None,
+    ):
         """Streaming check: consume an iterable of RelationTuples, yield
         ``numpy bool[slice]`` decision arrays in order, keeping at most
         ``depth`` slices in flight (flat memory for arbitrarily long
         streams — BASELINE config 5's 1M-check batches never materialize
         device state for more than ``depth`` slices). Each yielded slice
         pays one D2H transfer, overlapped with later slices' host+device
-        work via ``copy_to_host_async``."""
+        work via ``copy_to_host_async``. ``slice_cap`` bounds the queries
+        per slice below the memory-derived maximum — smaller slices trade
+        throughput for per-slice service latency."""
         from collections import deque
 
         snap = self.snapshot()
@@ -914,9 +976,12 @@ class TpuCheckEngine:
             any_truncated = any_truncated or tr
             return out
 
+        cap = self._slice_cap(snap)
+        if slice_cap:
+            cap = min(cap, slice_cap)
         it = iter(tuples_iter)
         while True:
-            batch = list(itertools.islice(it, self._slice_cap(snap)))
+            batch = list(itertools.islice(it, cap))
             if not batch:
                 break
             if snap.n_nodes == 0 or snap.n_edges == 0:
@@ -951,30 +1016,34 @@ class TpuCheckEngine:
         self, snap: GraphSnapshot, sd: np.ndarray, tg: np.ndarray, multi: dict
     ) -> np.ndarray:
         """Per-query device entry counts (seeds + answer gathers) of a
-        resolved slice — the scatter/gather work a query adds to a kernel."""
+        resolved slice — the scatter/gather work a query adds to a kernel.
+        Host-propagated starts are estimated at one hop of out-degree (the
+        peeled closure is not walked here; this only balances sub-chunk
+        boundaries)."""
         n = sd.shape[0]
         ni = snap.num_int
+        sbase = snap.sink_base
         nl = snap.num_live
         ip = snap.fwd_indptr
         sp_ = snap.sink_indptr
         cnt = np.zeros(n, np.int64)
         m_int = (sd >= 0) & (sd < ni)
         cnt[m_int] = 1
-        m_stat = sd >= nl
-        if m_stat.any():
-            s = sd[m_stat]
+        m_host = ((sd >= ni) & (sd < sbase)) | (sd >= nl)
+        if m_host.any():
+            s = sd[m_host]
             in_b = s < snap.n_base_nodes
             c = np.ones(s.shape[0], np.int64)  # overlay adjacency ≈ small
-            sb = s[in_b]
-            c[in_b] = ip[sb + 1] - ip[sb]
-            cnt[m_stat] = c
-        has_start = m_int | m_stat
-        for i, (live, hop) in multi.items():
-            cnt[i] = live.size + hop.size
-            has_start[i] = live.size > 0 or hop.size > 0
-        m_ans = has_start & (tg >= ni) & (tg < nl)
+            sb_ = s[in_b]
+            c[in_b] = ip[sb_ + 1] - ip[sb_]
+            cnt[m_host] = c
+        has_start = m_int | m_host
+        for i, (live, hostp) in multi.items():
+            cnt[i] = live.size + hostp.size
+            has_start[i] = live.size > 0 or hostp.size > 0
+        m_ans = has_start & (tg >= sbase) & (tg < nl)
         if m_ans.any():
-            t = tg[m_ans] - ni
+            t = tg[m_ans] - sbase
             cnt[m_ans] += sp_[t + 1] - sp_[t]
         return cnt
 
@@ -1098,22 +1167,23 @@ class TpuCheckEngine:
             W = packed[-1].shape[0] // 32
             if W % self._mesh.shape.get("data", 1):
                 sharding = self._bitmap_sharding_rows_only
+        buf, sizes = pack_entries(packed)
         if self._multiprocess:
             # multi-controller runtime: jit inputs must be global arrays;
             # every process holds identical host data (the lockstep
-            # contract, parallel/mesh.py init_distributed), so replicate
-            # the packed entry arrays onto the mesh in one batched call
+            # contract, parallel/mesh.py init_distributed)
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            args = tuple(jax.device_put(packed, NamedSharding(self._mesh, P())))
+            entries = jax.device_put(buf, NamedSharding(self._mesh, P()))
         else:
-            args = tuple(jnp.asarray(a) for a in packed)
+            entries = jnp.asarray(buf)
         ov = snap.device_overlay
         dev = _check_kernel(
             snap.device_buckets,
-            *args,
+            entries,
             ov_nbrs=None if ov is None else ov[0],
             ov_dst=None if ov is None else ov[1],
+            sizes=sizes,
             n_active=snap.num_active,
             n_int=snap.num_int,
             valid_rows=tuple(b.n for b in snap.buckets),

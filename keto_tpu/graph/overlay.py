@@ -65,6 +65,7 @@ def apply_delta(
     rows = list(rows)
     ni = base.num_int
     na = base.num_active
+    sb = base.sink_base  # peeled interior ids live in [ni, sb)
     nl = base.num_live
     nb = base.n_base_nodes
 
@@ -154,7 +155,7 @@ def apply_delta(
             ov_class[lhs_dev] = "static"
         elif lhs_dev >= nb and ov_class.get(lhs_dev) == "sink":
             return None  # overlay sink node gains an out-edge
-        elif ni <= lhs_dev < nl:
+        elif sb <= lhs_dev < nl:
             return None  # base sink gains an out-edge: needs a bitmap row
         if lhs_dev != sub_dev:
             # a self-loop adds nothing to reachability — but wildcard
@@ -173,7 +174,7 @@ def apply_delta(
                 wdev = int(wdev)
                 if wdev == lhs_dev or wdev == sub_dev:
                     continue
-                if ni <= wdev < nl:
+                if sb <= wdev < nl:
                     return None  # wildcard node is a base sink (shouldn't
                     # happen: it has out-edges) — be safe
                 new_edges.append((wdev, sub_dev))
@@ -196,24 +197,33 @@ def apply_delta(
     for src, dst in new_edges:
         if in_base_csr(src, dst):
             continue
-        dst_interior = dst < ni
-        dst_sinkish = (ni <= dst < nl) or (dst >= nb and ov_class.get(dst) == "sink")
-        if dst >= nl and dst < nb:
+        if nl <= dst < nb:
             return None  # base static node gains an in-edge
-        src_interior = src < ni
-        src_staticish = (nl <= src < nb) or (src >= nb and ov_class.get(src) == "static")
-        if not (src_interior or src_staticish):
-            return None  # source would need class change
-        if src_interior and dst_interior:
-            if dst >= na:
-                return None  # passive-interior row: the BFS loop never
-                # updates it, so a new in-edge from an interior source
-                # needs a relayout
-            ell.append((src, dst))
-        elif src_staticish:
+        src_bitmap = src < ni
+        # host-propagated sources: peeled interior, base static, overlay
+        # static — their new out-edges extend the host propagation
+        # adjacency (pack_chunk walks them), whatever the destination
+        src_hostprop = (
+            (ni <= src < sb)
+            or (nl <= src < nb)
+            or (src >= nb and ov_class.get(src) == "static")
+        )
+        if src_bitmap:
+            if dst < ni:
+                if dst >= na:
+                    return None  # passive bitmap row: the BFS loop never
+                    # updates it, so a new in-edge from a bitmap source
+                    # needs a relayout
+                ell.append((src, dst))
+            elif ni <= dst < sb:
+                return None  # peeled row gains a device-dependent in-edge:
+                # its init-constant property breaks — relayout
+            else:  # sink-class dst (base sink or overlay sink node)
+                add_sink_in.setdefault(dst, []).append(src)
+        elif src_hostprop:
             add_out.setdefault(src, []).append(dst)
-        else:  # interior src → sink-class dst
-            add_sink_in.setdefault(dst, []).append(src)
+        else:
+            return None  # sink source would need class change
 
     for src, dsts in add_out.items():
         old = ov_out.get(src)

@@ -109,12 +109,16 @@ class GraphSnapshot:
     num_leaves: int
     #: device ids < num_active are iterated by the BFS loop
     num_active: int
-    #: device ids < num_int are interior (active + passive); the device
-    #: bitmap has num_int+1 rows (last row all-zero)
+    #: device ids < num_int are interior with bitmap rows (active +
+    #: passive); the device bitmap has num_int+1 rows (last row all-zero)
     num_int: int
-    #: device ids in [num_int, num_live) are sinks; ids ≥ num_live are
-    #: static (no in-edges)
+    #: device ids in [num_int, num_live) split into peeled interior
+    #: [num_int, sink_base) — init-constant rows folded into host
+    #: propagation, see build_snapshot's peel note — and sinks
+    #: [sink_base, num_live); ids ≥ num_live are static (no in-edges)
     num_live: int
+    #: count of peeled interior nodes (sink_base = num_int + n_peeled)
+    n_peeled: int
     buckets: list[Bucket]
     # string→raw-id resolution: an InternedGraph (Python dicts) or a
     # NativeInterned (resident C++ tables) — same interface either way
@@ -153,6 +157,12 @@ class GraphSnapshot:
     @property
     def n_nodes(self) -> int:
         return self.num_sets + self.num_leaves
+
+    @property
+    def sink_base(self) -> int:
+        """First sink device id (peeled interior ids come before)."""
+        return self.num_int + self.n_peeled
+
 
     @property
     def n_base_nodes(self) -> int:
@@ -279,7 +289,15 @@ class GraphSnapshot:
         ov = self.ov_out
         if ov is None or not ov:
             return rows, cnts
-        member = np.asarray([int(n) in ov for n in nodes], bool)
+        # vectorized membership: pack_chunk's multi-hop propagation calls
+        # this per hop with frontiers of thousands of rows — a Python
+        # per-element `in` loop would serialize the hot path
+        with self._cache_lock:
+            ov_keys = self._pattern_cache.get("_ov_out_keys")
+            if ov_keys is None:
+                ov_keys = np.fromiter(ov.keys(), np.int64, len(ov))
+                self._pattern_cache["_ov_out_keys"] = ov_keys
+        member = np.isin(nodes, ov_keys)
         if not member.any():
             return rows, cnts
         ends = np.cumsum(cnts)
@@ -296,11 +314,11 @@ class GraphSnapshot:
         per-target counts) — base sink reverse CSR merged with overlay
         in-edges. ``sinks`` are device ids (base sinks or overlay nodes)."""
         sinks = np.asarray(sinks)
-        ni, nl = self.num_int, self.num_live
+        sb, nl = self.sink_base, self.num_live
         if self.ov_sink_in is None or not self.ov_sink_in:
-            return _csr_gather_host(self.sink_indptr, self.sink_indices, sinks - ni)
-        in_base = (sinks >= ni) & (sinks < nl)
-        base_idx = np.where(in_base, sinks - ni, 0)
+            return _csr_gather_host(self.sink_indptr, self.sink_indices, sinks - sb)
+        in_base = (sinks >= sb) & (sinks < nl)
+        base_idx = np.where(in_base, sinks - sb, 0)
         cnts = np.where(
             in_base,
             self.sink_indptr[base_idx + 1] - self.sink_indptr[base_idx],
@@ -396,6 +414,7 @@ def build_snapshot(
             num_active=0,
             num_int=0,
             num_live=0,
+            n_peeled=0,
             buckets=[],
             interned=g,
             raw2dev=np.zeros(0, np.int64),
@@ -412,20 +431,67 @@ def build_snapshot(
     has_out = out_deg > 0
     interior = has_in & has_out
     sink = has_in & ~has_out
-    # iterated ("ELL") edges: interior → interior. Edges from static
-    # sources are the batch-time one-hop term; edges into sinks are
-    # answer-time gathers — neither is materialized in the loop.
-    ell_edge = has_in[src_raw] & has_out[dst_raw]
+
+    # --- peel ---------------------------------------------------------------
+    # An interior node whose in-edges all come from static or
+    # already-peeled nodes has an init-CONSTANT bitmap row: its reached
+    # bits never change during the BFS loop. If it additionally has no
+    # out-edge into a sink (so forward expansion can't fan into the
+    # subject-leaf population), it leaves the device entirely — its effect
+    # folds into the per-batch host propagation (tpu_engine.pack_chunk),
+    # which generalizes the static one-hop term to the peeled DAG. This is
+    # the big lever on grant-chain workloads: e.g. the GitHub-shaped
+    # BASELINE config 4, where issues→repos→orgs chains peel ~80% of the
+    # bitmap rows and ~90% of the gather entries out of the kernel.
+    has_sink_out = np.zeros(n, bool)
+    m = sink[dst_raw]
+    if m.any():
+        has_sink_out[np.unique(src_raw[m])] = True
+    # Seed-inflation guard: peeling trades device gather work for
+    # host-computed seed entries shipped per batch — on tunneled devices
+    # the H2D bytes are the scarcest resource, so a node only peels when
+    # the number of bitmap seeds it would expand to (its forward closure
+    # through already-peeled nodes) stays small. A high-fanout hub (e.g.
+    # an org granting 25 teams) keeps its bitmap row; its fanout stays a
+    # device edge gathered per iteration instead of 25 seeds per query.
+    SEED_CAP = 4.0
+    peeled = np.zeros(n, bool)
+    closure = np.zeros(n)  # seeds a peeled node expands to
+    for _ in range(16):  # bounded: adversarial deep chains stay active
+        blockers = interior & ~peeled
+        deg = np.bincount(dst_raw[blockers[src_raw]], minlength=n)
+        cand = interior & ~peeled & (deg == 0) & ~has_sink_out
+        if not cand.any():
+            break
+        # candidates never point at same-round candidates (that would be
+        # an unpeeled-interior in-edge), so contributions are well-defined
+        contrib = np.where(peeled[dst_raw], closure[dst_raw], 1.0)
+        cand_closure = np.bincount(src_raw, weights=contrib, minlength=n)
+        newly = cand & (cand_closure <= SEED_CAP)
+        if not newly.any():
+            break
+        peeled |= newly
+        closure[newly] = cand_closure[newly]
+
+    live_int = interior & ~peeled  # nodes with bitmap rows
+    # iterated ("ELL") edges: unpeeled interior → unpeeled interior. Edges
+    # from static/peeled sources are the batch-time host-propagation term;
+    # edges into sinks are answer-time gathers — neither is materialized
+    # in the loop. (A sink's in-neighbors are never peeled: an edge into a
+    # sink is exactly what blocks peeling — the answer gather relies on
+    # this.)
+    ell_edge = live_int[src_raw] & live_int[dst_raw]
     int_in_deg = np.bincount(dst_raw[ell_edge], minlength=n)
 
     # bucket key: ceil-log2(interior in-degree) + 1 for active-interior;
-    # passive-interior 61, sinks 62, static 63
+    # passive-interior 61, peeled 62, sinks 63, static 64
     with np.errstate(divide="ignore"):
         bucket_key = np.ceil(np.log2(np.maximum(int_in_deg, 1))).astype(np.int64) + 1
     bucket_key[int_in_deg == 1] = 1
-    bucket_key[interior & (int_in_deg == 0)] = 61
-    bucket_key[sink] = 62
-    bucket_key[~has_in] = 63
+    bucket_key[live_int & (int_in_deg == 0)] = 61
+    bucket_key[peeled] = 62
+    bucket_key[sink] = 63
+    bucket_key[~has_in] = 64
 
     # renumber: device order sorts by (bucket, raw id); raw2dev inverts it
     dev_order = np.lexsort((np.arange(n), bucket_key))
@@ -433,7 +499,8 @@ def build_snapshot(
     raw2dev[dev_order] = np.arange(n)
 
     num_active = int(np.count_nonzero(bucket_key < 61))
-    num_int = int(np.count_nonzero(interior))
+    num_int = int(np.count_nonzero(live_int))
+    n_peeled = int(np.count_nonzero(peeled))
     num_live = int(np.count_nonzero(has_in))
 
     # group ELL edges by destination device id; cumcount gives the column
@@ -469,11 +536,13 @@ def build_snapshot(
     findptr = np.searchsorted(fsrc, np.arange(n + 1))
 
     # sink reverse CSR: interior in-neighbors per sink, for answer gathers
+    # (all unpeeled by construction — see the peel note above)
     s_edge = has_in[src_raw] & sink[dst_raw]
-    s_dst = raw2dev[dst_raw[s_edge]] - num_int
+    sink_base = num_int + n_peeled
+    s_dst = raw2dev[dst_raw[s_edge]] - sink_base
     s_src = raw2dev[src_raw[s_edge]].astype(np.int32)
     sorder = np.argsort(s_dst, kind="stable")
-    n_sink = num_live - num_int
+    n_sink = num_live - sink_base
     sink_indptr = np.searchsorted(s_dst[sorder], np.arange(n_sink + 1))
     sink_indices = s_src[sorder]
 
@@ -483,6 +552,7 @@ def build_snapshot(
         num_leaves=g.num_leaves,
         num_active=num_active,
         num_int=num_int,
+        n_peeled=n_peeled,
         num_live=num_live,
         buckets=buckets,
         interned=g,

@@ -29,27 +29,42 @@ def log(*a):
 
 
 def main():
+    import os
+
     n_tuples = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
     n_checks = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
     rng = random.Random(42)
     log(f"devices: {jax.devices()}")
 
     t0 = time.perf_counter()
-    tuples, doc_grant, membership, user_reaches, member_of, n_users, T = build_workload(rng, n_tuples)
-    nm = namespace_pkg.MemoryManager(
-        [namespace_pkg.Namespace(id=1, name="groups"), namespace_pkg.Namespace(id=2, name="docs")]
-    )
+    if os.environ.get("PROF_WORKLOAD") == "github":
+        from bench import build_workload_github, make_queries_github
+
+        tuples, ctx = build_workload_github(rng, n_tuples)
+        nm = namespace_pkg.MemoryManager(
+            [
+                namespace_pkg.Namespace(id=i + 1, name=n)
+                for i, n in enumerate(("orgs", "teams", "repos", "issues", "pulls"))
+            ]
+        )
+        queries_fn = lambda: make_queries_github(rng, n_checks, ctx)  # noqa: E731
+    else:
+        tuples, doc_grant, membership, user_reaches, member_of, n_users, T = build_workload(rng, n_tuples)
+        nm = namespace_pkg.MemoryManager(
+            [namespace_pkg.Namespace(id=1, name="groups"), namespace_pkg.Namespace(id=2, name="docs")]
+        )
+        queries_fn = lambda: make_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T)  # noqa: E731
     store = MemoryPersister(nm)
     store.write_relation_tuples(*tuples)
-    import os
     mb = int(os.environ.get("PROF_MAX_BATCH", 32 * te._WORD_WIDTHS[-1]))
-    engine = TpuCheckEngine(store, store.namespaces, max_batch=mb)
+    budget = int(float(os.environ.get("PROF_MEM_GB", "6")) * (1 << 30))
+    engine = TpuCheckEngine(store, store.namespaces, max_batch=mb, mem_budget_bytes=budget)
     snap = engine.snapshot()
     log(f"setup {time.perf_counter()-t0:.1f}s; nodes={snap.n_nodes} "
         f"active={snap.num_active} int={snap.num_int} live={snap.num_live} "
         f"buckets={[(b.n, b.nbrs.shape) for b in snap.buckets]}")
 
-    queries, expected = make_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T)
+    queries, expected = queries_fn()
 
     # warmup / compile
     t0 = time.perf_counter()
@@ -63,7 +78,8 @@ def main():
     log(f"resolve_bulk: {t_resolve*1e3:.0f} ms ({n_checks/t_resolve:,.0f} q/s), multi={len(multi)}")
 
     # --- stage 2: pack all chunks (host only) ---
-    cap = engine._max_batch
+    cap = engine._slice_cap(snap)
+    log(f"slice cap {cap} queries (W={cap // 32})")
     bounds = [(i, min(i + cap, n_checks)) for i in range(0, n_checks, cap)]
     W = next(w for w in te._WORD_WIDTHS if 32 * w >= min(cap, n_checks))
     t0 = time.perf_counter()
@@ -78,11 +94,12 @@ def main():
     packs = [(p, h) for p, h in packs if p is not None]
     for (packed, host_ans) in packs:
         t0 = time.perf_counter()
-        dev_args = [jnp.asarray(a) for a in packed]
+        buf, sizes = te.pack_entries(packed)
+        entries = jnp.asarray(buf)
         t_xfer += time.perf_counter() - t0
         t0 = time.perf_counter()
         out = te._check_kernel(
-            snap.device_buckets, *dev_args,
+            snap.device_buckets, entries, sizes=sizes,
             n_active=snap.num_active, n_int=snap.num_int,
             valid_rows=tuple(b.n for b in snap.buckets),
             it_cap=engine._it_cap, block_iters=engine._block_iters,
@@ -101,14 +118,15 @@ def main():
         log("no device chunks; skipping device-only stage")
         return
     packed, _ = packs[0]
-    dev_args = [jax.device_put(jnp.asarray(a)) for a in packed]
-    jax.block_until_ready(dev_args)
+    buf, sizes = te.pack_entries(packed)
+    dev_entries = jax.device_put(jnp.asarray(buf))
+    jax.block_until_ready(dev_entries)
     reps = max(4, len(packs))
     t0 = time.perf_counter()
     outs = []
     for _ in range(reps):
         outs.append(te._check_kernel(
-            snap.device_buckets, *dev_args,
+            snap.device_buckets, dev_entries, sizes=sizes,
             n_active=snap.num_active, n_int=snap.num_int,
             valid_rows=tuple(b.n for b in snap.buckets),
             it_cap=engine._it_cap, block_iters=engine._block_iters,

@@ -105,14 +105,22 @@ def test_delta_never_reinterns():
 
 def test_multi_hop_through_overlay_ell_edges():
     p = make_store()
-    # two disjoint chains; g2/h2 are active-interior (interior in-neighbor)
+    # two disjoint chains; the mutual g2↔g2b / h2↔h2b edges give g2/h2
+    # in-edges from UNPEELED interior nodes, keeping them active-interior
+    # (in-edges only from peeled/static rows would make them passive and
+    # the delta below would rebuild instead of overlay — see the peel
+    # note in keto_tpu/graph/snapshot.py)
     p.write_relation_tuples(
         T("d", "doc", "view", SubjectSet("g", "g1", "m")),
         T("g", "g1", "m", SubjectSet("g", "g2", "m")),
         T("g", "g2", "m", SubjectID("u1")),
+        T("g", "g2", "m", SubjectSet("g", "g2b", "m")),
+        T("g", "g2b", "m", SubjectSet("g", "g2", "m")),
         T("d", "doc2", "view", SubjectSet("g", "h1", "m")),
         T("g", "h1", "m", SubjectSet("g", "h2", "m")),
         T("g", "h2", "m", SubjectID("u2")),
+        T("g", "h2", "m", SubjectSet("g", "h2b", "m")),
+        T("g", "h2b", "m", SubjectSet("g", "h2", "m")),
     )
     engine = TpuCheckEngine(p, p.namespaces)
     engine.snapshot()
@@ -227,9 +235,13 @@ def test_overlay_upload_sharding_rank():
         T("d", "doc", "view", SubjectSet("g", "g1", "m")),
         T("g", "g1", "m", SubjectSet("g", "g2", "m")),
         T("g", "g2", "m", SubjectID("u1")),
+        T("g", "g2", "m", SubjectSet("g", "g2b", "m")),
+        T("g", "g2b", "m", SubjectSet("g", "g2", "m")),
         T("d", "doc2", "view", SubjectSet("g", "h1", "m")),
         T("g", "h1", "m", SubjectSet("g", "h2", "m")),
         T("g", "h2", "m", SubjectID("u2")),
+        T("g", "h2", "m", SubjectSet("g", "h2b", "m")),
+        T("g", "h2b", "m", SubjectSet("g", "h2", "m")),
     )
     mesh = make_mesh()
     engine = TpuCheckEngine(p, p.namespaces, mesh=mesh, shard_rows=True)
@@ -393,3 +405,37 @@ def test_sqlite_rows_since(tmp_path):
     assert p.rows_since(wm1) is None
     assert not engine.subject_is_allowed(T("d", "doc", "view", SubjectID("bob")))
     assert engine.subject_is_allowed(T("d", "doc", "view", SubjectID("carol")))
+
+
+def test_no_target_sentinel_never_collides_with_overlay_ids():
+    """Regression: in a base graph with ZERO static nodes, num_live ==
+    n_base_nodes, so the first overlay node gets device id num_live — a
+    node-id 'unreachable target' sentinel would collide with it in the
+    host walk's target-hit check and grant nonexistent targets. The
+    sentinel is -1 now; both the deny and the legit overlay-target grant
+    must hold."""
+    p = make_store()
+    # every set key also appears as a subject → no static nodes
+    p.write_relation_tuples(
+        T("g", "a", "m", SubjectSet("g", "b", "m")),
+        T("g", "b", "m", SubjectSet("g", "a", "m")),
+        T("g", "b", "m", SubjectID("u1")),
+    )
+    engine = TpuCheckEngine(p, p.namespaces)
+    snap = engine.snapshot()
+    assert snap.num_live == snap.n_base_nodes, "fixture must have no static nodes"
+    # delta: new LHS X grants new subject S → S is an overlay node at id
+    # num_live, reached through the host walk (X is overlay-static)
+    p.write_relation_tuples(T("g", "x", "m", SubjectID("s_new")))
+    snap2 = engine.snapshot()
+    assert snap2.ov_leaf_ids and min(snap2.ov_leaf_ids.values()) >= snap.num_live
+    assert_parity(
+        engine,
+        p,
+        [
+            T("g", "x", "m", SubjectID("ghost")),  # nonexistent target → deny
+            T("g", "x", "m", SubjectID("s_new")),  # legit overlay target → grant
+            T("g", "a", "m", SubjectID("ghost")),
+            T("g", "a", "m", SubjectID("u1")),
+        ],
+    )

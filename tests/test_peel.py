@@ -1,0 +1,139 @@
+"""Peeled-interior layout (keto_tpu/graph/snapshot.py peel note).
+
+Grant-chain nodes whose rows would be init-constant leave the device and
+fold into pack-time host propagation. Decisions must be identical to the
+recursive oracle for every start/target class — peeled starts, peeled
+targets, chains through multiple peeled layers, and deltas touching
+peeled nodes.
+"""
+
+import random
+
+import pytest
+
+from keto_tpu.check import CheckEngine
+from keto_tpu.check.tpu_engine import TpuCheckEngine
+from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+
+
+def T(ns, obj, rel, sub):
+    return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+
+def _github_store(make_persister, rng, n_teams=12, n_repos=10, n_issues=14):
+    """Miniature BASELINE-config-4 shape: issues→repos→orgs→teams→users.
+    Repos and orgs peel (no sink out-edges, in-edges only from
+    static/peeled); teams stay active (nesting + user members)."""
+    p = make_persister([("orgs", 1), ("teams", 2), ("repos", 3), ("issues", 4)])
+    tuples = []
+    for t in range(1, n_teams):
+        parent = rng.randrange(t)
+        tuples.append(T("teams", f"t{parent}", "m", SubjectSet("teams", f"t{t}", "m")))
+    for t in range(n_teams):
+        for u in rng.sample(range(8), 2):
+            tuples.append(T("teams", f"t{t}", "m", SubjectID(f"u{u}")))
+    tuples.append(T("orgs", "acme", "member", SubjectSet("teams", "t0", "m")))
+    for r in range(n_repos):
+        sub = (
+            SubjectSet("orgs", "acme", "member")
+            if rng.random() < 0.5
+            else SubjectSet("teams", f"t{rng.randrange(n_teams)}", "m")
+        )
+        tuples.append(T("repos", f"r{r}", "reader", sub))
+    for i in range(n_issues):
+        tuples.append(
+            T("issues", f"i{i}", "view", SubjectSet("repos", f"r{rng.randrange(n_repos)}", "reader"))
+        )
+    p.write_relation_tuples(*tuples)
+    return p
+
+
+def _assert_parity(engine, p, queries):
+    oracle = CheckEngine(p)
+    got = engine.batch_check(queries)
+    for q, g in zip(queries, got):
+        w = oracle.subject_is_allowed(q)
+        assert g == w, f"divergence on {q}: tpu={g} oracle={w}"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_chain_workload_peels_and_matches_oracle(make_persister, seed):
+    rng = random.Random(seed)
+    p = _github_store(make_persister, rng)
+    engine = TpuCheckEngine(p, p.namespaces)
+    snap = engine.snapshot()
+    assert snap.n_peeled > 0, "expected repos/orgs to peel"
+    queries = []
+    for _ in range(120):
+        kind = rng.randrange(4)
+        if kind == 0:  # deepest chain: issue view
+            queries.append(T("issues", f"i{rng.randrange(14)}", "view", SubjectID(f"u{rng.randrange(10)}")))
+        elif kind == 1:  # peeled START: repo reader as the queried set
+            queries.append(T("repos", f"r{rng.randrange(10)}", "reader", SubjectID(f"u{rng.randrange(10)}")))
+        elif kind == 2:  # peeled TARGET: reaching a repo's reader set
+            queries.append(
+                T("issues", f"i{rng.randrange(14)}", "view", SubjectSet("repos", f"r{rng.randrange(10)}", "reader"))
+            )
+        else:  # active-layer query
+            queries.append(T("teams", f"t{rng.randrange(12)}", "m", SubjectID(f"u{rng.randrange(10)}")))
+    _assert_parity(engine, p, queries)
+
+
+def test_peeled_target_unreachable_from_active_start(make_persister):
+    """A peeled node's in-edges are all static/peeled by construction, so
+    a query from an active start to a peeled target must deny (and the
+    host-decided grant path must not fire without a real edge)."""
+    rng = random.Random(7)
+    p = _github_store(make_persister, rng)
+    engine = TpuCheckEngine(p, p.namespaces)
+    _assert_parity(
+        engine,
+        p,
+        [
+            T("teams", "t0", "m", SubjectSet("repos", "r0", "reader")),
+            T("repos", "r0", "reader", SubjectSet("repos", "r0", "reader")),  # self, no edge
+            T("issues", "i0", "view", SubjectSet("orgs", "acme", "member")),
+        ],
+    )
+
+
+def test_delta_edges_touching_peeled_nodes(make_persister):
+    """Deltas from/to peeled nodes: a peeled source's new out-edge extends
+    host propagation (overlay add_out); an edge INTO a peeled node forces
+    a rebuild. Decisions match the oracle either way."""
+    rng = random.Random(11)
+    p = _github_store(make_persister, rng)
+    engine = TpuCheckEngine(p, p.namespaces)
+    snap0 = engine.snapshot()
+    assert snap0.n_peeled > 0
+
+    # peeled src (repo reader) grants to another team — new out-edge
+    p.write_relation_tuples(T("repos", "r0", "reader", SubjectSet("teams", "t3", "m")))
+    _assert_parity(
+        engine, p,
+        [T("repos", "r0", "reader", SubjectID(f"u{u}")) for u in range(8)]
+        + [T("issues", f"i{i}", "view", SubjectID("u1")) for i in range(14)],
+    )
+
+    # edge INTO a peeled node (team grants repo-reader membership —
+    # unusual but legal): must still answer correctly (rebuild path)
+    p.write_relation_tuples(T("teams", "t1", "m", SubjectSet("repos", "r1", "reader")))
+    _assert_parity(
+        engine, p,
+        [T("teams", "t1", "m", SubjectID(f"u{u}")) for u in range(8)]
+        + [T("teams", "t0", "m", SubjectID(f"u{u}")) for u in range(8)],
+    )
+
+
+def test_wildcard_pattern_with_peeled_matches(make_persister):
+    """resolve_starts patterns that match peeled set nodes route them
+    through host propagation (the multi path's hostprop rows)."""
+    rng = random.Random(3)
+    p = _github_store(make_persister, rng)
+    engine = TpuCheckEngine(p, p.namespaces)
+    _assert_parity(
+        engine, p,
+        [T("repos", "", "reader", SubjectID(f"u{u}")) for u in range(8)]
+        + [T("issues", "", "", SubjectID(f"u{u}")) for u in range(8)]
+        + [T("", "", "", SubjectID("u0"))],
+    )
