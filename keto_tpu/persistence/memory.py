@@ -65,6 +65,15 @@ class InternalRow:
             object.__setattr__(self, "_packed", cached)
         return cached
 
+    def key7(self):
+        """Row identity for delete matching — the 7 user-visible fields
+        (shared by the main row list, the LHS index, and delete-key
+        construction; keep all three on this one definition)."""
+        return (
+            self.namespace_id, self.object, self.relation, self.subject_id,
+            self.sset_namespace_id, self.sset_object, self.sset_relation,
+        )
+
     def sort_key(self):
         # ORDER BY namespace_id, object, relation, subject_id,
         #   subject_set_namespace_id, subject_set_object, subject_set_relation,
@@ -258,10 +267,7 @@ class MemoryPersister(Manager):
             new_rows = [self._to_row(rt) for rt in insert]
             delete_keys = []
             for rt in delete:
-                row = self._to_row(rt)
-                delete_keys.append(
-                    (row.namespace_id, row.object, row.relation, row.subject_id, row.sset_namespace_id, row.sset_object, row.sset_relation)
-                )
+                delete_keys.append(self._to_row(rt).key7())
             rows = self._rows()
             if len(new_rows) > 256:
                 # bulk load: one sort beats per-row insort's O(n) memmoves
@@ -273,12 +279,32 @@ class MemoryPersister(Manager):
             if delete_keys:
                 keyset = set(delete_keys)
                 self._shared.rows[self.network_id] = [
-                    r
-                    for r in rows
-                    if (r.namespace_id, r.object, r.relation, r.subject_id, r.sset_namespace_id, r.sset_object, r.sset_relation)
-                    not in keyset
+                    r for r in rows if r.key7() not in keyset
                 ]
-            self._shared.lhs_index = None
+            # maintain the LHS index incrementally: a full invalidation
+            # per write made every post-write indexed read pay an O(rows)
+            # rebuild (walls at tens of millions of tuples). Buckets stay
+            # sort_key-ordered via insort; deletes filter only the
+            # targeted buckets; bulk loads fall back to one lazy rebuild.
+            idx = self._shared.lhs_index
+            if idx is not None:
+                if len(new_rows) > 4096:
+                    self._shared.lhs_index = None
+                else:
+                    nid0 = self.network_id
+                    for r in new_rows:
+                        bucket = idx.setdefault(
+                            (nid0, r.namespace_id, r.object, r.relation), []
+                        )
+                        bisect.insort(bucket, r, key=InternalRow.sort_key)
+                    if delete_keys:
+                        for k7 in set(delete_keys):
+                            bk = (nid0, k7[0], k7[1], k7[2])
+                            b = idx.get(bk)
+                            if b:
+                                idx[bk] = [
+                                    r for r in b if r.key7() not in keyset
+                                ]
             self._shared.watermark += 1
             wm = self._shared.watermark
             nid = self.network_id

@@ -150,6 +150,11 @@ class SQLitePersister(Manager):
             _path_from_dsn(dsn), check_same_thread=False, isolation_level=None
         )
         self._dsn = dsn
+        # snapshot-row cache: (sorted InternalRow list, watermark). Full
+        # rebuild reads at 50M rows would otherwise re-read and re-encode
+        # every row per snapshot; insert-only advances extend the cache
+        # from the commit_time log instead (deletes invalidate).
+        self._snap_cache: Optional[tuple[list, int]] = None
         with self._lock:
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS keto_migrations "
@@ -380,16 +385,62 @@ class SQLitePersister(Manager):
     # -- snapshot support (TPU graph builder) --------------------------------
 
     def snapshot_rows(self) -> tuple[list[InternalRow], int]:
-        """Consistent (rows, watermark) view for the TPU graph builder."""
+        """Consistent (rows, watermark) view for the TPU graph builder.
+
+        Rows come back in the Manager's ORDER BY (the expand engine's
+        tree-child order rides on snapshot row order — see the interner
+        dedup note). Insert-only watermark advances extend the in-process
+        cache via the commit_time log, merge-inserted to keep the order;
+        deletes (delete_wm moved) fall back to the full ordered read."""
+        import heapq
+
         with self._lock:
-            rows = self._conn.execute(
-                f"SELECT namespace_id, object, relation, subject_id, subject_set_namespace_id, "
-                f"subject_set_object, subject_set_relation, commit_time FROM keto_relation_tuples "
-                f"WHERE nid = ? {_ORDER}",
-                (self.network_id,),
-            ).fetchall()
-            wm = self.watermark()
-        return [InternalRow(*r[:7], seq=r[7]) for r in rows], wm
+            # one read transaction around the meta and row reads: another
+            # CONNECTION on the same file committing between them would
+            # otherwise mislabel the cache watermark and duplicate rows
+            # on the next extension
+            self._conn.execute("BEGIN")
+            try:
+                meta = self._conn.execute(
+                    "SELECT watermark, delete_wm FROM keto_watermarks WHERE nid = ?",
+                    (self.network_id,),
+                ).fetchone()
+                wm, delete_wm = meta if meta else (0, 0)
+                cache = self._snap_cache
+                if cache is not None:
+                    c_rows, c_wm = cache
+                    if c_wm == wm:
+                        return list(c_rows), wm
+                    if delete_wm <= c_wm:
+                        new = self._conn.execute(
+                            "SELECT namespace_id, object, relation, subject_id, "
+                            "subject_set_namespace_id, subject_set_object, "
+                            "subject_set_relation, commit_time FROM keto_relation_tuples "
+                            "WHERE nid = ? AND commit_time > ?",
+                            (self.network_id, c_wm),
+                        ).fetchall()
+                        # single linear merge — per-row insort would memmove
+                        # the whole list per insert (O(k·n) at 50M rows)
+                        new_rows = sorted(
+                            (InternalRow(*r[:7], seq=r[7]) for r in new),
+                            key=InternalRow.sort_key,
+                        )
+                        rows = list(
+                            heapq.merge(c_rows, new_rows, key=InternalRow.sort_key)
+                        )
+                        self._snap_cache = (rows, wm)
+                        return list(rows), wm
+                raw = self._conn.execute(
+                    f"SELECT namespace_id, object, relation, subject_id, subject_set_namespace_id, "
+                    f"subject_set_object, subject_set_relation, commit_time FROM keto_relation_tuples "
+                    f"WHERE nid = ? {_ORDER}",
+                    (self.network_id,),
+                ).fetchall()
+                rows = [InternalRow(*r[:7], seq=r[7]) for r in raw]
+                self._snap_cache = (rows, wm)
+            finally:
+                self._conn.execute("COMMIT")
+        return list(rows), wm
 
     def rows_since(self, watermark: int):
         """Rows inserted after ``watermark`` as ``(rows, new_watermark)``,

@@ -43,10 +43,22 @@ CHECK_CASES = [
 ]
 
 
-@pytest.fixture(scope="module")
-def daemon():
+@pytest.fixture(scope="module", params=["memory", "sqlite-file"])
+def daemon(request, tmp_path_factory):
+    """One daemon per store DSN — the reference's 'same cases × every
+    DSN' matrix (reference internal/persistence/sql/full_test.go:52-70)
+    applied at the e2e layer."""
+    if request.param == "memory":
+        dsn = "memory"
+    else:
+        dsn = f"sqlite://{tmp_path_factory.mktemp('e2e')}/keto.db"
     cfg = Config(
-        overrides={"namespaces": NAMESPACES, "serve.read.port": 0, "serve.write.port": 0}
+        overrides={
+            "namespaces": NAMESPACES,
+            "dsn": dsn,
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+        }
     )
     d = Daemon(Registry(cfg))
     d.serve_all(block=False)
