@@ -13,10 +13,13 @@ schemas:
 all: native proto
 
 # native tuple→graph interner (keto_tpu/graph/native.py loads it)
-native: native/libketoingest.so
+native: native/libketoingest.so native/libketomux.so
 
 native/libketoingest.so: native/ingest.cpp
 	$(CXX) $(CXXFLAGS) -shared $< -o $@
+
+native/libketomux.so: native/mux.cpp
+	$(CXX) $(CXXFLAGS) -shared $< -o $@ -lpthread
 
 # regenerate protobuf modules from the wire contract
 proto:
@@ -30,4 +33,4 @@ bench:
 	python bench.py
 
 clean:
-	rm -f native/libketoingest.so
+	rm -f native/libketoingest.so native/libketomux.so

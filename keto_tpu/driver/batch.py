@@ -15,19 +15,30 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Optional, Sequence
 
 from keto_tpu.relationtuple.model import RelationTuple
 
 
 class CheckBatcher:
-    def __init__(self, engine, batch_size: int = 4096, window_ms: float = 1.0):
-        """``engine`` needs ``batch_check(list[RelationTuple]) -> list[bool]``."""
+    def __init__(
+        self,
+        engine,
+        batch_size: int = 4096,
+        window_ms: float = 1.0,
+        max_pending: Optional[int] = None,
+    ):
+        """``engine`` needs ``batch_check(list[RelationTuple]) -> list[bool]``.
+
+        ``max_pending`` bounds the queue (default 8×batch_size): when the
+        device can't keep up, callers block in ``check`` up to their own
+        timeout instead of growing an unbounded backlog — backpressure
+        propagates to the accepting sockets rather than to memory."""
         self._engine = engine
         self._batch_size = batch_size
         self._window_s = window_ms / 1e3
-        self._queue: queue.Queue = queue.Queue()
+        self._queue: queue.Queue = queue.Queue(maxsize=max_pending or 8 * batch_size)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -41,7 +52,10 @@ class CheckBatcher:
 
     def stop(self) -> None:
         self._stop.set()
-        self._queue.put(None)  # wake the collector
+        try:
+            self._queue.put_nowait(None)  # fast wake when the queue is idle
+        except queue.Full:
+            pass  # collector is mid-drain; it polls the stop flag
         if self._thread:
             self._thread.join(timeout=5)
             self._thread = None
@@ -62,12 +76,24 @@ class CheckBatcher:
         callers."""
         if self._stop.is_set():
             raise RuntimeError("check batcher stopped")
+        deadline = None if timeout is None else time.monotonic() + timeout
         fut: Future = Future()
-        self._queue.put((tuple_, fut))
+        try:
+            # a full queue blocks the caller — the backpressure seam
+            # between accepts and the device — against the SAME deadline
+            # the result wait uses, so the total never exceeds ``timeout``
+            self._queue.put((tuple_, fut), timeout=timeout)
+        except queue.Full:
+            raise TimeoutError("check queue full (device backlogged)") from None
         if self._stop.is_set() and not fut.done():
-            # raced with stop()'s drain: nobody will serve the queue anymore
-            fut.set_exception(RuntimeError("check batcher stopped"))
-        return fut.result(timeout=timeout)
+            # raced with stop()'s drain: nobody will serve the queue
+            # anymore — unless the collector's final batch got there first
+            try:
+                fut.set_exception(RuntimeError("check batcher stopped"))
+            except InvalidStateError:
+                pass  # the collector resolved it; return that result
+        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+        return fut.result(timeout=remaining)
 
     def check_batch(self, tuples: Sequence[RelationTuple]) -> list[bool]:
         """Pre-batched requests skip the queue entirely."""
@@ -77,7 +103,12 @@ class CheckBatcher:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            item = self._queue.get()
+            try:
+                # bounded wait so a stop() against a FULL queue (whose
+                # sentinel could not be enqueued) still terminates the loop
+                item = self._queue.get(timeout=0.25)
+            except queue.Empty:
+                continue
             if item is None:
                 continue
             batch = [item]

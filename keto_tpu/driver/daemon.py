@@ -15,7 +15,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from keto_tpu.servers.grpc_api import build_grpc_server
-from keto_tpu.servers.mux import PortMux
+from keto_tpu.servers.native_mux import make_port_mux
 from keto_tpu.servers.rest import READ, WRITE, RestServer
 
 
@@ -23,7 +23,7 @@ from keto_tpu.servers.rest import READ, WRITE, RestServer
 class _RoleServers:
     rest: RestServer
     grpc_server: object
-    mux: PortMux
+    mux: object  # NativePortMux or PortMux
 
     @property
     def port(self) -> int:
@@ -42,7 +42,8 @@ class Daemon:
         rest.start()
         grpc_server, grpc_port = build_grpc_server(self.registry, role)
         grpc_server.start()
-        mux = PortMux(host, port, rest_port=rest.port, grpc_port=grpc_port)
+        # native epoll mux when built (make native), Python fallback else
+        mux = make_port_mux(host, port, rest_port=rest.port, grpc_port=grpc_port)
         mux.start()
         self.registry.logger().info(
             "serving %s API on :%d (REST+gRPC multiplexed)", role, mux.port

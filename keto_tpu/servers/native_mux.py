@@ -1,0 +1,95 @@
+"""ctypes binding for the native epoll port multiplexer.
+
+``native/mux.cpp`` implements the cmux analog (reference
+internal/driver/daemon.go:87-159) as a single epoll loop — no
+per-connection threads, proxy flow control, sniff deadline, connection
+cap. Build with ``make native``; loading is opportunistic and callers
+fall back to the Python thread-per-connection mux
+(keto_tpu/servers/mux.py) when the shared object is absent or
+``KETO_TPU_NATIVE=0``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+from typing import Optional
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_checked = False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_checked
+    if _lib_checked:
+        return _lib
+    _lib_checked = True
+    if os.environ.get("KETO_TPU_NATIVE", "1") == "0":
+        return None
+    path = Path(__file__).resolve().parents[2] / "native" / "libketomux.so"
+    if os.environ.get("KETO_TPU_NATIVE_MUX_LIB"):
+        path = Path(os.environ["KETO_TPU_NATIVE_MUX_LIB"])
+    if not path.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError:
+        return None
+    lib.mux_start.restype = ctypes.c_void_p
+    lib.mux_start.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.mux_port.restype = ctypes.c_int
+    lib.mux_port.argtypes = [ctypes.c_void_p]
+    lib.mux_stop.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+class NativePortMux:
+    """Drop-in for keto_tpu.servers.mux.PortMux backed by the epoll loop."""
+
+    def __init__(
+        self, host: str, port: int, rest_port: int, grpc_port: int,
+        max_connections: int = 4096,
+    ):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("libketomux.so not available")
+        self._lib = lib
+        self._handle = lib.mux_start(
+            (host or "0.0.0.0").encode(), port, rest_port, grpc_port, max_connections
+        )
+        if not self._handle:
+            raise OSError(f"native mux failed to bind {host}:{port}")
+        self.rest_port = rest_port
+        self.grpc_port = grpc_port
+
+    @property
+    def port(self) -> int:
+        if not self._handle:
+            raise RuntimeError("native mux is stopped")
+        return int(self._lib.mux_port(self._handle))
+
+    def start(self) -> None:
+        pass  # the epoll loop starts in mux_start
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.mux_stop(self._handle)
+            self._handle = None
+
+
+def make_port_mux(host: str, port: int, rest_port: int, grpc_port: int):
+    """The native mux when available, else the Python fallback."""
+    if load_library() is not None:
+        try:
+            return NativePortMux(host, port, rest_port, grpc_port)
+        except OSError:
+            raise  # bind errors are real; surface them
+        except RuntimeError:
+            pass
+    from keto_tpu.servers.mux import PortMux
+
+    return PortMux(host, port, rest_port, grpc_port)
