@@ -298,23 +298,26 @@ def make_queries_github(rng, n_checks, ctx):
     return queries, expected
 
 
-def make_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T):
-    """Half the queries target users constructed to hold the grant, half are
-    uniform random (almost always denials) — so the analytic expectations
-    exercise both decisions."""
+def iter_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T):
+    """Yield ``(query, expected)``: half the queries target users
+    constructed to hold the grant, half are uniform random (almost always
+    denials) — so the analytic expectations exercise both decisions.
+    Shared by the batch configs (materialized) and config 5 (streamed)."""
     from keto_tpu.relationtuple.model import SubjectID
 
     docs = list(doc_grant)
-    queries, expected = [], []
     for i in range(n_checks):
         d = rng.choice(docs)
         kind, g = doc_grant[d]
         u = member_of(kind, g, rng) if i % 2 == 0 else None
         if u is None:
             u = rng.randrange(n_users)
-        queries.append(T("docs", f"doc-{d}", "view", SubjectID(f"user-{u}")))
-        expected.append(user_reaches(u, kind, g))
-    return queries, expected
+        yield T("docs", f"doc-{d}", "view", SubjectID(f"user-{u}")), user_reaches(u, kind, g)
+
+
+def make_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T):
+    pairs = list(iter_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T))
+    return [q for q, _ in pairs], [e for _, e in pairs]
 
 
 def run_config4(rng):
@@ -429,6 +432,99 @@ def run_config4(rng):
         "tpu_oracle_mismatches": mismatch,
     }
     log("[c4] " + json.dumps({"metric": "check_throughput_10m_depth8", "value": metrics["checks_per_s"], "unit": "checks/s", "detail": metrics}))
+    return metrics
+
+
+def run_config5(rng):
+    """BASELINE config 5: 50M tuples, streaming 1M-check batches at flat
+    memory (opt-in via BENCH_CONFIG5=1 — the build alone takes minutes).
+    Multi-tenancy is the network-id column (isolation tested in the
+    contract suite); the multi-chip sharding of this config is validated
+    on the virtual mesh (tests/test_sharded_check.py, dryrun_multichip) —
+    one real chip serves the whole graph here."""
+    import numpy as _np
+
+    from keto_tpu import namespace as namespace_pkg
+    from keto_tpu.check.tpu_engine import TpuCheckEngine
+    from keto_tpu.persistence.memory import MemoryPersister
+
+    n_tuples = int(os.environ.get("BENCH5_TUPLES", 50_000_000))
+    n_checks = int(os.environ.get("BENCH5_CHECKS", 1_000_000))
+
+    t0 = time.perf_counter()
+    tuples, doc_grant, membership, user_reaches, member_of, n_users, T = build_workload(
+        rng, n_tuples
+    )
+    log(f"[c5] workload: {len(tuples)} tuples in {time.perf_counter()-t0:.1f}s")
+    nm = namespace_pkg.MemoryManager(
+        [namespace_pkg.Namespace(id=1, name="groups"), namespace_pkg.Namespace(id=2, name="docs")]
+    )
+    store = MemoryPersister(nm)
+    t0 = time.perf_counter()
+    store.write_relation_tuples(*tuples)
+    ingest_s = time.perf_counter() - t0
+    del tuples
+    import gc
+
+    gc.collect()
+    log(f"[c5] ingest: {ingest_s:.1f}s")
+    engine = TpuCheckEngine(store, store.namespaces)
+    t0 = time.perf_counter()
+    snap = engine.snapshot()
+    snapshot_s = time.perf_counter() - t0
+    log(
+        f"[c5] snapshot: {snap.n_nodes} nodes, {snap.n_edges} edges, "
+        f"{snap.num_active} active / {snap.num_int} interior / {snap.n_peeled} peeled "
+        f"in {snapshot_s:.1f}s"
+    )
+
+    # the 1M-check request pre-materializes on the host (client-side
+    # construction stays out of the timed window, matching config 4's
+    # measurement); DEVICE state stays flat via the stream's bounded
+    # in-flight slices
+    pairs = list(iter_queries(random.Random(7), n_checks, doc_grant, n_users, user_reaches, member_of, T))
+    queries = [q for q, _ in pairs]
+    expected = _np.fromiter((e for _, e in pairs), bool, len(pairs))
+    del pairs
+
+    engine.batch_check(queries[:16384])  # warmup one slice geometry
+    log("[c5] warmup done")
+
+    slice_lat = []
+    outs = []
+    t_start = time.perf_counter()
+    t_prev = t_start
+    for out in engine.batch_check_stream(iter(queries), depth=2, slice_cap=131072):
+        now = time.perf_counter()
+        slice_lat.append(now - t_prev)
+        t_prev = now
+        outs.append(out)
+    total_s = time.perf_counter() - t_start
+    got = _np.concatenate(outs)
+    n_done = int(got.shape[0])
+    n_wrong = int((got != expected[:n_done]).sum())
+    steady = sorted(slice_lat[1:]) or slice_lat
+    p50 = steady[len(steady) // 2] * 1e3
+    p99 = steady[min(len(steady) - 1, int(len(steady) * 0.99))] * 1e3
+    qps = n_done / total_s
+    log(
+        f"[c5] stream: {qps:,.0f} checks/s over {n_done} checks "
+        f"({total_s:.1f}s total); slice p50={p50:.0f} ms p99={p99:.0f} ms; wrong={n_wrong}"
+    )
+    metrics = {
+        "tuples": n_tuples,
+        "checks": n_done,
+        "nodes": snap.n_nodes,
+        "edges": snap.n_edges,
+        "checks_per_s": round(qps, 1),
+        "stream_total_s": round(total_s, 1),
+        "stream_slice_p50_ms": round(p50, 1),
+        "stream_slice_p99_ms": round(p99, 1),
+        "wrong": n_wrong,
+        "ingest_s": round(ingest_s, 1),
+        "snapshot_build_s": round(snapshot_s, 1),
+    }
+    log("[c5] " + json.dumps({"metric": "check_throughput_50m_stream", "value": metrics["checks_per_s"], "unit": "checks/s", "detail": metrics}))
     return metrics
 
 
@@ -552,6 +648,16 @@ def main():
         except Exception as e:  # pragma: no cover - diagnostic path
             log(f"[c4] FAILED: {e!r}")
             config4 = {"error": repr(e)}
+    config5 = None
+    if os.environ.get("BENCH_CONFIG5", "0") == "1":
+        import gc
+
+        gc.collect()
+        try:
+            config5 = run_config5(random.Random(2042))
+        except Exception as e:  # pragma: no cover - diagnostic path
+            log(f"[c5] FAILED: {e!r}")
+            config5 = {"error": repr(e)}
 
     print(
         json.dumps(
@@ -578,6 +684,7 @@ def main():
                     "tpu_oracle_mismatches": mismatch_vs_oracle,
                     "device": str(jax.devices()[0]),
                     "config4_10m_depth8": config4,
+                    "config5_50m_stream": config5,
                 },
             }
         )

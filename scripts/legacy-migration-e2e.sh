@@ -1,0 +1,108 @@
+#!/bin/bash
+# Binary-level legacy-migration e2e — the analog of the reference's
+# scripts/single-table-migration-e2e.sh:1-52 (wired to
+# .github/workflows/single-table-migration-e2e.yml there, ci.yml here):
+# seed 300 v0.6-era per-namespace rows into a file database, migrate to
+# the single tuple table through the real CLI, serve the migrated store,
+# and diff `keto check` decisions against the expected set.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+workdir=$(mktemp -d)
+server_pid=""
+cleanup() {
+    [ -n "$server_pid" ] && kill "$server_pid" 2>/dev/null || true
+    rm -rf "$workdir"
+}
+trap cleanup EXIT
+
+db="$workdir/keto.db"
+read_port=$(python - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
+EOF
+)
+write_port=$(python - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
+EOF
+)
+
+cat > "$workdir/keto.yml" <<EOF
+namespaces:
+  - {id: 1, name: groups}
+  - {id: 2, name: docs}
+dsn: sqlite://$db
+serve:
+  read:  {host: 127.0.0.1, port: $read_port}
+  write: {host: 127.0.0.1, port: $write_port}
+EOF
+
+echo "== seeding 300 legacy rows into $db"
+python - "$db" "$workdir/expected.txt" <<'EOF'
+import random, sqlite3, sys
+
+db, expected_path = sys.argv[1], sys.argv[2]
+conn = sqlite3.connect(db)
+rng = random.Random(6)
+rows = {1: [], 2: []}
+for g in range(20):
+    for u in rng.sample(range(40), 7):
+        rows[1].append((f"group-{g}", "member", f"user-{u}"))
+for d in range(160):
+    g = rng.randrange(20)
+    rows[2].append((f"doc-{d}", "view", f"groups:group-{g}#member"))
+assert sum(len(v) for v in rows.values()) == 300
+for ns_id, rs in rows.items():
+    t = f"keto_{ns_id:010d}_relation_tuples"
+    conn.execute(
+        f"CREATE TABLE {t} (shard_id TEXT, object TEXT, relation TEXT, "
+        f"subject TEXT, commit_time INTEGER)"
+    )
+    conn.executemany(
+        f"INSERT INTO {t} (shard_id, object, relation, subject, commit_time) "
+        f"VALUES (NULL, ?, ?, ?, 0)", rs,
+    )
+conn.commit()
+
+# expected decisions: membership via group grant chains
+members = {}
+for obj, rel, sub in rows[1]:
+    members.setdefault(obj, set()).add(sub)
+with open(expected_path, "w") as f:
+    for obj, rel, sub in rng.sample(rows[2], 40):
+        grp = sub.split(":", 1)[1].split("#", 1)[0]
+        for u in rng.sample(range(40), 3):
+            want = "Allowed" if f"user-{u}" in members.get(grp, set()) else "Denied"
+            f.write(f"user-{u} view docs {obj} {want}\n")
+EOF
+
+echo "== migrating legacy tables through the CLI"
+python -m keto_tpu.cmd namespace migrate-legacy -c "$workdir/keto.yml" -y
+
+echo "== serving the migrated store"
+python -m keto_tpu.cmd serve -c "$workdir/keto.yml" &
+server_pid=$!
+for i in $(seq 1 100); do
+    if curl -fsS "http://127.0.0.1:$read_port/health/alive" >/dev/null 2>&1; then
+        break
+    fi
+    sleep 0.2
+done
+
+echo "== diffing keto check decisions"
+export KETO_READ_REMOTE="127.0.0.1:$read_port"
+fails=0
+while read -r subject relation namespace object want; do
+    got=$(python -m keto_tpu.cmd check "$subject" "$relation" "$namespace" "$object")
+    if [ "$got" != "$want" ]; then
+        echo "MISMATCH: $namespace:$object#$relation@$subject -> $got (want $want)"
+        fails=$((fails + 1))
+    fi
+done < "$workdir/expected.txt"
+
+if [ "$fails" -ne 0 ]; then
+    echo "legacy migration e2e FAILED: $fails mismatches"
+    exit 1
+fi
+echo "legacy migration e2e OK: all decisions match after migration"

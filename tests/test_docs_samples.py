@@ -127,3 +127,22 @@ def test_cat_videos_example_flow(live):
     assert "Allowed" in run_cli(["check", "cat lady", "view", "videos", "/cats/2.mp4"])
     out = run_cli(["expand", "view", "videos", "/cats/2.mp4"])
     assert "/cats" in out
+
+
+def test_generated_reference_docs_are_fresh():
+    """docs/reference/*.md render from the click tree and .proto files;
+    a drifted commit fails here (the reference's generated-docs codegen
+    check analog)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "render_docs", REPO / "scripts" / "render_docs.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert (REPO / "docs/reference/cli.md").read_text() == mod.render_cli() + "\n", (
+        "docs/reference/cli.md is stale — run scripts/render_docs.py"
+    )
+    assert (REPO / "docs/reference/proto.md").read_text() == mod.render_proto() + "\n", (
+        "docs/reference/proto.md is stale — run scripts/render_docs.py"
+    )
