@@ -320,6 +320,89 @@ def make_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T):
     return [q for q, _ in pairs], [e for _, e in pairs]
 
 
+def run_config2(rng):
+    """BASELINE config 2: synthetic flat ACL — 100k direct
+    (object#relation@user) tuples, 10k batched checks, depth 1. The
+    shallow extreme: no subject-set indirection at all, so the whole
+    decision is host resolution + sink answer gathers (every set node is
+    static, every user a sink). Also measures single-check latency
+    through subject_is_allowed — the config-1 serving-latency analog."""
+    from keto_tpu import namespace as namespace_pkg
+    from keto_tpu.check import CheckEngine
+    from keto_tpu.check.tpu_engine import TpuCheckEngine
+    from keto_tpu.persistence.memory import MemoryPersister
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID
+
+    n_tuples = int(os.environ.get("BENCH2_TUPLES", 100_000))
+    n_checks = int(os.environ.get("BENCH2_CHECKS", 10_000))
+
+    def T(obj, u):
+        return RelationTuple(namespace="acl", object=obj, relation="access", subject=SubjectID(u))
+
+    n_objs = max(10, n_tuples // 10)
+    grants = set()
+    tuples = []
+    for i in range(n_tuples):
+        o, u = rng.randrange(n_objs), rng.randrange(n_tuples // 5)
+        grants.add((o, u))
+        tuples.append(T(f"obj-{o}", f"user-{u}"))
+    nm = namespace_pkg.MemoryManager([namespace_pkg.Namespace(id=1, name="acl")])
+    store = MemoryPersister(nm)
+    store.write_relation_tuples(*tuples)
+    engine = TpuCheckEngine(store, store.namespaces)
+
+    queries, expected = [], []
+    grant_list = list(grants)
+    for i in range(n_checks):
+        if i % 2 == 0:
+            o, u = rng.choice(grant_list)
+        else:
+            o, u = rng.randrange(n_objs), rng.randrange(n_tuples // 5)
+        queries.append(T(f"obj-{o}", f"user-{u}"))
+        expected.append((o, u) in grants)
+
+    engine.batch_check(queries)  # warmup
+    reps = int(os.environ.get("BENCH_REPS", 3))
+    times = []
+    got = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        got = engine.batch_check(queries)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    qps = n_checks / times[len(times) // 2]
+    n_wrong = sum(g != e for g, e in zip(got, expected))
+
+    # single-check serving latency (config-1 analog: one Check() call)
+    lat = []
+    for q in queries[:40]:
+        t0 = time.perf_counter()
+        engine.subject_is_allowed(q)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    p50_1 = lat[len(lat) // 2] * 1e3
+
+    oracle = CheckEngine(store)
+    t0 = time.perf_counter()
+    og = [oracle.subject_is_allowed(q) for q in queries[:2000]]
+    oracle_qps = 2000 / (time.perf_counter() - t0)
+    mismatch = sum(g != o for g, o in zip(got[:2000], og))
+    log(
+        f"[c2] flat ACL: {qps:,.0f} checks/s ({n_checks} checks, depth 1); "
+        f"single-check p50={p50_1:.1f} ms; oracle {oracle_qps:,.0f}/s; "
+        f"wrong={n_wrong} vs_oracle_mismatch={mismatch}"
+    )
+    return {
+        "tuples": n_tuples,
+        "checks": n_checks,
+        "checks_per_s": round(qps, 1),
+        "single_check_p50_ms": round(p50_1, 2),
+        "oracle_checks_per_s": round(oracle_qps, 1),
+        "correct_vs_expected": n_wrong == 0,
+        "tpu_oracle_mismatches": mismatch,
+    }
+
+
 def run_config4(rng):
     """BASELINE config 4: 10M tuples, GitHub-style, depth ≤ 8. Returns a
     metrics dict (embedded in the headline JSON, plus one JSON line on
@@ -487,7 +570,9 @@ def run_config5(rng):
     expected = _np.fromiter((e for _, e in pairs), bool, len(pairs))
     del pairs
 
-    engine.batch_check(queries[:16384])  # warmup one slice geometry
+    engine.batch_check(queries[:131072])  # warmup the FULL slice geometry
+    # (a smaller warmup would compile a different query-word width and
+    # push the real slice's compile into the timed window)
     log("[c5] warmup done")
 
     slice_lat = []
@@ -630,8 +715,14 @@ def main():
         f"tpu_vs_oracle_mismatch={mismatch_vs_oracle}"
     )
 
-    # BASELINE config 4 (10M tuples, depth ≤ 8) — failures must not lose
-    # the headline JSON line
+    # BASELINE configs 2/4/5 — failures must not lose the headline JSON line
+    config2 = None
+    if os.environ.get("BENCH_CONFIG2", "1") != "0":
+        try:
+            config2 = run_config2(random.Random(542))
+        except Exception as e:  # pragma: no cover - diagnostic path
+            log(f"[c2] FAILED: {e!r}")
+            config2 = {"error": repr(e)}
     config4 = None
     n_tuples_built = len(tuples)
     snap_nodes, snap_edges = snap.n_nodes, snap.n_edges
@@ -683,6 +774,7 @@ def main():
                     "correct_vs_expected": n_wrong == 0,
                     "tpu_oracle_mismatches": mismatch_vs_oracle,
                     "device": str(jax.devices()[0]),
+                    "config2_flat_acl": config2,
                     "config4_10m_depth8": config4,
                     "config5_50m_stream": config5,
                 },

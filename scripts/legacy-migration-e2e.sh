@@ -83,12 +83,22 @@ python -m keto_tpu.cmd namespace migrate-legacy -c "$workdir/keto.yml" -y
 echo "== serving the migrated store"
 python -m keto_tpu.cmd serve -c "$workdir/keto.yml" &
 server_pid=$!
+healthy=0
 for i in $(seq 1 100); do
     if curl -fsS "http://127.0.0.1:$read_port/health/alive" >/dev/null 2>&1; then
+        healthy=1
         break
+    fi
+    if ! kill -0 "$server_pid" 2>/dev/null; then
+        echo "server process died during startup"
+        exit 1
     fi
     sleep 0.2
 done
+if [ "$healthy" -ne 1 ]; then
+    echo "server failed to become healthy within 20s"
+    exit 1
+fi
 
 echo "== diffing keto check decisions"
 export KETO_READ_REMOTE="127.0.0.1:$read_port"
