@@ -39,9 +39,16 @@ suite compares trees order-insensitively like the reference's):
 - a root pattern that exists as no set node (e.g. an empty-namespace
   root) concatenates the ordered child lists of the matching keys, which
   can interleave differently than global row order when wildcard-bearing
-  keys also match;
-- while an insert-only delta overlay is pending, overlay children append
-  after base children (order restored at the next full rebuild).
+  keys also match.
+
+While an insert-only delta overlay is pending, expand DELEGATES to the
+Manager-backed engine outright: overlay children would append after base
+children, shifting the DFS visit order — and with it which occurrence of
+a repeated set gets expanded vs visited-pruned, which at bounded depth
+changes which subtrees appear at all. The Manager path reproduces the
+reference's order exactly by construction; the snapshot fast path resumes
+at the next full rebuild (overlays are transient by design). Checks are
+unaffected — reachability is order-independent.
 """
 
 from __future__ import annotations
@@ -76,6 +83,10 @@ class SnapshotExpandEngine:
             self._nm: Callable[[], namespace_pkg.Manager] = lambda: namespaces
         else:
             self._nm = namespaces
+        from keto_tpu.expand.engine import ExpandEngine
+
+        #: exact-order engine for overlay-pending snapshots (see module doc)
+        self._manager_engine = ExpandEngine(check_engine._store)
 
     # -- public API (host engine signature) ----------------------------------
 
@@ -85,6 +96,10 @@ class SnapshotExpandEngine:
         if not isinstance(subject, SubjectSet):
             return Tree(type=LEAF, subject=subject)
         snap = self._engine.snapshot()
+        if snap.has_overlay:
+            # pending insert-only overlay: serve the reference's exact
+            # tree from the Manager until the next rebuild (module doc)
+            return self._manager_engine.build_tree(subject, rest_depth)
         nm = self._nm()
 
         ns = subject.namespace
@@ -111,9 +126,7 @@ class SnapshotExpandEngine:
             starts = snap.resolve_starts(ns_id, subject.object, subject.relation)
             if starts.size == 0:
                 return None
-            children_of[_PATTERN_ROOT] = self._pattern_children(
-                snap, starts, self._overlay_fwd(snap)
-            )
+            children_of[_PATTERN_ROOT] = self._pattern_children(snap, starts)
             root_dev = _PATTERN_ROOT
 
         self._capture_adjacency(snap, root_dev, rest_depth, children_of)
@@ -168,7 +181,6 @@ class SnapshotExpandEngine:
     ) -> None:
         """Fill ``children_of`` for every set node reachable within the
         depth budget: one ``out_neighbors_bulk`` gather per BFS level."""
-        ov_fwd = self._overlay_fwd(snap)
         if root_dev == _PATTERN_ROOT:
             ch = children_of[_PATTERN_ROOT]
             m = snap.is_set_dev_bulk(ch)
@@ -189,9 +201,6 @@ class SnapshotExpandEngine:
             for i, dev in enumerate(frontier):
                 ch = rows[start : ends[i]]
                 start = int(ends[i])
-                extra = ov_fwd.get(dev)
-                if extra is not None:
-                    ch = np.concatenate([ch, np.asarray(extra, ch.dtype if ch.size else np.int64)])
                 children_of[dev] = ch
                 new_children.append(ch)
             if new_children:
@@ -206,38 +215,13 @@ class SnapshotExpandEngine:
             level += 1
 
     @staticmethod
-    def _overlay_fwd(snap: GraphSnapshot) -> dict:
-        """Forward adjacency of the pending delta overlay that
-        ``out_neighbors_bulk`` does NOT carry: interior→interior edges live
-        in the overlay ELL and interior→sink edges in the answer-gather
-        overlay (keto_tpu/graph/overlay.py partitions them for the check
-        kernel; expand needs them as plain children)."""
-        with snap._cache_lock:
-            got = snap._pattern_cache.get("_ov_fwd")
-            if got is not None:
-                return got
-            fwd: dict[int, list[int]] = {}
-            if snap.ov_ell is not None:
-                for src, dst in snap.ov_ell.tolist():
-                    fwd.setdefault(int(src), []).append(int(dst))
-            if snap.ov_sink_in:
-                for sink, srcs in snap.ov_sink_in.items():
-                    for s in np.asarray(srcs).tolist():
-                        fwd.setdefault(int(s), []).append(int(sink))
-            snap._pattern_cache["_ov_fwd"] = fwd
-            return fwd
-
-    @staticmethod
-    def _pattern_children(
-        snap: GraphSnapshot, starts: np.ndarray, ov_fwd: dict
-    ) -> np.ndarray:
+    def _pattern_children(snap: GraphSnapshot, starts: np.ndarray) -> np.ndarray:
         """Ordered union of the matching keys' child lists for a root
         pattern with no node of its own: keys sort by (ns_id, object,
         relation) — the leading columns of the store's ORDER BY — then
         each key contributes its children in its own (row-order) edge
-        order, pending delta-overlay children appended (same read-your-
-        writes contract as _capture_adjacency); duplicates keep the first
-        occurrence."""
+        order; duplicates keep the first occurrence. (Never called with a
+        pending overlay: build_tree delegates that case to the Manager.)"""
         keyed = []
         for dev in starts.tolist():
             kind, key = snap.key_of_dev(dev)
@@ -246,18 +230,6 @@ class SnapshotExpandEngine:
         keyed.sort(key=lambda kv: kv[0])
         if not keyed:
             return np.zeros(0, np.int64)
-        devs = [d for _, d in keyed]
-        rows, cnts = snap.out_neighbors_bulk(np.asarray(devs, np.int64))
-        if ov_fwd:
-            ends = np.cumsum(cnts)
-            parts = []
-            start = 0
-            for i, dev in enumerate(devs):
-                parts.append(rows[start : ends[i]])
-                start = int(ends[i])
-                extra = ov_fwd.get(dev)
-                if extra is not None:
-                    parts.append(np.asarray(extra, np.int64))
-            rows = np.concatenate(parts)
+        rows, _ = snap.out_neighbors_bulk(np.asarray([d for _, d in keyed], np.int64))
         _, first = np.unique(rows, return_index=True)
         return rows[np.sort(first)]

@@ -112,6 +112,18 @@ def apply_delta(
         empty_rel = interned.rel_code("")
 
     new_edges: list[tuple[int, int]] = []
+    self_loops: set[int] = set(base.ov_self or ())
+    fwd_indptr = base.fwd_indptr
+    fwd_indices = base.fwd_indices
+
+    def in_base_csr(src: int, dst: int) -> bool:
+        # re-inserting an existing tuple (legal: duplicate inserts create
+        # additional store rows) must not duplicate the graph edge —
+        # out-neighbor lists feed pack_chunk's disjoint-bit scatter-ADD
+        if src >= nb:
+            return False
+        a, b = fwd_indptr[src], fwd_indptr[src + 1]
+        return bool(np.any(fwd_indices[a:b] == dst))
 
     for r in rows:
         lhs_wild = (
@@ -161,6 +173,9 @@ def apply_delta(
             # a self-loop adds nothing to reachability — but wildcard
             # attachment below still applies to the tuple
             new_edges.append((lhs_dev, sub_dev))
+        elif not in_base_csr(lhs_dev, lhs_dev):
+            # expand must still render the self-referencing child
+            self_loops.add(lhs_dev)
 
         # attach to every existing wildcard set node matching this tuple
         # (the base builder's pass-2 expansion, incrementally)
@@ -172,8 +187,14 @@ def apply_delta(
             m &= (w_rel == empty_rel) | ((w_rel == rc) if rc >= 0 else False)
             for wdev in w_dev[m]:
                 wdev = int(wdev)
-                if wdev == lhs_dev or wdev == sub_dev:
+                if wdev == sub_dev:
+                    # self-loop at the wildcard node: reachability-inert,
+                    # recorded for expand rendering only
+                    if not in_base_csr(wdev, wdev):
+                        self_loops.add(wdev)
                     continue
+                if wdev == lhs_dev:
+                    continue  # the literal edge above already covers it
                 if sb <= wdev < nl:
                     return None  # wildcard node is a base sink (shouldn't
                     # happen: it has out-edges) — be safe
@@ -182,17 +203,6 @@ def apply_delta(
     # classify + partition the new edges
     add_out: dict[int, list[int]] = {}
     add_sink_in: dict[int, list[int]] = {}
-    fwd_indptr = base.fwd_indptr
-    fwd_indices = base.fwd_indices
-
-    def in_base_csr(src: int, dst: int) -> bool:
-        # re-inserting an existing tuple (legal: duplicate inserts create
-        # additional store rows) must not duplicate the graph edge —
-        # out-neighbor lists feed pack_chunk's disjoint-bit scatter-ADD
-        if src >= nb:
-            return False
-        a, b = fwd_indptr[src], fwd_indptr[src + 1]
-        return bool(np.any(fwd_indices[a:b] == dst))
 
     for src, dst in new_edges:
         if in_base_csr(src, dst):
@@ -252,6 +262,7 @@ def apply_delta(
         ov_out=ov_out,
         ov_sink_in=ov_sink_in,
         ov_ell=ell_arr,
+        ov_self=self_loops or None,
         device_overlay=None,  # engine re-uploads (cheap: overlay is small)
         _pattern_cache={},
         _cache_lock=__import__("threading").Lock(),

@@ -246,3 +246,100 @@ def test_pattern_root_without_node(make_persister):
             assert h is None and t is None, f"{sub}: {h} vs {t}"
         else:
             assert h.equals(t), f"{sub}:\n{h}\nvs\n{t}"
+
+
+def reached_subjects(tree, acc=None):
+    """Every subject a tree mentions — the expansion's semantic content."""
+    if acc is None:
+        acc = set()
+    if tree is not None:
+        acc.add(str(tree.subject))
+        for c in tree.children:
+            reached_subjects(c, acc)
+    return acc
+
+
+def test_delta_self_loop_renders_child(make_persister):
+    """A delta tuple whose subject is the node's own set adds nothing to
+    reachability (apply_delta drops the edge) but the tree must still
+    show the self-referencing child as a pruned leaf, like the host."""
+    p = make_persister([("g", 1), ("", 3)])
+    p.write_relation_tuples(
+        T("g", "team", "r0", SubjectID("u1")),
+        T("g", "x", "m", SubjectSet("g", "team", "")),  # creates wildcard node g:team#
+    )
+    host, tpu = engines(p)
+    tpu.build_tree(SubjectSet("g", "team", ""), 5)  # base snapshot
+    # delta: tuple g:team#r1@(g:team#) — subject IS the wildcard node
+    p.write_relation_tuples(T("g", "team", "r1", SubjectSet("g", "team", "")))
+    snap = tpu._engine.snapshot()
+    assert snap.ov_self, "expected the dropped self-loop to be recorded"
+    h = normalize(host.build_tree(SubjectSet("g", "team", ""), 5))
+    t = normalize(tpu.build_tree(SubjectSet("g", "team", ""), 5))
+    assert h is not None and t is not None and h.equals(t), f"{h}\nvs\n{t}"
+
+
+def test_overlay_children_keep_manager_order(make_persister):
+    """Delta children of an overlay-touched node must appear in the
+    Manager's page order, not appended at the end (the visit order drives
+    the visited-set pruning sites)."""
+    p = make_persister([("g", 1)])
+    p.write_relation_tuples(
+        T("g", "root", "m", SubjectID("zz")),
+    )
+    host, tpu = engines(p)
+    tpu.build_tree(SubjectSet("g", "root", "m"), 5)
+    # delta child 'aa' sorts BEFORE base child 'zz' in manager order
+    p.write_relation_tuples(T("g", "root", "m", SubjectID("aa")))
+    h = host.build_tree(SubjectSet("g", "root", "m"), 5)
+    t = tpu.build_tree(SubjectSet("g", "root", "m"), 5)
+    assert [str(c.subject) for c in h.children] == ["aa", "zz"]
+    assert_tree_identical(h, t)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_overlay_pending_semantic_parity_fuzz(make_persister, seed):
+    """With a delta overlay pending on a wildcard-heavy store, the tree
+    SHAPE may legitimately differ (documented visit-order drift) but the
+    reached-subject set — the expansion's semantic content — must always
+    equal the host's."""
+    p, names, objs, rels = _wild_store(make_persister, seed)
+    rng = random.Random(3000 + seed)
+    host, tpu = engines(p)
+    tpu.build_tree(SubjectSet(names[0], objs[0], rels[0]), 3)  # base snapshot
+    users = [f"u{i}" for i in range(5)]
+    for round_ in range(4):
+        extra = []
+        for _ in range(5):
+            sub = (
+                SubjectID(rng.choice(users))
+                if rng.random() < 0.4
+                else SubjectSet(rng.choice(names), rng.choice(objs), rng.choice(rels))
+            )
+            extra.append(T(rng.choice(names), rng.choice(objs), rng.choice(rels), sub))
+        p.write_relation_tuples(*extra)
+        for _ in range(15):
+            sub = SubjectSet(rng.choice(names), rng.choice(objs), rng.choice(rels))
+            d = rng.choice([1, 2, 3, 100])
+            h = host.build_tree(sub, d)
+            t = tpu.build_tree(sub, d)
+            assert (h is None) == (t is None), f"{sub}@{d}"
+            assert reached_subjects(h) == reached_subjects(t), f"{sub}@{d}"
+
+
+def test_delta_self_loop_on_existing_node(make_persister):
+    """A delta whose ONLY overlay effect is a self-loop on an EXISTING
+    node (ov_self alone) must still delegate — the base CSR lacks the
+    self-referencing child the host renders."""
+    p = make_persister([("g", 1)])
+    p.write_relation_tuples(T("g", "team", "r0", SubjectID("u1")))
+    host, tpu = engines(p)
+    tpu.build_tree(SubjectSet("g", "team", "r0"), 5)  # base snapshot
+    p.write_relation_tuples(T("g", "team", "r0", SubjectSet("g", "team", "r0")))
+    snap = tpu._engine.snapshot()
+    assert snap.ov_self and not snap.ov_set_ids and not snap.ov_leaf_ids
+    assert snap.has_overlay
+    h = host.build_tree(SubjectSet("g", "team", "r0"), 5)
+    t = tpu.build_tree(SubjectSet("g", "team", "r0"), 5)
+    assert_tree_identical(h, t)
+    assert sorted(str(c.subject) for c in t.children) == ["g:team#r0", "u1"]
