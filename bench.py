@@ -468,6 +468,7 @@ def run_config4(rng):
     log(f"[c4] batch reps: {['%.0f ms' % (t*1e3) for t in times]}")
 
     # streamed per-slice latency (p50/p99), pipeline-fill slice excluded
+    engine.batch_check(queries[:16384])  # stream-slice geometry warmup
     slice_lat = []
     stream_got = []
     t_prev = time.perf_counter()
@@ -674,6 +675,7 @@ def main():
     # gap (first yield excluded — it absorbs pipeline fill) is the real
     # per-slice service time; decisions are validated below like the
     # batch pass.
+    engine.batch_check(queries[:16384])  # stream-slice geometry warmup
     slice_lat = []
     stream_got = []
     t0 = time.perf_counter()

@@ -47,6 +47,7 @@ from __future__ import annotations
 import itertools
 import logging
 import threading
+import time
 from functools import partial
 from typing import Callable, Optional, Sequence
 
@@ -486,6 +487,7 @@ class TpuCheckEngine:
         mesh=None,
         shard_rows: bool = False,
         mem_budget_bytes: int = 10 << 30,
+        compact_after_s: float = 5.0,
     ):
         if it_cap < 1:
             raise ValueError("it_cap must be >= 1 (the answer pull needs one step)")
@@ -527,6 +529,12 @@ class TpuCheckEngine:
         # delta overlays beyond this edge count trigger a full rebuild (the
         # overlay ELL stage and host merge costs grow with overlay size)
         self._max_overlay_edges = 4096
+        # an overlay older than this compacts in the background (a full
+        # rebuild served from the old snapshot): without it an insert-only
+        # workload would keep a small overlay — and everything gated on it,
+        # e.g. expand's Manager delegation — alive forever
+        self._compact_after_s = compact_after_s
+        self._overlay_born: Optional[float] = None
         self._bg_rebuild: Optional[threading.Thread] = None
 
     # -- snapshot lifecycle --------------------------------------------------
@@ -550,6 +558,14 @@ class TpuCheckEngine:
         snap = self._snapshot
         wm = self._store.watermark()
         if snap is not None and snap.snapshot_id == wm:
+            if (
+                snap.has_overlay
+                and self._overlay_born is not None
+                and time.monotonic() - self._overlay_born > self._compact_after_s
+            ):
+                # quiet long enough: fold the overlay into a fresh base
+                # layout off the serving path
+                self._kick_background_refresh(force_full=True)
             return snap
         if (
             at_least is not None
@@ -561,9 +577,10 @@ class TpuCheckEngine:
         with self._lock:
             return self._refresh_locked()
 
-    def _kick_background_refresh(self) -> None:
+    def _kick_background_refresh(self, force_full: bool = False) -> None:
         """Start (at most one) background thread bringing the snapshot up
-        to the store's watermark, so staleness-tolerant readers never pay
+        to the store's watermark — or, with ``force_full``, compacting a
+        pending overlay into a fresh base layout — so readers never pay
         the rebuild."""
         t = self._bg_rebuild
         if t is not None and t.is_alive():
@@ -571,24 +588,27 @@ class TpuCheckEngine:
 
         def run():
             with self._lock:
-                self._refresh_locked()
+                self._refresh_locked(force_full=force_full)
 
         t = threading.Thread(target=run, name="keto-tpu-snapshot-refresh", daemon=True)
         self._bg_rebuild = t
         t.start()
 
-    def _refresh_locked(self) -> GraphSnapshot:
+    def _refresh_locked(self, force_full: bool = False) -> GraphSnapshot:
         """Bring the snapshot to the current watermark (caller holds the
-        lock): delta overlay when possible, full rebuild otherwise."""
+        lock): delta overlay when possible, full rebuild otherwise (or
+        always, for an overlay compaction pass)."""
         snap = self._snapshot
         wm = self._store.watermark()
-        if snap is not None and snap.snapshot_id == wm:
+        if snap is not None and snap.snapshot_id == wm and not (
+            force_full and snap.has_overlay
+        ):
             return snap
         wild_ns_ids = frozenset(
             n.id for n in self._nm().namespaces() if n.name == ""
         )
         new = None
-        if snap is not None:
+        if snap is not None and not force_full:
             new = self._try_delta(snap, wild_ns_ids)
         if new is None:
             rows, wm = self._store.snapshot_rows()
@@ -596,6 +616,11 @@ class TpuCheckEngine:
             self._upload_buckets(new)
         self._upload_overlay(new)
         self._snapshot = new
+        if new.has_overlay:
+            if self._overlay_born is None:
+                self._overlay_born = time.monotonic()
+        else:
+            self._overlay_born = None
         return new
 
     def _try_delta(

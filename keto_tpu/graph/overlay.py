@@ -112,7 +112,6 @@ def apply_delta(
         empty_rel = interned.rel_code("")
 
     new_edges: list[tuple[int, int]] = []
-    self_loops: set[int] = set(base.ov_self or ())
     fwd_indptr = base.fwd_indptr
     fwd_indices = base.fwd_indices
 
@@ -169,13 +168,13 @@ def apply_delta(
             return None  # overlay sink node gains an out-edge
         elif sb <= lhs_dev < nl:
             return None  # base sink gains an out-edge: needs a bitmap row
-        if lhs_dev != sub_dev:
-            # a self-loop adds nothing to reachability — but wildcard
-            # attachment below still applies to the tuple
-            new_edges.append((lhs_dev, sub_dev))
-        elif not in_base_csr(lhs_dev, lhs_dev):
-            # expand must still render the self-referencing child
-            self_loops.add(lhs_dev)
+        # self-loops route through normal classification: they ARE paths
+        # of length 1 (a check of a node against its own subject set
+        # grants through one — the base builder keeps them, and dropping
+        # them here wrongly denied that query while the overlay was
+        # pending). An active→active self-loop becomes an overlay-ELL
+        # edge the kernel handles like any other; other classes rebuild.
+        new_edges.append((lhs_dev, sub_dev))
 
         # attach to every existing wildcard set node matching this tuple
         # (the base builder's pass-2 expansion, incrementally)
@@ -187,12 +186,6 @@ def apply_delta(
             m &= (w_rel == empty_rel) | ((w_rel == rc) if rc >= 0 else False)
             for wdev in w_dev[m]:
                 wdev = int(wdev)
-                if wdev == sub_dev:
-                    # self-loop at the wildcard node: reachability-inert,
-                    # recorded for expand rendering only
-                    if not in_base_csr(wdev, wdev):
-                        self_loops.add(wdev)
-                    continue
                 if wdev == lhs_dev:
                     continue  # the literal edge above already covers it
                 if sb <= wdev < nl:
@@ -262,7 +255,6 @@ def apply_delta(
         ov_out=ov_out,
         ov_sink_in=ov_sink_in,
         ov_ell=ell_arr,
-        ov_self=self_loops or None,
         device_overlay=None,  # engine re-uploads (cheap: overlay is small)
         _pattern_cache={},
         _cache_lock=__import__("threading").Lock(),

@@ -150,11 +150,6 @@ class GraphSnapshot:
     ov_out: Optional[dict] = None  # src dev → np.int64[...] out-neighbor devs
     ov_sink_in: Optional[dict] = None  # sink dev → np.int32[...] interior srcs
     ov_ell: Optional[np.ndarray] = None  # int64 [K, 2] (src, dst) edges
-    #: set-node devs that gained a reachability-inert SELF-loop via a
-    #: delta (a tuple whose subject is the node's own set). Recorded so
-    #: ``snapshot()`` freshness treats the write as applied; expand
-    #: delegates overlay-pending snapshots to the Manager anyway
-    ov_self: Optional[set] = None
     device_overlay: Any = None  # (ov_nbrs, ov_dst) jnp arrays or None
     _pattern_cache: dict = field(default_factory=dict)
     _cache_lock: threading.Lock = field(default_factory=threading.Lock)
@@ -173,7 +168,6 @@ class GraphSnapshot:
             or bool(self.ov_leaf_ids)
             or bool(self.ov_out)
             or bool(self.ov_sink_in)
-            or bool(self.ov_self)
             or self.ov_ell is not None
         )
 

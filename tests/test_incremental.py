@@ -439,3 +439,29 @@ def test_no_target_sentinel_never_collides_with_overlay_ids():
             T("g", "a", "m", SubjectID("u1")),
         ],
     )
+
+
+def test_overlay_compacts_in_background():
+    """An insert-only workload must not keep an overlay (and everything
+    gated on it, e.g. expand's Manager delegation) alive forever: after
+    compact_after_s of quiet, a background full rebuild folds it in."""
+    import time as time_mod
+
+    p = make_store()
+    p.write_relation_tuples(T("g", "team", "member", SubjectID("alice")))
+    engine = TpuCheckEngine(p, p.namespaces, compact_after_s=0.1)
+    engine.snapshot()
+    p.write_relation_tuples(T("g", "team", "member", SubjectID("bob")))
+    snap = engine.snapshot()
+    assert snap.has_overlay  # delta applied
+    time_mod.sleep(0.15)
+    deadline = time_mod.time() + 10
+    while time_mod.time() < deadline:
+        if not engine.snapshot().has_overlay:
+            break
+        time_mod.sleep(0.05)
+    final = engine.snapshot()
+    assert not final.has_overlay, "overlay never compacted"
+    assert final.snapshot_id == p.watermark()
+    assert engine.subject_is_allowed(T("g", "team", "member", SubjectID("bob")))
+    assert not engine.subject_is_allowed(T("g", "team", "member", SubjectID("eve")))

@@ -273,7 +273,7 @@ def test_delta_self_loop_renders_child(make_persister):
     # delta: tuple g:team#r1@(g:team#) — subject IS the wildcard node
     p.write_relation_tuples(T("g", "team", "r1", SubjectSet("g", "team", "")))
     snap = tpu._engine.snapshot()
-    assert snap.ov_self, "expected the dropped self-loop to be recorded"
+    assert snap.has_overlay or snap.ov_set_ids is None  # delta or rebuild: both legal
     h = normalize(host.build_tree(SubjectSet("g", "team", ""), 5))
     t = normalize(tpu.build_tree(SubjectSet("g", "team", ""), 5))
     assert h is not None and t is not None and h.equals(t), f"{h}\nvs\n{t}"
@@ -328,18 +328,24 @@ def test_overlay_pending_semantic_parity_fuzz(make_persister, seed):
 
 
 def test_delta_self_loop_on_existing_node(make_persister):
-    """A delta whose ONLY overlay effect is a self-loop on an EXISTING
-    node (ov_self alone) must still delegate — the base CSR lacks the
-    self-referencing child the host renders."""
+    """A delta self-loop on an existing node routes through normal edge
+    classification (it IS a path of length 1): the tree shows the
+    self-referencing child and — the part the old special-case got wrong
+    — a CHECK of the node against its own subject set grants."""
+    from keto_tpu.check import CheckEngine
+
     p = make_persister([("g", 1)])
     p.write_relation_tuples(T("g", "team", "r0", SubjectID("u1")))
     host, tpu = engines(p)
     tpu.build_tree(SubjectSet("g", "team", "r0"), 5)  # base snapshot
     p.write_relation_tuples(T("g", "team", "r0", SubjectSet("g", "team", "r0")))
-    snap = tpu._engine.snapshot()
-    assert snap.ov_self and not snap.ov_set_ids and not snap.ov_leaf_ids
-    assert snap.has_overlay
     h = host.build_tree(SubjectSet("g", "team", "r0"), 5)
     t = tpu.build_tree(SubjectSet("g", "team", "r0"), 5)
     assert_tree_identical(h, t)
     assert sorted(str(c.subject) for c in t.children) == ["g:team#r0", "u1"]
+    # the check-parity half (previously denied while the overlay was pending)
+    oracle = CheckEngine(p)
+    q = T("g", "team", "r0", SubjectSet("g", "team", "r0"))
+    want = oracle.subject_is_allowed(q)
+    assert want is True
+    assert tpu._engine.subject_is_allowed(q) is want
