@@ -465,3 +465,30 @@ def test_overlay_compacts_in_background():
     assert final.snapshot_id == p.watermark()
     assert engine.subject_is_allowed(T("g", "team", "member", SubjectID("bob")))
     assert not engine.subject_is_allowed(T("g", "team", "member", SubjectID("eve")))
+
+
+def test_checks_correct_during_compaction_races():
+    """Checks served while background compactions and delta writes race
+    must match the oracle throughout (compact_after_s=0 forces a
+    compaction kick on every overlay-bearing snapshot read)."""
+    import random as random_mod
+
+    rng = random_mod.Random(3)
+    p = make_store()
+    users = [f"u{i}" for i in range(8)]
+    for g in range(6):
+        p.write_relation_tuples(
+            T("g", f"grp{g}", "m", SubjectSet("g", f"grp{(g + 1) % 6}", "m")),
+            *[T("g", f"grp{g}", "m", SubjectID(u)) for u in rng.sample(users, 3)],
+        )
+    engine = TpuCheckEngine(p, p.namespaces, compact_after_s=0.0)
+    oracle = CheckEngine(p)
+    for round_ in range(12):
+        p.write_relation_tuples(T("g", f"grp{round_ % 6}", "m", SubjectID(f"w{round_}")))
+        qs = [
+            T("g", f"grp{rng.randrange(6)}", "m", SubjectID(rng.choice(users + [f"w{round_}", "ghost"])))
+            for _ in range(30)
+        ]
+        got = engine.batch_check(qs)
+        for q, g in zip(qs, got):
+            assert g == oracle.subject_is_allowed(q), f"round {round_}: {q}"
