@@ -88,7 +88,7 @@ def _csr_gather_counts(
 class Bucket:
     """One live-in-degree bucket: ``nbrs[i, j]`` is the device id of the
     j-th live in-neighbor of device node ``offset + i`` (sentinel
-    ``num_live`` — the all-zero bitmap row — when padding)."""
+    ``num_int`` — the all-zero bitmap row — when padding)."""
 
     offset: int  # device id of the first row
     n: int  # valid rows (bucket membership)
