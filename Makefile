@@ -4,7 +4,7 @@
 CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
 
-.PHONY: all native proto schemas test bench clean
+.PHONY: all native proto schemas docs test bench clean
 
 # render the public JSON schemas into .schema/
 schemas:
@@ -12,7 +12,12 @@ schemas:
 
 all: native proto
 
-# native tuple→graph interner (keto_tpu/graph/native.py loads it)
+# generated CLI + proto reference docs (freshness-tested in CI)
+docs:
+	python scripts/render_docs.py
+
+# native libraries: tuple→graph interner (keto_tpu/graph/native.py) and
+# the epoll port multiplexer (keto_tpu/servers/native_mux.py)
 native: native/libketoingest.so native/libketomux.so
 
 native/libketoingest.so: native/ingest.cpp
