@@ -488,6 +488,7 @@ class TpuCheckEngine:
         shard_rows: bool = False,
         mem_budget_bytes: int = 10 << 30,
         compact_after_s: float = 5.0,
+        peel_seed_cap: float = 4.0,
     ):
         if it_cap < 1:
             raise ValueError("it_cap must be >= 1 (the answer pull needs one step)")
@@ -534,6 +535,7 @@ class TpuCheckEngine:
         # workload would keep a small overlay — and everything gated on it,
         # e.g. expand's Manager delegation — alive forever
         self._compact_after_s = compact_after_s
+        self._peel_seed_cap = peel_seed_cap
         self._overlay_born: Optional[float] = None
         self._bg_rebuild: Optional[threading.Thread] = None
 
@@ -612,7 +614,7 @@ class TpuCheckEngine:
             new = self._try_delta(snap, wild_ns_ids)
         if new is None:
             rows, wm = self._store.snapshot_rows()
-            new = build_snapshot(rows, wm, wild_ns_ids)
+            new = build_snapshot(rows, wm, wild_ns_ids, peel_seed_cap=self._peel_seed_cap)
             self._upload_buckets(new)
         self._upload_overlay(new)
         self._snapshot = new

@@ -50,6 +50,7 @@ _DEFAULTS: dict[str, Any] = {
         "backend": "auto",
         "batch_size": 4096,
         "it_cap": 4096,
+        "peel_seed_cap": 4.0,
         "batch_window_ms": 1.0,
     },
     "limit": {"max_read_depth": 5},
@@ -69,6 +70,7 @@ _ENV_KEYS = [
     "engine.backend",
     "engine.batch_size",
     "engine.it_cap",
+    "engine.peel_seed_cap",
     "engine.batch_window_ms",
     "limit.max_read_depth",
     "log.level",
@@ -104,16 +106,28 @@ def _get_path(cfg: dict, dotted: str, default: Any = None) -> Any:
     return cur
 
 
+def _schema_type(dotted: str) -> Optional[str]:
+    node: Any = CONFIG_SCHEMA
+    for part in dotted.split("."):
+        node = node.get("properties", {}).get(part)
+        if not isinstance(node, dict):
+            return None
+    return node.get("type")
+
+
 def _coerce(dotted: str, raw: str) -> Any:
-    if dotted.endswith((".port", "_size", "_cap", "max_read_depth")):
-        return int(raw)
-    if dotted.endswith("_ms"):
-        return float(raw)
+    # env values coerce by the key's DECLARED schema type — suffix
+    # heuristics rot the moment a float key ends in _cap or _size
     if dotted == "namespaces":
         raw = raw.strip()
         if raw.startswith("["):
             return json.loads(raw)
         return raw
+    t = _schema_type(dotted)
+    if t == "integer":
+        return int(raw)
+    if t == "number":
+        return float(raw)
     return raw
 
 

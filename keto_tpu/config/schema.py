@@ -69,6 +69,11 @@ CONFIG_SCHEMA = {
                     "default": 4096,
                     "description": "BFS iteration cap per device batch; hitting it logs a truncation warning.",
                 },
+                "peel_seed_cap": {
+                    "type": "number",
+                    "default": 4.0,
+                    "description": "Max host-propagated seeds a peeled node may expand to; raise on local hardware with fast host-device links.",
+                },
                 "batch_window_ms": {"type": "number", "default": 1.0},
             },
         },

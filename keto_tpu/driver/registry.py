@@ -99,6 +99,7 @@ class Registry:
                     store,
                     self.namespaces_source(),
                     it_cap=int(self._config.get("engine.it_cap", 4096)),
+                    peel_seed_cap=float(self._config.get("engine.peel_seed_cap", 4.0)),
                 )
             return CheckEngine(store)
 

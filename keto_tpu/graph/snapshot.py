@@ -401,7 +401,10 @@ class GraphSnapshot:
 
 
 def build_snapshot(
-    rows: Iterable, watermark: int, wild_ns_ids: FrozenSet[int] = frozenset()
+    rows: Iterable,
+    watermark: int,
+    wild_ns_ids: FrozenSet[int] = frozenset(),
+    peel_seed_cap: float = 4.0,
 ) -> GraphSnapshot:
     """Intern rows and lay out the bucketed reverse-ELL adjacency.
 
@@ -467,7 +470,10 @@ def build_snapshot(
     # through already-peeled nodes) stays small. A high-fanout hub (e.g.
     # an org granting 25 teams) keeps its bitmap row; its fanout stays a
     # device edge gathered per iteration instead of 25 seeds per query.
-    SEED_CAP = 4.0
+    # The default of 4 is tuned for a thin host↔device link (tunnel);
+    # local hardware with full PCIe/DMA bandwidth can raise it
+    # (engine.peel_seed_cap) to trade seed bytes for smaller kernels.
+    SEED_CAP = peel_seed_cap
     peeled = np.zeros(n, bool)
     closure = np.zeros(n)  # seeds a peeled node expands to
     for _ in range(16):  # bounded: adversarial deep chains stay active

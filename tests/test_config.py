@@ -251,3 +251,28 @@ def test_websocket_survives_mid_frame_timeout():
         w.stop()
     finally:
         srv.close()
+
+
+def test_peel_seed_cap_reaches_snapshot_builder():
+    """engine.peel_seed_cap plumbs config → engine → build_snapshot (0
+    disables peeling entirely; env value coerces to float)."""
+    from keto_tpu.config.provider import _coerce
+    from keto_tpu.driver.registry import Registry
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    assert _coerce("engine.peel_seed_cap", "2.5") == 2.5
+    cfg = Config(overrides={"namespaces": [{"id": 1, "name": "g"}], "engine.peel_seed_cap": 0.0})
+    reg = Registry(cfg)
+    p = reg.relation_tuple_manager()
+    # a chain that peels under the default cap (mid has no sink out-edges)
+    p.write_relation_tuples(
+        RelationTuple(namespace="g", object="doc", relation="v", subject=SubjectSet("g", "mid", "m")),
+        RelationTuple(namespace="g", object="mid", relation="m", subject=SubjectSet("g", "leaf", "m")),
+        RelationTuple(namespace="g", object="leaf", relation="m", subject=SubjectID("u")),
+    )
+    snap = reg.permission_engine().snapshot()
+    assert snap.n_peeled == 0, "cap 0 must disable peeling"
+    assert reg.permission_engine().subject_is_allowed(
+        RelationTuple(namespace="g", object="doc", relation="v", subject=SubjectID("u"))
+    )
+    cfg.close()
