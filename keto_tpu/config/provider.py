@@ -52,6 +52,7 @@ _DEFAULTS: dict[str, Any] = {
         "it_cap": 4096,
         "peel_seed_cap": 4.0,
         "batch_window_ms": 1.0,
+        "sync_rebuild_budget_s": 0.25,
     },
     "limit": {"max_read_depth": 5},
     "log": {"level": "info", "format": "text"},
@@ -72,6 +73,7 @@ _ENV_KEYS = [
     "engine.it_cap",
     "engine.peel_seed_cap",
     "engine.batch_window_ms",
+    "engine.sync_rebuild_budget_s",
     "limit.max_read_depth",
     "log.level",
     "log.format",

@@ -75,6 +75,11 @@ CONFIG_SCHEMA = {
                     "description": "Max host-propagated seeds a peeled node may expand to; raise on local hardware with fast host-device links.",
                 },
                 "batch_window_ms": {"type": "number", "default": 1.0},
+                "sync_rebuild_budget_s": {
+                    "type": "number",
+                    "default": 0.25,
+                    "description": "Serving-path policy: when the last full snapshot rebuild cost more than this, default-consistency checks serve the current snapshot and rebuilds run in the background (bounded staleness); cheaper stores catch up inline (read-your-writes).",
+                },
             },
         },
         "limit": {

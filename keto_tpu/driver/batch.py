@@ -71,9 +71,33 @@ class CheckBatcher:
 
     # -- API -----------------------------------------------------------------
 
-    def check(self, tuple_: RelationTuple, timeout: Optional[float] = 30.0) -> bool:
+    def check(
+        self,
+        tuple_: RelationTuple,
+        timeout: Optional[float] = 30.0,
+        *,
+        at_least: Optional[int] = None,
+        latest: bool = False,
+    ) -> bool:
         """Blocking single check, transparently batched with concurrent
-        callers."""
+        callers. Default consistency is the serving mode (bounded
+        staleness, never stalled by a rebuild); ``at_least`` pins a
+        caller's snaptoken, ``latest`` forces read-your-writes."""
+        return self.check_with_token(
+            tuple_, timeout, at_least=at_least, latest=latest
+        )[0]
+
+    def check_with_token(
+        self,
+        tuple_: RelationTuple,
+        timeout: Optional[float] = 30.0,
+        *,
+        at_least: Optional[int] = None,
+        latest: bool = False,
+    ) -> tuple[bool, Optional[int]]:
+        """``check`` plus the id of the snapshot that decided it (None when
+        the engine has no snapshot concept — e.g. the recursive oracle,
+        which reads the store directly and is always fresh)."""
         if self._stop.is_set():
             raise RuntimeError("check batcher stopped")
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -82,7 +106,7 @@ class CheckBatcher:
             # a full queue blocks the caller — the backpressure seam
             # between accepts and the device — against the SAME deadline
             # the result wait uses, so the total never exceeds ``timeout``
-            self._queue.put((tuple_, fut), timeout=timeout)
+            self._queue.put((tuple_, fut, at_least, latest), timeout=timeout)
         except queue.Full:
             raise TimeoutError("check queue full (device backlogged)") from None
         if self._stop.is_set() and not fut.done():
@@ -98,6 +122,24 @@ class CheckBatcher:
     def check_batch(self, tuples: Sequence[RelationTuple]) -> list[bool]:
         """Pre-batched requests skip the queue entirely."""
         return self._engine.batch_check(list(tuples))
+
+    def _dispatch(self, tuples, at_leasts, latests):
+        """One engine call for a coalesced batch: the strongest requested
+        consistency wins (freshness is monotone — a fresher snapshot
+        satisfies every weaker requirement in the batch)."""
+        if hasattr(self._engine, "batch_check_with_token"):
+            if any(latests):
+                # read-your-writes dominates every floor in the batch
+                return self._engine.batch_check_with_token(tuples, mode="latest")
+            floors = [a for a in at_leasts if a is not None]
+            return self._engine.batch_check_with_token(
+                tuples, at_least=max(floors) if floors else None, mode="serving"
+            )
+        # oracle engine: always fresh (reads the store per traversal
+        # step), no snapshot concept
+        if hasattr(self._engine, "batch_check"):
+            return self._engine.batch_check(tuples), None
+        return [self._engine.subject_is_allowed(t) for t in tuples], None
 
     # -- collector -----------------------------------------------------------
 
@@ -128,14 +170,18 @@ class CheckBatcher:
                     break
                 batch.append(nxt)
 
-            tuples = [t for t, _ in batch]
+            tuples = [t for t, _, _, _ in batch]
             try:
-                results = self._engine.batch_check(tuples)
+                results, token = self._dispatch(
+                    tuples,
+                    [a for _, _, a, _ in batch],
+                    [l for _, _, _, l in batch],
+                )
             except Exception as e:  # engine failure → every caller sees it
-                for _, fut in batch:
+                for _, fut, _, _ in batch:
                     if not fut.done():
                         fut.set_exception(e)
                 continue
-            for (_, fut), allowed in zip(batch, results):
+            for (_, fut, _, _), allowed in zip(batch, results):
                 if not fut.done():
-                    fut.set_result(allowed)
+                    fut.set_result((allowed, token))

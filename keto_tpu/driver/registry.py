@@ -100,6 +100,9 @@ class Registry:
                     self.namespaces_source(),
                     it_cap=int(self._config.get("engine.it_cap", 4096)),
                     peel_seed_cap=float(self._config.get("engine.peel_seed_cap", 4.0)),
+                    sync_rebuild_budget_s=float(
+                        self._config.get("engine.sync_rebuild_budget_s", 0.25)
+                    ),
                 )
             return CheckEngine(store)
 

@@ -1,11 +1,11 @@
-"""Insert-only delta application: extend a snapshot without rebuilding it.
+"""Delta application: extend a snapshot without rebuilding it.
 
 The reference serves reads during writes through SQL MVCC — a transactional
-insert never stalls readers (reference
-internal/persistence/sql/relationtuples.go:271-278). The TPU analog cannot
-re-intern and re-lay-out the device graph per write (seconds at 1M+ tuples),
-so insert-only watermark advances apply as an **overlay** on the immutable
-base snapshot:
+insert or delete never stalls readers (reference
+internal/persistence/sql/relationtuples.go:178-201, 271-278). The TPU analog
+cannot re-intern and re-lay-out the device graph per write (seconds at 1M+
+tuples), so watermark advances apply as an **overlay** on the immutable base
+snapshot:
 
 - new nodes get device ids ≥ ``base.n_base_nodes``. They never need bitmap
   rows: a brand-new set key seen as a tuple's LHS has only out-edges
@@ -22,19 +22,40 @@ base snapshot:
   * interior source → sink destination → answer-gather overlay
     (``ov_sink_in``);
 
+- **deleted edges become tombstones** instead of forcing a rebuild (the
+  reference's MVCC serves reads through deletes the same way): a removed
+  base edge enters ``ov_removed`` — a sorted key array the snapshot's host
+  gathers (``out_neighbors_bulk`` / ``sink_in_rows_bulk``) mask against —
+  and, when it is an iterated interior→interior edge, an ``ell_patch``
+  entry that overwrites its slot in the device bucket with the all-zero
+  sentinel row (the engine applies patches with one tiny device scatter —
+  no re-upload). Deleting an overlay-added edge simply removes it from the
+  overlay structures. Deletes never change the layout: a node left
+  edgeless keeps its (now unreachable) rows and answers deny. Only graphs
+  containing wildcard set nodes rebuild on delete — a removed tuple's
+  wildcard-attach edges survive exactly when another matching row covers
+  them, which requires a store scan;
 - a delta tuple also attaches to every **existing wildcard set node** whose
   pattern matches it, mirroring the base builder's wildcard expansion
   (keto_tpu/graph/interner.py intern_rows pass 2);
-- anything that would change an existing node's class — a sink gaining an
-  out-edge, a static node gaining an in-edge, an edge into a
+- anything that would change an existing node's class on INSERT — a sink
+  gaining an out-edge, a static node gaining an in-edge, an edge into a
   passive-interior row (which the BFS loop never updates), a new
   wildcard-bearing key (whose out-edges require a full tuple scan), an
-  overlay node transitioning class — and any delete returns ``None``:
-  the caller falls back to a full rebuild.
+  overlay node transitioning class — returns ``None``: the caller falls
+  back to a full rebuild.
+
+``apply_delta`` consumes an ordered op list (``("ins", row) | ("del",
+key7)`` — the store's ``changes_since`` seam) and nets it per tuple key
+first: only the last op per key matters for edge presence, so
+delete-then-reinsert within one delta window is a no-op and
+insert-then-delete never materializes.
 
 ``apply_delta`` is pure: it returns a NEW GraphSnapshot sharing the base's
 arrays (in-flight batches keep using the old object), with the overlay
-containers copied-and-extended.
+containers copied-and-extended. Pending device patches ride in
+``ell_patch`` relative to the base's ``device_buckets``; the engine applies
+and clears them under its snapshot lock.
 """
 
 from __future__ import annotations
@@ -51,36 +72,62 @@ def _merged(old: Optional[dict]) -> dict:
     return dict(old) if old else {}
 
 
+def rows_as_ops(rows: Iterable) -> list:
+    """Wrap an insert-only row list in the op format (the ``rows_since``
+    compatibility shim for stores without a delete log)."""
+    return [("ins", r) for r in rows]
+
+
 def apply_delta(
     base: GraphSnapshot,
-    rows: Iterable,
+    ops: list,
     new_watermark: int,
     wild_ns_ids: FrozenSet[int],
 ) -> Optional[GraphSnapshot]:
-    """Overlay ``rows`` (InternalRow-shaped inserts since the base
-    watermark) onto ``base``. Returns the extended snapshot, or ``None``
-    when the delta needs a full rebuild."""
+    """Overlay ``ops`` (ordered mutations since the base watermark) onto
+    ``base``. Returns the extended snapshot, or ``None`` when the delta
+    needs a full rebuild."""
     if wild_ns_ids != base.wild_ns_ids:
         return None  # namespace config changed — wildcard expansion differs
-    rows = list(rows)
+
+    # net effect per tuple key: the last op wins (deletes remove ALL rows
+    # of a key, so edge presence after the delta is decided by whether the
+    # final op re-inserted it). First-seen key order keeps processing
+    # deterministic across hosts (the multi-controller lockstep contract).
+    net: dict[tuple, tuple] = {}
+    for kind, payload in ops:
+        key = payload if kind == "del" else payload.key7()
+        net[key] = (kind, payload)
+    ins_rows = [p for k, (kind, p) in net.items() if kind == "ins"]
+    del_keys = [k for k, (kind, _) in net.items() if kind == "del"]
+
     ni = base.num_int
     na = base.num_active
     sb = base.sink_base  # peeled interior ids live in [ni, sb)
     nl = base.num_live
     nb = base.n_base_nodes
 
+    interned = base.interned
+    raw2dev = base.raw2dev
+
+    if del_keys and bool(np.any(np.asarray(interned.key_wild))):
+        # a removed tuple's wildcard-attach edges survive iff another
+        # matching row covers them — deciding that needs a store scan
+        return None
+
     ov_set = _merged(base.ov_set_ids)
     ov_leaf = _merged(base.ov_leaf_ids)
     ov_out = {k: v for k, v in (base.ov_out or {}).items()}
     ov_sink_in = {k: v for k, v in (base.ov_sink_in or {}).items()}
     ell = [tuple(e) for e in (() if base.ov_ell is None else base.ov_ell)]
+    removed: set[int] = (
+        set(int(k) for k in base.ov_removed) if base.ov_removed is not None else set()
+    )
+    ell_patch: list[tuple[int, int, int, int]] = []
     nxt = base.ov_next or nb
 
     # overlay node classes: "static" = out-edges only, "sink" = in-edges only
     ov_class: dict[int, str] = dict(base.ov_class or {})
-
-    interned = base.interned
-    raw2dev = base.raw2dev
 
     def resolve_or_new_set(ns_id: int, obj: str, rel: str):
         raw = interned.resolve_set(ns_id, obj, rel)
@@ -124,7 +171,20 @@ def apply_delta(
         a, b = fwd_indptr[src], fwd_indptr[src + 1]
         return bool(np.any(fwd_indices[a:b] == dst))
 
-    for r in rows:
+    def ell_slot(src: int, dst: int) -> Optional[tuple[int, int, int]]:
+        """(bucket index, bucket-local row, column) of base ELL edge
+        src→dst — located in the base host arrays (never patched, so slots
+        stay stable across remove/restore cycles)."""
+        for bi, b in enumerate(base.buckets):
+            if b.offset <= dst < b.offset + b.n:
+                row = dst - b.offset
+                cols = np.nonzero(b.nbrs[row] == src)[0]
+                if cols.size == 0:
+                    return None
+                return bi, row, int(cols[0])
+        return None
+
+    for r in ins_rows:
         lhs_wild = (
             r.namespace_id in wild_ns_ids or r.object == "" or r.relation == ""
         )
@@ -199,6 +259,15 @@ def apply_delta(
 
     for src, dst in new_edges:
         if in_base_csr(src, dst):
+            key = (src << 32) | dst
+            if key in removed:
+                # re-insert of a tombstoned base edge: restore in place
+                removed.discard(key)
+                if src < ni and dst < na:
+                    slot = ell_slot(src, dst)
+                    if slot is None:
+                        return None  # base layout disagrees — be safe
+                    ell_patch.append(slot + (src,))
             continue
         if nl <= dst < nb:
             return None  # base static node gains an in-edge
@@ -228,6 +297,68 @@ def apply_delta(
         else:
             return None  # sink source would need class change
 
+    # deletes: resolve each key's endpoints (no creation) and remove the
+    # edge wherever it lives — overlay structures for delta-added edges,
+    # the tombstone set (plus a device sentinel patch for iterated edges)
+    # for base edges. Unresolvable endpoints or absent edges are no-ops:
+    # deleting a tuple that isn't there changes nothing (the store's
+    # delete log only records effective deletes anyway).
+    ell_members = set(ell)
+    dropped_ell: set[tuple[int, int]] = set()
+    for k in del_keys:
+        ns_id, obj, rel, sub_id, sns, sobj, srel = k
+        lhs_dev, lhs_missing = resolve_or_new_set(ns_id, obj, rel)
+        if lhs_missing:
+            continue
+        if sub_id is not None:
+            sub_dev, sub_missing = resolve_or_new_leaf(sub_id)
+        else:
+            sub_dev, sub_missing = resolve_or_new_set(sns, sobj, srel)
+        if sub_missing:
+            continue
+        edge = (lhs_dev, sub_dev)
+        if edge in ell_members:
+            ell_members.discard(edge)
+            dropped_ell.add(edge)
+            continue
+        out_arr = ov_out.get(lhs_dev)
+        if out_arr is not None and bool(np.any(out_arr == sub_dev)):
+            rest = out_arr[out_arr != sub_dev]
+            if rest.size:
+                ov_out[lhs_dev] = rest
+            else:
+                del ov_out[lhs_dev]
+            continue
+        in_arr = ov_sink_in.get(sub_dev)
+        if in_arr is not None and bool(np.any(in_arr == lhs_dev)):
+            rest = in_arr[in_arr != lhs_dev]
+            if rest.size:
+                ov_sink_in[sub_dev] = rest
+            else:
+                del ov_sink_in[sub_dev]
+            continue
+        key = (lhs_dev << 32) | sub_dev
+        if key in removed or not in_base_csr(lhs_dev, sub_dev):
+            continue  # already tombstoned / edge never existed
+        removed.add(key)
+        if lhs_dev < ni and sub_dev < na:
+            slot = ell_slot(lhs_dev, sub_dev)
+            if slot is None:
+                return None  # base layout disagrees — be safe
+            # num_int is the bitmap's all-zero row: the gather contributes
+            # nothing, exactly like bucket padding
+            ell_patch.append(slot + (ni,))
+        elif lhs_dev < ni and not (sb <= sub_dev < nl):
+            # interior source into anything but a sink has no host-side
+            # mask to hide behind — only the two handled classes exist in
+            # a consistent layout (ELL above, sink gathers below), so an
+            # unclassifiable edge means the layout and the store disagree
+            return None
+        # peeled/static sources and interior→sink edges are masked by the
+        # ov_removed filters in out_neighbors_bulk / sink_in_rows_bulk
+    if dropped_ell:
+        ell = [e for e in ell if e not in dropped_ell]
+
     for src, dsts in add_out.items():
         old = ov_out.get(src)
         merged = np.asarray(dsts, np.int64) if old is None else np.concatenate(
@@ -245,6 +376,10 @@ def apply_delta(
     if ell:
         ell_arr = np.unique(np.asarray(ell, np.int64), axis=0)
 
+    removed_arr = None
+    if removed:
+        removed_arr = np.sort(np.fromiter(removed, np.int64, len(removed)))
+
     return dataclasses.replace(
         base,
         snapshot_id=new_watermark,
@@ -255,6 +390,8 @@ def apply_delta(
         ov_out=ov_out,
         ov_sink_in=ov_sink_in,
         ov_ell=ell_arr,
+        ov_removed=removed_arr,
+        ell_patch=ell_patch or None,
         device_overlay=None,  # engine re-uploads (cheap: overlay is small)
         _pattern_cache={},
         _cache_lock=__import__("threading").Lock(),

@@ -150,6 +150,14 @@ class GraphSnapshot:
     ov_out: Optional[dict] = None  # src dev → np.int64[...] out-neighbor devs
     ov_sink_in: Optional[dict] = None  # sink dev → np.int32[...] interior srcs
     ov_ell: Optional[np.ndarray] = None  # int64 [K, 2] (src, dst) edges
+    #: tombstoned BASE edges as a sorted int64 key array ((src << 32) | dst)
+    #: — deletes applied as deltas (keto_tpu/graph/overlay.py). Host
+    #: gathers mask against it; iterated edges are additionally sentinel-
+    #: patched out of the device buckets (``ell_patch``).
+    ov_removed: Optional[np.ndarray] = None
+    #: pending device-bucket patches [(bucket, row, col, value)] relative
+    #: to the base's device_buckets; the engine applies + clears them
+    ell_patch: Optional[list] = None
     device_overlay: Any = None  # (ov_nbrs, ov_dst) jnp arrays or None
     _pattern_cache: dict = field(default_factory=dict)
     _cache_lock: threading.Lock = field(default_factory=threading.Lock)
@@ -169,6 +177,7 @@ class GraphSnapshot:
             or bool(self.ov_out)
             or bool(self.ov_sink_in)
             or self.ov_ell is not None
+            or (self.ov_removed is not None and self.ov_removed.size > 0)
         )
 
     @property
@@ -193,6 +202,8 @@ class GraphSnapshot:
             ov += int(self.ov_ell.shape[0])
         if self.ov_sink_in:
             ov += sum(v.size for v in self.ov_sink_in.values())
+        if self.ov_removed is not None:
+            ov -= int(self.ov_removed.size)
         return base + ov
 
 
@@ -273,15 +284,27 @@ class GraphSnapshot:
                 out[i] = int(devs[i]) in ov_sets
         return out
 
+    def _removed_drop(self, keys: np.ndarray, cnts: np.ndarray):
+        """(keep-mask over gathered entries, per-segment adjusted counts)
+        for the tombstone filter, or None when nothing matches. ``keys``
+        are (endpoint << 32) | endpoint packed like ``ov_removed``."""
+        rem = self.ov_removed
+        pos = np.clip(np.searchsorted(rem, keys), 0, rem.size - 1)
+        hit = rem[pos] == keys
+        if not hit.any():
+            return None
+        seg = np.repeat(np.arange(cnts.shape[0]), cnts)
+        return ~hit, cnts - np.bincount(seg[hit], minlength=cnts.shape[0])
+
     def out_neighbors_bulk(self, nodes: np.ndarray):
         """(concatenated out-neighbor devs of ``nodes``, per-node counts) —
         base forward CSR merged with the delta overlay's adjacency (new
-        tuples since the base build). Node order is preserved. Base
-        neighbor order within a node is GUARANTEED to be store row order
-        (= the Manager's page order; interner dedup keeps first occurrence
-        — the expand engine's tree-child parity depends on this,
-        keto_tpu/expand/tpu_engine.py); overlay extras append after base
-        neighbors."""
+        tuples since the base build) and masked by its tombstones (deleted
+        tuples). Node order is preserved. Base neighbor order within a node
+        is GUARANTEED to be store row order (= the Manager's page order;
+        interner dedup keeps first occurrence — the expand engine's
+        tree-child parity depends on this, keto_tpu/expand/tpu_engine.py);
+        overlay extras append after base neighbors."""
         nodes = np.asarray(nodes)
         nb = self.n_base_nodes
         if nodes.size and int(nodes.max()) >= nb:
@@ -299,6 +322,14 @@ class GraphSnapshot:
             )
         else:
             rows, cnts = _csr_gather_host(self.fwd_indptr, self.fwd_indices, nodes)
+        if self.ov_removed is not None and self.ov_removed.size and rows.size:
+            keys = (np.repeat(nodes.astype(np.int64), cnts) << 32) | rows.astype(
+                np.int64
+            )
+            drop = self._removed_drop(keys, cnts)
+            if drop is not None:
+                keep, cnts = drop
+                rows = rows[keep]
         ov = self.ov_out
         if ov is None or not ov:
             return rows, cnts
@@ -325,10 +356,12 @@ class GraphSnapshot:
     def sink_in_rows_bulk(self, sinks: np.ndarray):
         """(concatenated interior in-neighbor rows of sink-class targets,
         per-target counts) — base sink reverse CSR merged with overlay
-        in-edges. ``sinks`` are device ids (base sinks or overlay nodes)."""
+        in-edges and masked by tombstones. ``sinks`` are device ids (base
+        sinks or overlay nodes)."""
         sinks = np.asarray(sinks)
         sb, nl = self.sink_base, self.num_live
-        if self.ov_sink_in is None or not self.ov_sink_in:
+        no_ov = self.ov_sink_in is None or not self.ov_sink_in
+        if no_ov and (self.ov_removed is None or not self.ov_removed.size):
             return _csr_gather_host(self.sink_indptr, self.sink_indices, sinks - sb)
         in_base = (sinks >= sb) & (sinks < nl)
         base_idx = np.where(in_base, sinks - sb, 0)
@@ -338,6 +371,16 @@ class GraphSnapshot:
             0,
         )
         rows, cnts = _csr_gather_counts(self.sink_indptr, self.sink_indices, base_idx, cnts)
+        if self.ov_removed is not None and self.ov_removed.size and rows.size:
+            keys = (rows.astype(np.int64) << 32) | np.repeat(
+                sinks.astype(np.int64), cnts
+            )
+            drop = self._removed_drop(keys, cnts)
+            if drop is not None:
+                keep, cnts = drop
+                rows = rows[keep]
+        if no_ov:
+            return rows, cnts
         ov = self.ov_sink_in
         member = np.asarray([int(s) in ov for s in sinks], bool)
         if not member.any():

@@ -115,10 +115,14 @@ class _SharedState:
         self.lhs_index: Optional[dict[tuple, list[InternalRow]]] = None
         # insert log for delta snapshots (keto_tpu/graph/overlay.py):
         # (watermark, row) per inserted row, per network; any delete bumps
-        # delete_wm, invalidating deltas from before it
+        # delete_wm, invalidating insert-only deltas from before it
         self.insert_log: dict[str, list[tuple[int, InternalRow]]] = {}
         self.delete_wm: dict[str, int] = {}
         self.log_floor: dict[str, int] = {}
+        # delete log for tombstone deltas (``changes_since``): (watermark,
+        # key7) per delete key, per network, bounded like the insert log
+        self.delete_log: dict[str, list[tuple[int, tuple]]] = {}
+        self.del_floor: dict[str, int] = {}
 
 
 class MemoryPersister(Manager):
@@ -276,11 +280,17 @@ class MemoryPersister(Manager):
             else:
                 for r in new_rows:
                     bisect.insort(rows, r, key=InternalRow.sort_key)
+            hit_keys: set = set()
             if delete_keys:
                 keyset = set(delete_keys)
-                self._shared.rows[self.network_id] = [
-                    r for r in rows if r.key7() not in keyset
-                ]
+                kept = []
+                for r in rows:
+                    k = r.key7()
+                    if k in keyset:
+                        hit_keys.add(k)
+                    else:
+                        kept.append(r)
+                self._shared.rows[self.network_id] = kept
             # maintain the LHS index incrementally: a full invalidation
             # per write made every post-write indexed read pay an O(rows)
             # rebuild (walls at tens of millions of tuples). Buckets stay
@@ -308,9 +318,22 @@ class MemoryPersister(Manager):
             self._shared.watermark += 1
             wm = self._shared.watermark
             nid = self.network_id
-            if delete_keys:
-                # deletes invalidate any delta from before this point
+            if hit_keys:
+                # only EFFECTIVE deletes (matched ≥ 1 row) are recorded —
+                # same contract as the sqlite store, and what apply_delta's
+                # wildcard-graph rebuild guard assumes. They invalidate any
+                # insert-only delta from before this point (rows_since);
+                # tombstone-capable readers use the delete log via
+                # changes_since instead.
                 self._shared.delete_wm[nid] = wm
+                dlog = self._shared.delete_log.setdefault(nid, [])
+                dlog.extend(
+                    (wm, k) for k in dict.fromkeys(delete_keys) if k in hit_keys
+                )
+                if len(dlog) > self._shared.LOG_CAP:
+                    drop = len(dlog) - self._shared.LOG_CAP
+                    self._shared.del_floor[nid] = dlog[drop - 1][0]
+                    del dlog[:drop]
             if new_rows:
                 if len(new_rows) > self._shared.LOG_CAP:
                     # bulk load past the cap: a delta spanning this batch
@@ -352,3 +375,30 @@ class MemoryPersister(Manager):
                 return None
             log = self._shared.insert_log.get(nid, ())
             return [r for w, r in log if w > watermark], self._shared.watermark
+
+    def changes_since(self, watermark: int):
+        """Ordered mutations after ``watermark`` as ``(ops, new_watermark)``
+        where each op is ``("ins", InternalRow)`` or ``("del", key7)`` —
+        the tombstone-capable delta seam (keto_tpu/graph/overlay.py handles
+        deletes as removed-edge masks instead of forcing a rebuild).
+        Returns ``None`` when either log no longer reaches back that far.
+        Within one transaction inserts are ordered before deletes, matching
+        the transact path (deletes filter the just-extended row list)."""
+        nid = self.network_id
+        with self._shared.lock:
+            if self._shared.log_floor.get(nid, 0) > watermark:
+                return None
+            if self._shared.del_floor.get(nid, 0) > watermark:
+                return None
+            ins = [
+                (w, 0, ("ins", r))
+                for w, r in self._shared.insert_log.get(nid, ())
+                if w > watermark
+            ]
+            dels = [
+                (w, 1, ("del", k))
+                for w, k in self._shared.delete_log.get(nid, ())
+                if w > watermark
+            ]
+            merged = sorted(ins + dels, key=lambda t: (t[0], t[1]))
+            return [op for _, _, op in merged], self._shared.watermark

@@ -111,7 +111,46 @@ MIGRATIONS: list[tuple[str, str, str]] = [
         "ALTER TABLE keto_watermarks ADD COLUMN delete_wm INTEGER NOT NULL DEFAULT 0",
         "ALTER TABLE keto_watermarks DROP COLUMN delete_wm",
     ),
+    (
+        # delete log: the commit_time-ordered record of *effective* delete
+        # keys, read by ``changes_since`` so the device engine can apply
+        # deletes as tombstone overlays (keto_tpu/graph/overlay.py) instead
+        # of rebuilding. Bounded: del_log_floor rises as old entries prune;
+        # deltas reaching below the floor fall back to a rebuild.
+        "20210623000006_delete_log",
+        """
+        CREATE TABLE keto_tuple_delete_log (
+            nid TEXT NOT NULL,
+            namespace_id INTEGER NOT NULL,
+            object TEXT NOT NULL,
+            relation TEXT NOT NULL,
+            subject_id TEXT NULL,
+            subject_set_namespace_id INTEGER NULL,
+            subject_set_object TEXT NULL,
+            subject_set_relation TEXT NULL,
+            commit_time INTEGER NOT NULL
+        )
+        """,
+        "DROP TABLE keto_tuple_delete_log",
+    ),
+    (
+        "20210623000007_delete_log_idx_floor",
+        """
+        CREATE INDEX keto_tuple_delete_log_idx
+        ON keto_tuple_delete_log (nid, commit_time)
+        """,
+        "DROP INDEX keto_tuple_delete_log_idx",
+    ),
+    (
+        "20210623000008_delete_log_floor",
+        "ALTER TABLE keto_watermarks ADD COLUMN del_log_floor INTEGER NOT NULL DEFAULT 0",
+        "ALTER TABLE keto_watermarks DROP COLUMN del_log_floor",
+    ),
 ]
+
+#: delete-log retention window in watermark units; older entries prune and
+#: the floor rises (matching the in-memory store's bounded logs)
+_DELETE_LOG_KEEP = 8192
 
 _ORDER = (
     "ORDER BY namespace_id, object, relation, subject_id, "
@@ -341,6 +380,7 @@ class SQLitePersister(Manager):
                             for i, values in enumerate(ins_rows)
                         ],
                     )
+                effective_dels: list[tuple] = []
                 if del_rows:
                     null_safe = " AND ".join(
                         f"{col} IS ?" for col in (
@@ -350,12 +390,21 @@ class SQLitePersister(Manager):
                             "subject_set_relation",
                         )
                     )
-                    cur = self._conn.executemany(
-                        "DELETE FROM keto_relation_tuples WHERE nid = ? AND namespace_id = ? "
-                        "AND object = ? AND relation = ? AND " + null_safe,
-                        [(self.network_id,) + values for values in del_rows],
-                    )
-                    changed = changed or cur.rowcount > 0
+                    # per-key deletes (like the reference's per-tuple loop,
+                    # relationtuples.go:178-201) so only keys that actually
+                    # removed rows enter the delete log — a logged no-op
+                    # under an unbumped watermark would leak into a later
+                    # delta read
+                    for values in dict.fromkeys(del_rows):
+                        cur = self._conn.execute(
+                            "DELETE FROM keto_relation_tuples WHERE nid = ? "
+                            "AND namespace_id = ? AND object = ? AND relation = ? "
+                            "AND " + null_safe,
+                            (self.network_id,) + values,
+                        )
+                        if cur.rowcount > 0:
+                            effective_dels.append(values)
+                    changed = changed or bool(effective_dels)
                 if changed:
                     # bump only when the data actually moved, so the device
                     # snapshot is not rebuilt for no-op transactions
@@ -364,12 +413,34 @@ class SQLitePersister(Manager):
                         "ON CONFLICT(nid) DO UPDATE SET watermark = watermark + 1",
                         (self.network_id,),
                     )
-                    if del_rows:
+                    if effective_dels:
                         self._conn.execute(
                             "UPDATE keto_watermarks SET delete_wm = watermark "
                             "WHERE nid = ?",
                             (self.network_id,),
                         )
+                        self._conn.executemany(
+                            "INSERT INTO keto_tuple_delete_log (nid, namespace_id, "
+                            "object, relation, subject_id, subject_set_namespace_id, "
+                            "subject_set_object, subject_set_relation, commit_time) "
+                            "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                            [
+                                (self.network_id,) + values + (commit_time,)
+                                for values in effective_dels
+                            ],
+                        )
+                        floor = commit_time - _DELETE_LOG_KEEP
+                        if floor > 0:
+                            self._conn.execute(
+                                "DELETE FROM keto_tuple_delete_log "
+                                "WHERE nid = ? AND commit_time <= ?",
+                                (self.network_id, floor),
+                            )
+                            self._conn.execute(
+                                "UPDATE keto_watermarks SET del_log_floor = ? "
+                                "WHERE nid = ?",
+                                (floor, self.network_id),
+                            )
                 self._conn.execute("COMMIT")
             except Exception:
                 self._conn.execute("ROLLBACK")
@@ -465,6 +536,46 @@ class SQLitePersister(Manager):
                 (self.network_id, watermark),
             ).fetchall()
         return [InternalRow(*r[:7], seq=r[7]) for r in rows], wm
+
+    def changes_since(self, watermark: int):
+        """Ordered mutations after ``watermark`` as ``(ops, new_watermark)``
+        with ops ``("ins", InternalRow) | ("del", key7)`` — the
+        tombstone-capable delta seam (see MemoryPersister.changes_since).
+        ``None`` when the delete log no longer reaches back that far.
+        Surviving rows' commit_time doubles as the insert log; within one
+        commit_time inserts order before deletes (the transact path deletes
+        after inserting, so a tuple inserted+deleted in one transaction
+        nets to deleted)."""
+        with self._lock:
+            meta = self._conn.execute(
+                "SELECT watermark, del_log_floor FROM keto_watermarks WHERE nid = ?",
+                (self.network_id,),
+            ).fetchone()
+            if meta is None:
+                return [], 0
+            wm, floor = meta
+            if floor > watermark:
+                return None
+            ins = self._conn.execute(
+                "SELECT namespace_id, object, relation, subject_id, "
+                "subject_set_namespace_id, subject_set_object, subject_set_relation, "
+                "commit_time FROM keto_relation_tuples "
+                "WHERE nid = ? AND commit_time > ?",
+                (self.network_id, watermark),
+            ).fetchall()
+            dels = self._conn.execute(
+                "SELECT namespace_id, object, relation, subject_id, "
+                "subject_set_namespace_id, subject_set_object, subject_set_relation, "
+                "commit_time FROM keto_tuple_delete_log "
+                "WHERE nid = ? AND commit_time > ?",
+                (self.network_id, watermark),
+            ).fetchall()
+        merged = sorted(
+            [(r[7], 0, ("ins", InternalRow(*r[:7], seq=r[7]))) for r in ins]
+            + [(r[7], 1, ("del", tuple(r[:7]))) for r in dels],
+            key=lambda t: (t[0], t[1]),
+        )
+        return [op for _, _, op in merged], wm
 
 
 #: import alias

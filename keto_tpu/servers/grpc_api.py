@@ -85,12 +85,22 @@ class CheckService:
 
     def Check(self, request, context):
         tuple_ = tuple_from_proto(request)
-        allowed = self.registry.check_batcher().check(tuple_)
-        engine = self.registry.permission_engine()
-        snaptoken = ""
-        if hasattr(engine, "snapshot"):
-            snaptoken = str(engine.snapshot().snapshot_id)
-        return check_service_pb2.CheckResponse(allowed=allowed, snaptoken=snaptoken)
+        at_least = None
+        if request.snaptoken:
+            # snaptokens are the snapshot ids this server minted (the
+            # store watermark) — anything else is a caller bug
+            try:
+                at_least = int(request.snaptoken)
+            except ValueError:
+                raise ErrBadRequest(
+                    f"malformed snaptoken {request.snaptoken!r}"
+                ) from None
+        allowed, token = self.registry.check_batcher().check_with_token(
+            tuple_, at_least=at_least, latest=request.latest
+        )
+        return check_service_pb2.CheckResponse(
+            allowed=allowed, snaptoken="" if token is None else str(token)
+        )
 
     def register(self, server):
         server.add_generic_rpc_handlers(

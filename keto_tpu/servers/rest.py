@@ -76,7 +76,7 @@ class RestApp:
                 if route == ("GET", "/check"):
                     return self._get_check(query)
                 if route == ("POST", "/check"):
-                    return self._post_check(body)
+                    return self._post_check(body, query)
                 if route == ("GET", "/expand"):
                     return self._get_expand(query)
                 if route == ("GET", "/relation-tuples"):
@@ -100,23 +100,38 @@ class RestApp:
 
     # -- read ----------------------------------------------------------------
 
-    def _check(self, tuple_: RelationTuple):
-        allowed = self.registry.check_batcher().check(tuple_)
-        return (200 if allowed else 403), {"allowed": allowed}, {}
+    def _check(self, tuple_: RelationTuple, query):
+        # per-request consistency (the REST face of the gRPC
+        # snaptoken/latest fields): ?snaptoken=<token from a write or a
+        # previous check> serves at-least-that-fresh; ?latest=true forces
+        # read-your-writes; default is the never-stalling serving mode
+        raw_token = (query.get("snaptoken") or [""])[0]
+        at_least = None
+        if raw_token:
+            try:
+                at_least = int(raw_token)
+            except ValueError:
+                raise ErrBadRequest(f"malformed snaptoken {raw_token!r}") from None
+        latest = (query.get("latest") or [""])[0].lower() in ("1", "true")
+        allowed, token = self.registry.check_batcher().check_with_token(
+            tuple_, at_least=at_least, latest=latest
+        )
+        headers = {} if token is None else {"X-Keto-Snaptoken": str(token)}
+        return (200 if allowed else 403), {"allowed": allowed}, headers
 
     def _get_check(self, query):
         try:
             tuple_ = RelationTuple.from_url_query(query)
         except ErrNilSubject:
             raise ErrBadRequest("Subject has to be specified.") from None
-        return self._check(tuple_)
+        return self._check(tuple_, query)
 
-    def _post_check(self, body: bytes):
+    def _post_check(self, body: bytes, query):
         try:
             obj = json.loads(body or b"{}")
         except json.JSONDecodeError as e:
             raise ErrBadRequest(f"Unable to decode JSON payload: {e}") from None
-        return self._check(RelationTuple.from_json(obj))
+        return self._check(RelationTuple.from_json(obj), query)
 
     def _get_expand(self, query):
         # the reference parses max-depth unconditionally — absent/invalid
