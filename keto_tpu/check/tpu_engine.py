@@ -568,14 +568,7 @@ class TpuCheckEngine:
         snap = self._snapshot
         wm = self._store.watermark()
         if snap is not None and snap.snapshot_id == wm:
-            if (
-                snap.has_overlay
-                and self._overlay_born is not None
-                and time.monotonic() - self._overlay_born > self._compact_after_s
-            ):
-                # quiet long enough: fold the overlay into a fresh base
-                # layout off the serving path
-                self._kick_background_refresh(force_full=True)
+            self._maybe_kick_compaction(snap)
             return snap
         if (
             at_least is not None
@@ -614,12 +607,7 @@ class TpuCheckEngine:
             # current — return it directly (NOT via snapshot(): a write
             # landing between the two watermark reads would send that
             # call into an inline rebuild), with the usual compaction kick
-            if (
-                snap.has_overlay
-                and self._overlay_born is not None
-                and time.monotonic() - self._overlay_born > self._compact_after_s
-            ):
-                self._kick_background_refresh(force_full=True)
+            self._maybe_kick_compaction(snap)
             return snap
         if self._lock.acquire(blocking=False):
             try:
@@ -639,6 +627,17 @@ class TpuCheckEngine:
         if mode == "serving":
             return self.snapshot_serving()
         return self.snapshot()
+
+    def _maybe_kick_compaction(self, snap: GraphSnapshot) -> None:
+        """Fold an overlay that has been quiet for compact_after_s into a
+        fresh base layout, off the serving path (one policy, shared by
+        snapshot() and snapshot_serving())."""
+        if (
+            snap.has_overlay
+            and self._overlay_born is not None
+            and time.monotonic() - self._overlay_born > self._compact_after_s
+        ):
+            self._kick_background_refresh(force_full=True)
 
     def _kick_background_refresh(self, force_full: bool = False) -> None:
         """Start (at most one) background thread bringing the snapshot up
@@ -1096,10 +1095,49 @@ class TpuCheckEngine:
         snap = self._snapshot_for(at_least, mode)
         if snap.n_nodes == 0 or snap.n_edges == 0 or not tuples:
             return [False] * len(tuples), snap.snapshot_id
-        results = list(self._dispatch_slices(snap, tuples))
-        out, max_iters, any_truncated = self._collect(results, len(tuples))
-        self._after_batch(max_iters, any_truncated)
+        out, max_iters = self._run_exact(snap, tuples)
+        self._after_batch(max_iters)
         return out.tolist(), snap.snapshot_id
+
+    def _cap_limit(self, snap: GraphSnapshot) -> int:
+        """Iteration count that can NEVER truncate: monotone bitmaps reach
+        the fixpoint in at most one pull per active row (each growing pull
+        sets ≥ 1 new bit in some active row), +1 for the convergence
+        observation."""
+        return snap.num_active + 1
+
+    def _run_exact(
+        self, snap: GraphSnapshot, tuples: Sequence[RelationTuple], it_cap: Optional[int] = None
+    ) -> tuple[np.ndarray, int]:
+        """Dispatch + collect with the EXACTNESS guarantee the reference's
+        visited-set termination gives for free: a truncated kernel (frontier
+        still growing at it_cap) never decides a query. Affected queries
+        re-run with an escalating cap, bounded by ``_cap_limit`` — the
+        final rung cannot truncate, so every decision comes from a true
+        fixpoint."""
+        cap = it_cap or self._it_cap
+        results = list(self._dispatch_slices(snap, tuples, it_cap=cap))
+        out, max_iters, trunc_idx = self._collect(results, len(tuples))
+        if trunc_idx:
+            limit = self._cap_limit(snap)
+            if cap >= limit:
+                # mathematically unreachable; fail loudly rather than
+                # return a possibly-wrong deny
+                raise RuntimeError(
+                    f"BFS truncated at the fixpoint bound (cap={cap}, "
+                    f"active rows={snap.num_active})"
+                )
+            new_cap = min(max(cap * 8, 8), limit)
+            _log.info(
+                "check BFS hit it_cap=%d; re-running %d queries exactly at cap=%d",
+                cap, len(trunc_idx), new_cap,
+            )
+            redo, redo_iters = self._run_exact(
+                snap, [tuples[i] for i in trunc_idx], it_cap=new_cap
+            )
+            out[np.asarray(trunc_idx)] = redo
+            max_iters = max(max_iters, redo_iters)
+        return out, max_iters
 
     def batch_check_stream(
         self,
@@ -1125,13 +1163,20 @@ class TpuCheckEngine:
         depth = depth or self._dispatch_window
         inflight: deque = deque()
         max_iters = 0
-        any_truncated = False
 
         def _land(rec):
-            nonlocal max_iters, any_truncated
-            out, it, tr = self._unpack_slice(*rec)
+            nonlocal max_iters
+            out, it, tr = self._unpack_slice(rec[0], rec[1], rec[2])
             max_iters = max(max_iters, it)
-            any_truncated = any_truncated or tr
+            if tr:
+                # truncated frontier: the slice's decisions are unusable —
+                # re-run these queries exactly (escalating cap ladder)
+                out, redo_iters = self._run_exact(
+                    snap, rec[3], it_cap=min(
+                        max(self._it_cap * 8, 8), self._cap_limit(snap)
+                    )
+                )
+                max_iters = max(max_iters, redo_iters)
             return out
 
         cap = self._slice_cap(snap)
@@ -1153,7 +1198,7 @@ class TpuCheckEngine:
                     yield _land(inflight.popleft())
         while inflight:
             yield _land(inflight.popleft())
-        self._after_batch(max_iters, any_truncated)
+        self._after_batch(max_iters)
 
     def _slice_cap(self, snap: GraphSnapshot) -> int:
         """Queries per device slice: the widest bitmap the workspace budget
@@ -1205,10 +1250,16 @@ class TpuCheckEngine:
             cnt[m_ans] += sp_[t + 1] - sp_[t]
         return cnt
 
-    def _dispatch_slices(self, snap: GraphSnapshot, tuples: Sequence[RelationTuple]):
+    def _dispatch_slices(
+        self,
+        snap: GraphSnapshot,
+        tuples: Sequence[RelationTuple],
+        it_cap: Optional[int] = None,
+    ):
         """Resolve + pack + dispatch ``tuples`` in ``_slice_cap`` query
-        slices, yielding ``[dev_out | None, host_ans, nq]`` records as each
-        slice is enqueued (the device chews on earlier slices meanwhile).
+        slices, yielding ``[dev_out | None, host_ans, nq, chunk_tuples]``
+        records as each slice is enqueued (the device chews on earlier
+        slices meanwhile; chunk_tuples lets a truncated slice re-run).
 
         A slice whose resolved fan-out exceeds 4·B device entries (wildcard
         patterns, high-out-degree static starts) is sub-chunked so entry
@@ -1238,8 +1289,10 @@ class TpuCheckEngine:
                     i0 = i1
             for a, b in bounds:
                 # sub-chunks keep the slice width: queries pad, geometry stays
-                dev, host_ans = self._device_batch(snap, sd, tg, multi, a, b, W)
-                yield [dev, host_ans, b - a]
+                dev, host_ans = self._device_batch(
+                    snap, sd, tg, multi, a, b, W, it_cap=it_cap
+                )
+                yield [dev, host_ans, b - a, tuples[s0 + a : s0 + b]]
 
     @staticmethod
     def _decode_packed(f: np.ndarray, host_ans: np.ndarray, nq: int):
@@ -1260,8 +1313,11 @@ class TpuCheckEngine:
         return cls._decode_packed(jax.device_get(dev), host_ans, nq)
 
     def _collect(self, results, n: int):
-        """Fetch every dispatched slice in ONE device transfer and unpack."""
-        devs = [d for d, _, _ in results if d is not None]
+        """Fetch every dispatched slice in ONE device transfer and unpack.
+        Returns ``(decisions, max_iters, truncated query indices)`` —
+        queries in a truncated slice carry NO decision the caller may use
+        (``_run_exact`` re-runs them)."""
+        devs = [r[0] for r in results if r[0] is not None]
         flat = None
         if devs:
             cat = jnp.concatenate(devs) if len(devs) > 1 else devs[0]
@@ -1269,10 +1325,10 @@ class TpuCheckEngine:
             flat = jax.device_get(cat)
         out = np.zeros(n, dtype=bool)
         max_iters = 0
-        any_truncated = False
+        trunc_idx: list[int] = []
         pos = 0
         off = 0
-        for dev, host_ans, nq in results:
+        for dev, host_ans, nq, _ in results:
             if dev is None:
                 out[pos : pos + nq] = host_ans[:nq]
             else:
@@ -1283,11 +1339,12 @@ class TpuCheckEngine:
                 off += size
                 out[pos : pos + nq] = bits
                 max_iters = max(max_iters, it)
-                any_truncated = any_truncated or tr
+                if tr:
+                    trunc_idx.extend(range(pos, pos + nq))
             pos += nq
-        return out, max_iters, any_truncated
+        return out, max_iters, trunc_idx
 
-    def _after_batch(self, max_iters: int, any_truncated: bool) -> None:
+    def _after_batch(self, max_iters: int) -> None:
         # adapt the pull-block size so deep workloads converge within few
         # convergence observations. Grow-only: block_iters is a static jit
         # argname, so shrinking it would recompile every kernel geometry for
@@ -1296,14 +1353,6 @@ class TpuCheckEngine:
         want = min(32, _ceil_pow2(max_iters + 1))
         if want > self._block_iters:
             self._block_iters = want
-        if any_truncated:
-            # the reference terminates exactly via its visited set; hitting
-            # the cap means some deny decisions may come from a truncated
-            # frontier — surface it instead of failing silently
-            _log.warning(
-                "check BFS hit it_cap=%d before the fixpoint; deny decisions "
-                "in this batch may be incomplete (raise it_cap)", self._it_cap,
-            )
 
     def _device_batch(
         self,
@@ -1314,6 +1363,7 @@ class TpuCheckEngine:
         i0: int,
         i1: int,
         force_W: Optional[int] = None,
+        it_cap: Optional[int] = None,
     ):
         packed, host_ans = pack_chunk(snap, sd, tg, multi, i0, i1, force_W)
         if packed is None:
@@ -1345,7 +1395,7 @@ class TpuCheckEngine:
             n_active=snap.num_active,
             n_int=snap.num_int,
             valid_rows=tuple(b.n for b in snap.buckets),
-            it_cap=self._it_cap,
+            it_cap=it_cap or self._it_cap,
             block_iters=self._block_iters,
             bitmap_sharding=sharding,
         )

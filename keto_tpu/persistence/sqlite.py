@@ -146,6 +146,18 @@ MIGRATIONS: list[tuple[str, str, str]] = [
         "ALTER TABLE keto_watermarks ADD COLUMN del_log_floor INTEGER NOT NULL DEFAULT 0",
         "ALTER TABLE keto_watermarks DROP COLUMN del_log_floor",
     ),
+    (
+        # commit-time range index: rows_since/changes_since (the delta
+        # seams on the steady-state serving path) are one indexed range
+        # read, not a table scan — commit_time is the LAST column of the
+        # full covering index, unusable for this range
+        "20210623000009_commit_time_idx",
+        """
+        CREATE INDEX keto_relation_tuples_commit_time_idx
+        ON keto_relation_tuples (nid, commit_time)
+        """,
+        "DROP INDEX keto_relation_tuples_commit_time_idx",
+    ),
 ]
 
 #: delete-log retention window in watermark units; older entries prune and

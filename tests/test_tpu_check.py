@@ -317,3 +317,61 @@ def test_stream_matches_batch(make_persister, depth):
     assert len(slices) > 1  # actually exercised slice boundaries
     got = np.concatenate(slices).tolist()
     assert got == want
+
+
+# -- exactness under it_cap ---------------------------------------------------
+
+
+def _deep_chain_store(make_persister, depth=24):
+    """doc#view → c0 → c1 → … → c{depth-1} → user, closed into a CYCLE
+    (c{depth-1} → c0): cycle members have interior in-edges from never-
+    peelable nodes, so the whole chain stays in the iterated device kernel
+    (a plain chain would peel into host propagation and never truncate)."""
+    p = make_persister([("g", 1), ("d", 2)])
+    rows = [T("d", "doc", "view", SubjectSet("g", "c0", "m"))]
+    for i in range(depth - 1):
+        rows.append(T("g", f"c{i}", "m", SubjectSet("g", f"c{i+1}", "m")))
+    rows.append(T("g", f"c{depth-1}", "m", SubjectSet("g", "c0", "m")))
+    rows.append(T("g", f"c{depth-1}", "m", SubjectID("user")))
+    p.write_relation_tuples(*rows)
+    return p
+
+
+def test_it_cap_truncation_rerun_exact(make_persister):
+    """it_cap=1 on a deep chain: the first kernel truncates, but NO decision
+    may come from the truncated frontier — the engine re-runs with an
+    escalating cap and must match the oracle on grants AND denies
+    (the reference is always exact via its visited set)."""
+    p = _deep_chain_store(make_persister)
+    oracle = CheckEngine(p)
+    engine = TpuCheckEngine(p, p.namespaces, it_cap=1)
+    rungs = []
+    orig = engine._run_exact
+    engine._run_exact = lambda s, t, it_cap=None: (
+        rungs.append(it_cap), orig(s, t, it_cap=it_cap)
+    )[1]
+    queries = [
+        T("d", "doc", "view", SubjectID("user")),   # deep grant
+        T("d", "doc", "view", SubjectID("ghost")),  # deep deny
+        T("g", "c0", "m", SubjectID("user")),       # grant, one shorter
+        T("g", "c5", "m", SubjectID("ghost")),      # deny mid-chain
+    ]
+    got = engine.batch_check(queries)
+    want = [oracle.subject_is_allowed(q) for q in queries]
+    assert got == want == [True, False, True, False]
+    assert len(rungs) >= 2, "truncation retry ladder never engaged"
+
+
+def test_it_cap_truncation_rerun_exact_stream(make_persister):
+    p = _deep_chain_store(make_persister)
+    oracle = CheckEngine(p)
+    engine = TpuCheckEngine(p, p.namespaces, it_cap=1)
+    queries = [
+        T("d", "doc", "view", SubjectID("user")),
+        T("d", "doc", "view", SubjectID("ghost")),
+    ] * 5
+    import numpy as np
+
+    got = np.concatenate(list(engine.batch_check_stream(iter(queries)))).tolist()
+    want = [oracle.subject_is_allowed(q) for q in queries]
+    assert got == want
