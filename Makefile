@@ -1,8 +1,10 @@
 # Build/test entry points (the reference drives the same tasks from its
 # Makefile: build tags, codegen, tests — reference Makefile:44-108).
 
+# c++20: the interner's transparent (allocation-free) hash lookups need
+# heterogeneous unordered_map support
 CXX ?= g++
-CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -Wextra
+CXXFLAGS ?= -O3 -std=c++20 -fPIC -Wall -Wextra
 
 .PHONY: all native proto schemas docs test bench clean
 

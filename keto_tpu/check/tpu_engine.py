@@ -681,7 +681,12 @@ class TpuCheckEngine:
                 return None
             t0 = time.monotonic()
             rows, wm = self._store.snapshot_rows()
-            new = build_snapshot(rows, wm, wild_ns_ids, peel_seed_cap=self._peel_seed_cap)
+            cols_fn = getattr(self._store, "snapshot_columns", None)
+            new = build_snapshot(
+                rows, wm, wild_ns_ids,
+                peel_seed_cap=self._peel_seed_cap,
+                columns=cols_fn(wm) if cols_fn is not None else None,
+            )
             self._upload_buckets(new)
             self._last_full_build_s = time.monotonic() - t0
         self._apply_ell_patch(new)

@@ -51,6 +51,24 @@ def load_library() -> Optional[ctypes.CDLL]:
         p = ctypes.c_void_p
         lib.graph_build.restype = p
         lib.graph_build.argtypes = [ctypes.c_char_p, c, ctypes.POINTER(c), c]
+        if hasattr(lib, "graph_build_columnar"):
+            pc = ctypes.POINTER(c)
+            pb = ctypes.c_char_p
+            lib.graph_build_columnar.restype = p
+            lib.graph_build_columnar.argtypes = (
+                [c, pc, ctypes.POINTER(ctypes.c_uint8), pc]
+                + [pb, pc, pc] * 5
+                + [pc, c]
+            )
+        if hasattr(lib, "graph_build_ucs4"):
+            pc = ctypes.POINTER(c)
+            pu = ctypes.POINTER(ctypes.c_uint32)
+            lib.graph_build_ucs4.restype = p
+            lib.graph_build_ucs4.argtypes = (
+                [c, pc, ctypes.POINTER(ctypes.c_uint8), pc]
+                + [pu, c] * 5
+                + [pc, c]
+            )
         lib.graph_free.argtypes = [p]
         for fn in ("graph_num_sets", "graph_num_leaves", "graph_num_edges"):
             getattr(lib, fn).restype = c
@@ -222,13 +240,142 @@ class NativeInterned:
         return self._str_at("graph_leaf_str", idx)
 
 
-def native_intern_rows(rows: Iterable, wild_ns_ids=frozenset()) -> Optional[NativeInterned]:
-    """Native counterpart of ``intern_rows``; None when the lib is absent."""
+def _string_column(strs: list) -> Optional[tuple[bytes, np.ndarray, np.ndarray]]:
+    """(utf-8 blob, byte starts, byte lens) for a string column, built in
+    a handful of vectorized passes (the columnar fast path's whole point:
+    no per-row Python encode). Joins on NUL — multi-byte UTF-8 never
+    contains a 0x00 byte, so separator positions are exactly the zero
+    bytes of the encoded blob. None when a string embeds NUL (nothing
+    legitimate does; the packed-buffer path handles it by falling back to
+    the Python interner)."""
+    n = len(strs)
+    if n == 0:
+        return b"", np.zeros(0, np.int64), np.zeros(0, np.int64)
+    joined = "\x00".join(strs)
+    if joined.count("\x00") != n - 1:
+        return None
+    blob = joined.encode()
+    seps = np.nonzero(np.frombuffer(blob, np.uint8) == 0)[0]
+    starts = np.empty(n, np.int64)
+    starts[0] = 0
+    starts[1:] = seps + 1
+    ends = np.empty(n, np.int64)
+    ends[:-1] = seps
+    ends[-1] = len(blob)
+    return blob, starts, ends - starts
+
+
+def native_intern_rows_columnar(
+    lib, rows: list, wild_ns_ids
+) -> Optional[NativeInterned]:
+    from operator import attrgetter
+
+    n = len(rows)
+    c = ctypes.c_int64
+    # C-speed column extraction: one attrgetter map per column (a Python
+    # per-row loop over six attributes dominated the handoff at 10M rows)
+    ns = np.fromiter(map(attrgetter("namespace_id"), rows), np.int64, n)
+    col_sid = list(map(attrgetter("subject_id"), rows))
+    kind = np.fromiter((s is not None for s in col_sid), np.uint8, n)
+    sns = np.fromiter(
+        (v if v is not None else 0 for v in map(attrgetter("sset_namespace_id"), rows)),
+        np.int64,
+        n,
+    )
+    cols = []
+    for attr, none_ok in (
+        ("object", False), ("relation", False), ("subject_id", True),
+        ("sset_object", True), ("sset_relation", True),
+    ):
+        vals = col_sid if attr == "subject_id" else list(map(attrgetter(attr), rows))
+        if none_ok:
+            # `or ""` maps None→"" and keeps "" as-is — the only falsy str
+            vals = [v or "" for v in vals]
+        col = _string_column(vals)
+        if col is None:
+            return None
+        cols.append(col)
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.POINTER(c))
+
+    wild = np.asarray(sorted(wild_ns_ids), np.int64)
+    args = [n, ptr(ns), kind.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), ptr(sns)]
+    for blob, starts, lens in cols:
+        args += [blob, ptr(starts), ptr(lens)]
+    args += [ptr(wild), len(wild)]
+    handle = lib.graph_build_columnar(*args)
+    if not handle:
+        return None
+    return NativeInterned(lib, handle)
+
+
+def _ucs4_ok(arr: np.ndarray) -> bool:
+    """True when every cell's NUL padding is trailing-only: an embedded
+    NUL code point would truncate in the C++ decoder (NUL is the pad)."""
+    if arr.dtype.itemsize == 0 or arr.size == 0:
+        return True
+    v = arr.view(np.uint32).reshape(arr.shape[0], -1)
+    if v.shape[1] <= 1:
+        return True
+    z = v == 0
+    return not bool(np.any(z[:, :-1] & (v[:, 1:] != 0)))
+
+
+def native_intern_columns(lib, columns: dict, wild_ns_ids) -> Optional[NativeInterned]:
+    """Intern from the store's cached sorted column bundle (numpy '<U*'
+    string arrays + int/kind arrays) — zero per-row Python work; the C++
+    side decodes UCS4 cells straight out of the numpy buffers."""
+    if not hasattr(lib, "graph_build_ucs4"):
+        return None
+    c = ctypes.c_int64
+    n = int(columns["ns"].shape[0])
+    str_cols = []
+    for name in ("obj", "rel", "sid", "sso", "ssr"):
+        arr = np.ascontiguousarray(columns[name])
+        if arr.dtype.kind != "U" or not _ucs4_ok(arr):
+            return None
+        str_cols.append(arr)
+    ns = np.ascontiguousarray(columns["ns"], np.int64)
+    kind = np.ascontiguousarray(columns["kind"], np.uint8)
+    sns = np.ascontiguousarray(columns["sns"], np.int64)
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.POINTER(c))
+
+    wild = np.asarray(sorted(wild_ns_ids), np.int64)
+    args = [n, ptr(ns), kind.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), ptr(sns)]
+    for arr in str_cols:
+        args += [
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            arr.dtype.itemsize // 4,
+        ]
+    args += [ptr(wild), len(wild)]
+    handle = lib.graph_build_ucs4(*args)
+    if not handle:
+        return None
+    return NativeInterned(lib, handle)
+
+
+def native_intern_rows(
+    rows: Iterable, wild_ns_ids=frozenset(), columns: Optional[dict] = None
+) -> Optional[NativeInterned]:
+    """Native counterpart of ``intern_rows``; None when the lib is absent.
+    ``columns`` is an optional pre-extracted column bundle (the store's
+    bulk-ingest cache) that skips row iteration entirely."""
     lib = load_library()
     if lib is None:
         return None
+    if columns is not None:
+        got = native_intern_columns(lib, columns, wild_ns_ids)
+        if got is not None:
+            return got
     if not isinstance(rows, list):
         rows = list(rows)
+    if rows and hasattr(lib, "graph_build_columnar") and hasattr(rows[0], "namespace_id"):
+        got = native_intern_rows_columnar(lib, rows, wild_ns_ids)
+        if got is not None:
+            return got
     buf = pack_rows(rows)
     # strings containing the separator control bytes would corrupt the
     # framing — detectable as a field-count mismatch; fall back to Python

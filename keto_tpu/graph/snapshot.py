@@ -448,18 +448,21 @@ def build_snapshot(
     watermark: int,
     wild_ns_ids: FrozenSet[int] = frozenset(),
     peel_seed_cap: float = 4.0,
+    columns: Optional[dict] = None,
 ) -> GraphSnapshot:
     """Intern rows and lay out the bucketed reverse-ELL adjacency.
 
     ``wild_ns_ids``: ids of configured namespaces whose *name* is the empty
     string — their set nodes expand with a wildcarded namespace. Interning
     runs in the native C++ path when ``native/libketoingest.so`` is built
-    (``make native``), else in Python.
+    (``make native``), else in Python. ``columns`` is the store's optional
+    sorted column bundle (MemoryPersister.snapshot_columns) — the
+    zero-extraction interning input.
     """
     rows = list(rows)
     from keto_tpu.graph.native import native_intern_rows
 
-    g = native_intern_rows(rows, wild_ns_ids)
+    g = native_intern_rows(rows, wild_ns_ids, columns=columns)
     if g is None:
         g = intern_rows(rows, wild_ns_ids)
     src_raw, dst_raw = g.src, g.dst

@@ -24,10 +24,12 @@ The store keeps columnar-friendly internal rows so the TPU snapshot builder
 from __future__ import annotations
 
 import bisect
+import heapq
 import itertools
 import threading
-from dataclasses import dataclass
 from typing import Optional, Sequence
+
+import numpy as np
 
 from keto_tpu import namespace as namespace_pkg
 from keto_tpu.relationtuple.manager import Manager
@@ -40,29 +42,69 @@ from keto_tpu.x.pagination import (
 )
 
 
-@dataclass(frozen=True)
 class InternalRow:
-    """One stored tuple with interned namespace IDs."""
+    """One stored tuple with interned namespace IDs.
 
-    namespace_id: int
-    object: str
-    relation: str
-    subject_id: Optional[str]  # exactly one of subject_id / subject_set_* is set
-    sset_namespace_id: Optional[int]
-    sset_object: Optional[str]
-    sset_relation: Optional[str]
-    seq: int  # commit order (the reference's commit_time)
+    A hand-written slotted class, not a dataclass: bulk loads construct
+    tens of millions of these (BASELINE configs 4-5), and the frozen-
+    dataclass ``object.__setattr__``-per-field init was the single
+    hottest line of store ingest. Treat instances as immutable.
+    """
+
+    __slots__ = (
+        "namespace_id", "object", "relation", "subject_id",
+        "sset_namespace_id", "sset_object", "sset_relation", "seq", "_packed",
+    )
+
+    def __init__(
+        self,
+        namespace_id: int,
+        object: str,  # noqa: A002 - field name mirrors the SQL column
+        relation: str,
+        subject_id: Optional[str],  # exactly one of subject_id / sset_* is set
+        sset_namespace_id: Optional[int],
+        sset_object: Optional[str],
+        sset_relation: Optional[str],
+        seq: int,  # commit order (the reference's commit_time)
+    ):
+        self.namespace_id = namespace_id
+        self.object = object
+        self.relation = relation
+        self.subject_id = subject_id
+        self.sset_namespace_id = sset_namespace_id
+        self.sset_object = sset_object
+        self.sset_relation = sset_relation
+        self.seq = seq
+        self._packed: Optional[bytes] = None
+
+    def __repr__(self) -> str:
+        return (
+            f"InternalRow(namespace_id={self.namespace_id!r}, object={self.object!r}, "
+            f"relation={self.relation!r}, subject_id={self.subject_id!r}, "
+            f"sset_namespace_id={self.sset_namespace_id!r}, "
+            f"sset_object={self.sset_object!r}, sset_relation={self.sset_relation!r}, "
+            f"seq={self.seq!r})"
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, InternalRow)
+            and self.key7() == other.key7()
+            and self.seq == other.seq
+        )
+
+    def __hash__(self) -> int:
+        return hash(self.key7() + (self.seq,))
 
     def packed(self) -> bytes:
         """The native interner's record encoding, cached on first use so
         snapshot rebuilds pay serialization once per row lifetime
         (keto_tpu/graph/native.py documents the format)."""
-        cached = self.__dict__.get("_packed")
+        cached = self._packed
         if cached is None:
             from keto_tpu.graph.native import encode_row
 
-            cached = encode_row(self)
-            object.__setattr__(self, "_packed", cached)
+            cached = self._packed = encode_row(self)
         return cached
 
     def key7(self):
@@ -123,6 +165,11 @@ class _SharedState:
         # key7) per delete key, per network, bounded like the insert log
         self.delete_log: dict[str, list[tuple[int, tuple]]] = {}
         self.del_floor: dict[str, int] = {}
+        # sorted column-array bundle from a bulk load into an empty store,
+        # keyed by the watermark it is valid at — the snapshot builder's
+        # zero-copy interning input (keto_tpu/graph/native.py
+        # native_intern_columns). Any later mutation invalidates it.
+        self.col_cache: dict[str, tuple[int, dict]] = {}
 
 
 class MemoryPersister(Manager):
@@ -169,6 +216,120 @@ class MemoryPersister(Manager):
         return InternalRow(
             ns.id, rt.object, rt.relation, None, sns.id, rt.subject.object, rt.subject.relation, next(self._shared.seq)
         )
+
+    #: longest string a bulk-ingest numpy column will hold: fixed-width
+    #: U-dtype cells mean ONE outlier string inflates the whole column
+    #: (n · maxlen · 4 bytes), so longer strings route to the row path
+    _BULK_MAX_STR = 256
+
+    def _bulk_ingest(
+        self, tuples_seq: Sequence[RelationTuple]
+    ) -> Optional[tuple[list[InternalRow], dict]]:
+        """Bulk tuples → sorted rows + sorted column bundle, in ONE column
+        pass. The store's ORDER BY runs as a numpy lexsort over column
+        arrays — list.sort(key=sort_key) materializes a nested key tuple
+        per row, which dominated bulk ingest at BASELINE scale — and row
+        objects are constructed directly in sorted order (no second
+        permutation pass). NULL-first semantics ride on (presence, value)
+        column pairs exactly like sort_key's ``(0, "") if x is None else
+        (1, x)``; unicode comparison of numpy U-dtype arrays matches
+        Python str ordering; the arange tie-break = arrival order = seq
+        order, so the result is identical to the key-based sort.
+
+        The returned bundle (sorted numpy columns) is the snapshot
+        builder's zero-extraction interning input
+        (keto_tpu/graph/native.py native_intern_columns).
+
+        Returns ``None`` when the batch is unsafe for fixed-width numpy
+        columns — a string with a TRAILING NUL (numpy U-dtype strips
+        trailing NUL code points on read-back, silently collapsing
+        ``"a\\x00"`` onto ``"a"``) or longer than ``_BULK_MAX_STR`` (one
+        outlier would inflate every cell of its column). The caller falls
+        back to the per-row path, which handles both exactly."""
+        nm = self._nm()
+        ns_cache: dict = {}
+
+        def ns_id(name: str) -> int:
+            i = ns_cache.get(name)
+            if i is None:
+                i = nm.get_namespace_by_name(name).id
+                ns_cache[name] = i
+            return i
+
+        n = len(tuples_seq)
+        c_ns: list[int] = []
+        c_obj: list[str] = []
+        c_rel: list[str] = []
+        c_kind: list[bool] = []
+        c_sid: list[str] = []
+        c_sns: list[int] = []
+        c_sso: list[str] = []
+        c_ssr: list[str] = []
+        for rt in tuples_seq:
+            sub = rt.subject
+            if sub is None:
+                raise ErrNilSubject()
+            c_ns.append(ns_id(rt.namespace))
+            c_obj.append(rt.object)
+            c_rel.append(rt.relation)
+            if isinstance(sub, SubjectID):
+                c_kind.append(True)
+                c_sid.append(sub.id)
+                c_sns.append(0)
+                c_sso.append("")
+                c_ssr.append("")
+            else:
+                c_kind.append(False)
+                c_sid.append("")
+                c_sns.append(ns_id(sub.namespace))
+                c_sso.append(sub.object)
+                c_ssr.append(sub.relation)
+
+        cap = self._BULK_MAX_STR
+        for col in (c_obj, c_rel, c_sid, c_sso, c_ssr):
+            if max(map(len, col), default=0) > cap or any(
+                s.endswith("\x00") for s in col
+            ):
+                return None
+        a_ns = np.asarray(c_ns, np.int64)
+        a_obj = np.array(c_obj)
+        a_rel = np.array(c_rel)
+        sid_p = np.asarray(c_kind, bool)
+        sid_v = np.array(c_sid)
+        sns_v = np.asarray(c_sns, np.int64)
+        sso_v = np.array(c_sso)
+        ssr_v = np.array(c_ssr)
+        # exactly-one-of means ~sid_p doubles as the sns/sso/ssr presence
+        # flag (NULL-first: subject-set rows sort before subject-id rows)
+        perm = np.lexsort((
+            np.arange(n),
+            ssr_v, sso_v, sns_v, ~sid_p,
+            sid_v, sid_p,
+            a_rel, a_obj, a_ns,
+        ))
+        bundle = {
+            "ns": a_ns[perm],
+            "kind": sid_p[perm].view(np.uint8),
+            "sns": sns_v[perm],
+            "obj": a_obj[perm],
+            "rel": a_rel[perm],
+            "sid": sid_v[perm],
+            "sso": sso_v[perm],
+            "ssr": ssr_v[perm],
+        }
+        seqs = list(itertools.islice(self._shared.seq, n))
+        rows: list[Optional[InternalRow]] = [None] * n
+        for out_i, i in enumerate(perm.tolist()):
+            if c_kind[i]:
+                rows[out_i] = InternalRow(
+                    c_ns[i], c_obj[i], c_rel[i], c_sid[i], None, None, None, seqs[i]
+                )
+            else:
+                rows[out_i] = InternalRow(
+                    c_ns[i], c_obj[i], c_rel[i], None, c_sns[i], c_sso[i],
+                    c_ssr[i], seqs[i],
+                )
+        return rows, bundle
 
     def _to_tuple(self, row: InternalRow) -> RelationTuple:
         nm = self._nm()
@@ -268,15 +429,41 @@ class MemoryPersister(Manager):
         mutation, so a failing insert/delete leaves the store untouched
         (rollback semantics of reference relationtuples.go:271-278)."""
         with self._shared.lock:
-            new_rows = [self._to_row(rt) for rt in insert]
+            new_sorted: Optional[list[InternalRow]] = None
+            bundle = None
+            if len(insert) >= 4096:
+                # bulk load: one column pass + numpy lexsort, rows emerge
+                # already in ORDER BY (per-row sort keys walled at tens of
+                # millions of rows), plus the interner's column bundle.
+                # None = batch unsafe for numpy columns → row path below.
+                got = self._bulk_ingest(insert)
+                if got is not None:
+                    new_sorted, bundle = got
+            if new_sorted is not None:
+                new_rows: Sequence[InternalRow] = new_sorted
+            else:
+                new_rows = [self._to_row(rt) for rt in insert]
+                if len(new_rows) > 256:
+                    new_sorted = sorted(new_rows, key=InternalRow.sort_key)
             delete_keys = []
             for rt in delete:
                 delete_keys.append(self._to_row(rt).key7())
             rows = self._rows()
-            if len(new_rows) > 256:
-                # bulk load: one sort beats per-row insort's O(n) memmoves
-                rows.extend(new_rows)
-                rows.sort(key=InternalRow.sort_key)
+            # any mutation invalidates the bulk-load column cache; a clean
+            # bulk load into an empty store refreshes it below
+            self._shared.col_cache.pop(self.network_id, None)
+            col_bundle = None
+            if bundle is not None and not rows and not delete:
+                col_bundle = bundle
+            if new_sorted is not None:
+                if rows:
+                    # linear merge keeps the store sorted without re-sorting
+                    rows = list(
+                        heapq.merge(rows, new_sorted, key=InternalRow.sort_key)
+                    )
+                    self._shared.rows[self.network_id] = rows
+                else:
+                    rows.extend(new_sorted)
             else:
                 for r in new_rows:
                     bisect.insort(rows, r, key=InternalRow.sort_key)
@@ -318,6 +505,8 @@ class MemoryPersister(Manager):
             self._shared.watermark += 1
             wm = self._shared.watermark
             nid = self.network_id
+            if col_bundle is not None:
+                self._shared.col_cache[nid] = (wm, col_bundle)
             if hit_keys:
                 # only EFFECTIVE deletes (matched ≥ 1 row) are recorded —
                 # same contract as the sqlite store, and what apply_delta's
@@ -361,6 +550,16 @@ class MemoryPersister(Manager):
         """Consistent (rows, watermark) view for the TPU graph builder."""
         with self._shared.lock:
             return list(self._rows()), self._shared.watermark
+
+    def snapshot_columns(self, watermark: int) -> Optional[dict]:
+        """The bulk-load column bundle valid at ``watermark``, or None —
+        the zero-copy interning input for full snapshot builds right
+        after a bulk load (keto_tpu/graph/native.py)."""
+        with self._shared.lock:
+            got = self._shared.col_cache.get(self.network_id)
+            if got is not None and got[0] == watermark:
+                return got[1]
+            return None
 
     def rows_since(self, watermark: int):
         """Rows inserted after ``watermark`` as ``(rows, new_watermark)``,

@@ -4,15 +4,24 @@
 // millions of tuple rows into int32 node ids (keto_tpu/graph/interner.py
 // documents the node/edge model and wildcard-expansion semantics; this file
 // implements the same contract behind a C ABI). The Python fallback walks
-// rows in a Python loop; this implementation parses a packed byte buffer in
-// one pass and keeps the intern tables resident so query resolution
-// (set-node and leaf lookups) stays native too.
+// rows in a Python loop; this implementation consumes either
 //
-// Input buffer format, one record per tuple row, fields separated by 0x1F
-// (unit separator), records by 0x1E (record separator):
-//   ns_id '\x1f' object '\x1f' relation '\x1f' kind '\x1f' f0 '\x1f' f1 '\x1f' f2 '\x1e'
-// where kind is "0" (subject set: f0=ns_id, f1=object, f2=relation) or
-// "1" (subject id: f0=id, f1=f2 empty). ns_id is decimal ASCII.
+//  - **columnar arrays** (graph_build_columnar): five string columns as
+//    (blob, starts, lens) triples plus int/kind arrays, produced by
+//    keto_tpu/graph/native.py in a handful of vectorized numpy passes —
+//    the fast path: zero per-row Python work; or
+//  - a **packed byte buffer** (graph_build), one 0x1F/0x1E-separated record
+//    per row:
+//      ns_id '\x1f' object '\x1f' relation '\x1f' kind '\x1f' f0 '\x1f' f1 '\x1f' f2 '\x1e'
+//    where kind is "0" (subject set: f0=ns_id, f1=object, f2=relation) or
+//    "1" (subject id: f0=id, f1=f2 empty); ns_id is decimal ASCII. Kept for
+//    odd encodings the columnar packer rejects and for resolve_queries.
+//
+// Interning internals: object/relation strings intern to dense codes via
+// transparent (string_view, no per-lookup allocation) hash maps; a set node
+// key is then the integer triple (ns, obj_code, rel_code) in an int-keyed
+// map — node-id assignment order is identical to interner.py (ids in first-
+// occurrence order, field codes interned at node creation then per tuple).
 //
 // Exported functions use plain C types; ownership of the Graph handle stays
 // with the caller (graph_free).
@@ -27,31 +36,40 @@
 
 namespace {
 
-struct SetKey {
-    int64_t ns;
-    std::string obj;
-    std::string rel;
-    bool operator==(const SetKey& o) const {
+struct SvHash {
+    using is_transparent = void;
+    size_t operator()(std::string_view s) const { return std::hash<std::string_view>()(s); }
+    size_t operator()(const std::string& s) const { return std::hash<std::string_view>()(s); }
+};
+struct SvEq {
+    using is_transparent = void;
+    bool operator()(std::string_view a, std::string_view b) const { return a == b; }
+};
+
+using StrTable = std::unordered_map<std::string, int64_t, SvHash, SvEq>;
+
+struct TripleKey {
+    int64_t ns, obj, rel;
+    bool operator==(const TripleKey& o) const {
         return ns == o.ns && obj == o.obj && rel == o.rel;
     }
 };
-
-struct SetKeyHash {
-    size_t operator()(const SetKey& k) const {
-        size_t h = std::hash<int64_t>()(k.ns);
-        h ^= std::hash<std::string>()(k.obj) + 0x9e3779b97f4a7c15ULL + (h << 6) + (h >> 2);
-        h ^= std::hash<std::string>()(k.rel) + 0x9e3779b97f4a7c15ULL + (h << 6) + (h >> 2);
-        return h;
+struct TripleHash {
+    size_t operator()(const TripleKey& k) const {
+        uint64_t h = (uint64_t)k.ns * 0x9e3779b97f4a7c15ULL;
+        h ^= (uint64_t)k.obj + 0x9e3779b97f4a7c15ULL + (h << 6) + (h >> 2);
+        h ^= (uint64_t)k.rel + 0x9e3779b97f4a7c15ULL + (h << 6) + (h >> 2);
+        return (size_t)h;
     }
 };
 
 struct Graph {
-    std::unordered_map<SetKey, int64_t, SetKeyHash> set_ids;
-    std::unordered_map<std::string, int64_t> leaf_ids;
-    std::unordered_map<std::string, int64_t> obj_codes;
-    std::unordered_map<std::string, int64_t> rel_codes;
-    // reverse tables for expand-tree reconstruction: pointers into the
-    // node-based unordered_maps above (stable for the Graph's lifetime)
+    std::unordered_map<TripleKey, int64_t, TripleHash> set_ids;
+    StrTable leaf_ids;
+    StrTable obj_codes;
+    StrTable rel_codes;
+    // reverse tables: pointers into the node-based maps above (stable for
+    // the Graph's lifetime)
     std::vector<const std::string*> leaf_by_id, obj_by_code, rel_by_code;
     // per set node, aligned with set id
     std::vector<int64_t> key_ns, key_obj, key_rel;
@@ -64,9 +82,9 @@ struct Graph {
     std::vector<int64_t> wild_ns_ids;
 };
 
-int64_t intern_code(std::unordered_map<std::string, int64_t>& table, std::string_view s,
+int64_t intern_code(StrTable& table, std::string_view s,
                     std::vector<const std::string*>& by_code) {
-    auto it = table.find(std::string(s));
+    auto it = table.find(s);
     if (it != table.end()) return it->second;
     int64_t code = (int64_t)table.size();
     auto ins = table.emplace(std::string(s), code);
@@ -74,22 +92,31 @@ int64_t intern_code(std::unordered_map<std::string, int64_t>& table, std::string
     return code;
 }
 
-int64_t set_node(Graph& g, int64_t ns, std::string_view obj, std::string_view rel,
-                 bool ns_wild) {
-    SetKey key{ns, std::string(obj), std::string(rel)};
+int64_t set_node_coded(Graph& g, int64_t ns, int64_t oc, int64_t rc, bool any_empty,
+                       bool ns_wild) {
+    TripleKey key{ns, oc, rc};
     auto it = g.set_ids.find(key);
     if (it != g.set_ids.end()) return it->second;
     int64_t id = (int64_t)g.set_ids.size();
-    g.set_ids.emplace(std::move(key), id);
+    g.set_ids.emplace(key, id);
     g.key_ns.push_back(ns);
-    g.key_obj.push_back(intern_code(g.obj_codes, obj, g.obj_by_code));
-    g.key_rel.push_back(intern_code(g.rel_codes, rel, g.rel_by_code));
-    g.wild.push_back(ns_wild || obj.empty() || rel.empty());
+    g.key_obj.push_back(oc);
+    g.key_rel.push_back(rc);
+    g.wild.push_back(ns_wild || any_empty);
     return id;
 }
 
+int64_t set_node(Graph& g, int64_t ns, std::string_view obj, std::string_view rel,
+                 bool ns_wild) {
+    // intern field codes first (matches interner.py set_node: codes are
+    // interned at node creation), then key on the integer triple
+    int64_t oc = intern_code(g.obj_codes, obj, g.obj_by_code);
+    int64_t rc = intern_code(g.rel_codes, rel, g.rel_by_code);
+    return set_node_coded(g, ns, oc, rc, obj.empty() || rel.empty(), ns_wild);
+}
+
 int64_t leaf_node(Graph& g, std::string_view s) {
-    auto it = g.leaf_ids.find(std::string(s));
+    auto it = g.leaf_ids.find(s);
     if (it != g.leaf_ids.end()) return it->second;
     int64_t id = (int64_t)g.leaf_ids.size();
     auto ins = g.leaf_ids.emplace(std::string(s), id);
@@ -103,64 +130,31 @@ bool is_wild_ns(const Graph& g, int64_t ns) {
     return false;
 }
 
-}  // namespace
-
-extern "C" {
-
-// Parse the packed row buffer; returns a Graph handle or nullptr on a
-// malformed buffer.
-Graph* graph_build(const char* buf, int64_t len, const int64_t* wild_ns_ids,
-                   int64_t n_wild_ns) {
-    Graph* g = new Graph();
-    g->wild_ns_ids.assign(wild_ns_ids, wild_ns_ids + n_wild_ns);
-
-    const char* p = buf;
-    const char* end = buf + len;
-    std::string_view fields[7];
-    while (p < end) {
-        // split one record into 7 fields
-        int f = 0;
-        const char* field_start = p;
-        while (p < end && f < 7) {
-            if (*p == '\x1f' || *p == '\x1e') {
-                fields[f++] = std::string_view(field_start, (size_t)(p - field_start));
-                bool rec_end = (*p == '\x1e');
-                ++p;
-                field_start = p;
-                if (rec_end) break;
-            } else {
-                ++p;
-            }
-        }
-        if (f != 7) {
-            delete g;
-            return nullptr;
-        }
-        int64_t ns = 0;
-        for (char c : fields[0]) {
-            if (c < '0' || c > '9') { delete g; return nullptr; }
-            ns = ns * 10 + (c - '0');
-        }
-        int64_t lhs = set_node(*g, ns, fields[1], fields[2], is_wild_ns(*g, ns));
-        g->t_lhs.push_back(lhs);
-        g->t_ns.push_back(ns);
-        g->t_obj.push_back(intern_code(g->obj_codes, fields[1], g->obj_by_code));
-        g->t_rel.push_back(intern_code(g->rel_codes, fields[2], g->rel_by_code));
-        if (fields[3] == "1") {
-            g->t_sub_kind.push_back(1);
-            g->t_sub_idx.push_back(leaf_node(*g, fields[4]));
-        } else {
-            int64_t sns = 0;
-            for (char c : fields[4]) {
-                if (c < '0' || c > '9') { delete g; return nullptr; }
-                sns = sns * 10 + (c - '0');
-            }
-            g->t_sub_kind.push_back(0);
-            g->t_sub_idx.push_back(
-                set_node(*g, sns, fields[5], fields[6], is_wild_ns(*g, sns)));
-        }
+inline void add_row(Graph& g, int64_t ns, std::string_view obj, std::string_view rel,
+                    bool sub_is_leaf, std::string_view sid, int64_t sns,
+                    std::string_view sso, std::string_view ssr) {
+    // intern each LHS field once and reuse the code for both the node key
+    // and the per-tuple arrays (the extra per-field lookup was ~25% of the
+    // interning pass at 10M rows)
+    int64_t oc = intern_code(g.obj_codes, obj, g.obj_by_code);
+    int64_t rc = intern_code(g.rel_codes, rel, g.rel_by_code);
+    int64_t lhs = set_node_coded(g, ns, oc, rc, obj.empty() || rel.empty(),
+                                 is_wild_ns(g, ns));
+    g.t_lhs.push_back(lhs);
+    g.t_ns.push_back(ns);
+    g.t_obj.push_back(oc);
+    g.t_rel.push_back(rc);
+    if (sub_is_leaf) {
+        g.t_sub_kind.push_back(1);
+        g.t_sub_idx.push_back(leaf_node(g, sid));
+    } else {
+        g.t_sub_kind.push_back(0);
+        g.t_sub_idx.push_back(set_node(g, sns, sso, ssr, is_wild_ns(g, sns)));
     }
+}
 
+// edges + dedup + temporary teardown, shared by both build entry points
+void finish_edges(Graph* g) {
     // edges: literal LHS nodes take their own tuples; wildcard-bearing set
     // nodes take every matching tuple's subject (see interner.py pass 2)
     const int64_t num_sets = (int64_t)g->set_ids.size();
@@ -168,6 +162,8 @@ Graph* graph_build(const char* buf, int64_t len, const int64_t* wild_ns_ids,
     auto sub_raw = [&](size_t i) {
         return g->t_sub_kind[i] ? g->t_sub_idx[i] + num_sets : g->t_sub_idx[i];
     };
+    g->src.reserve(nt);
+    g->dst.reserve(nt);
     for (size_t i = 0; i < nt; ++i) {
         if (!g->wild[(size_t)g->t_lhs[i]]) {
             g->src.push_back(g->t_lhs[i]);
@@ -176,9 +172,9 @@ Graph* graph_build(const char* buf, int64_t len, const int64_t* wild_ns_ids,
     }
     int64_t empty_obj = -1, empty_rel = -1;
     {
-        auto it = g->obj_codes.find("");
+        auto it = g->obj_codes.find(std::string_view(""));
         if (it != g->obj_codes.end()) empty_obj = it->second;
-        it = g->rel_codes.find("");
+        it = g->rel_codes.find(std::string_view(""));
         if (it != g->rel_codes.end()) empty_rel = it->second;
     }
     for (int64_t s = 0; s < num_sets; ++s) {
@@ -230,6 +226,168 @@ Graph* graph_build(const char* buf, int64_t len, const int64_t* wild_ns_ids,
     std::vector<int64_t>().swap(g->t_rel);
     std::vector<int64_t>().swap(g->t_sub_idx);
     std::vector<uint8_t>().swap(g->t_sub_kind);
+}
+
+void reserve_rows(Graph* g, size_t n) {
+    g->t_lhs.reserve(n);
+    g->t_ns.reserve(n);
+    g->t_obj.reserve(n);
+    g->t_rel.reserve(n);
+    g->t_sub_idx.reserve(n);
+    g->t_sub_kind.reserve(n);
+    // pre-size the intern tables: growth rehashes at 10M inserts cost more
+    // than the (transient) bucket-array over-allocation
+    g->set_ids.reserve(n / 2 + 16);
+    g->leaf_ids.reserve(n / 2 + 16);
+    g->obj_codes.reserve(n / 2 + 16);
+    g->rel_codes.reserve(1024);
+    g->key_ns.reserve(n / 2 + 16);
+    g->key_obj.reserve(n / 2 + 16);
+    g->key_rel.reserve(n / 2 + 16);
+    g->wild.reserve(n / 2 + 16);
+}
+
+// Decode one fixed-width UCS4 (numpy '<U*') cell into utf-8 in ``out``;
+// returns a view over ``out``. Cells are NUL-padded to ``width`` code
+// points; decoding stops at the first NUL.
+inline std::string_view sv_from_ucs4(const uint32_t* p, int64_t width,
+                                     std::string& out) {
+    out.clear();
+    for (int64_t i = 0; i < width; ++i) {
+        uint32_t cp = p[i];
+        if (cp == 0) break;
+        if (cp < 0x80) {
+            out.push_back((char)cp);
+        } else if (cp < 0x800) {
+            out.push_back((char)(0xC0 | (cp >> 6)));
+            out.push_back((char)(0x80 | (cp & 0x3F)));
+        } else if (cp < 0x10000) {
+            out.push_back((char)(0xE0 | (cp >> 12)));
+            out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+            out.push_back((char)(0x80 | (cp & 0x3F)));
+        } else {
+            out.push_back((char)(0xF0 | (cp >> 18)));
+            out.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+            out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+            out.push_back((char)(0x80 | (cp & 0x3F)));
+        }
+    }
+    return std::string_view(out);
+}
+
+}  // namespace
+
+extern "C" {
+
+// UCS4 columnar fast path: string columns as numpy '<U*' fixed-width
+// arrays (data pointer + per-cell width in code points). This is the
+// zero-copy handoff from the store's bulk-ingest column cache
+// (keto_tpu/persistence/memory.py): no Python-side encoding at all.
+Graph* graph_build_ucs4(
+    int64_t n, const int64_t* ns, const uint8_t* kind, const int64_t* sns,
+    const uint32_t* obj, int64_t obj_w,
+    const uint32_t* rel, int64_t rel_w,
+    const uint32_t* sid, int64_t sid_w,
+    const uint32_t* sso, int64_t sso_w,
+    const uint32_t* ssr, int64_t ssr_w,
+    const int64_t* wild_ns_ids, int64_t n_wild_ns) {
+    Graph* g = new Graph();
+    g->wild_ns_ids.assign(wild_ns_ids, wild_ns_ids + n_wild_ns);
+    reserve_rows(g, (size_t)n);
+    std::string b_obj, b_rel, b_sid, b_sso, b_ssr;
+    for (int64_t i = 0; i < n; ++i) {
+        std::string_view v_obj = sv_from_ucs4(obj + i * obj_w, obj_w, b_obj);
+        std::string_view v_rel = sv_from_ucs4(rel + i * rel_w, rel_w, b_rel);
+        if (kind[i]) {
+            add_row(*g, ns[i], v_obj, v_rel, true,
+                    sv_from_ucs4(sid + i * sid_w, sid_w, b_sid), 0,
+                    std::string_view(), std::string_view());
+        } else {
+            add_row(*g, ns[i], v_obj, v_rel, false, std::string_view(), sns[i],
+                    sv_from_ucs4(sso + i * sso_w, sso_w, b_sso),
+                    sv_from_ucs4(ssr + i * ssr_w, ssr_w, b_ssr));
+        }
+    }
+    finish_edges(g);
+    return g;
+}
+
+// Columnar fast path: n rows as arrays. String column i of a row r is
+// blob[starts[r] .. starts[r]+lens[r]); kind[r]=1 means subject-id row
+// (sid column; sns/sso/ssr ignored), 0 means subject-set row (sid ignored).
+Graph* graph_build_columnar(
+    int64_t n, const int64_t* ns, const uint8_t* kind, const int64_t* sns,
+    const char* obj_blob, const int64_t* obj_starts, const int64_t* obj_lens,
+    const char* rel_blob, const int64_t* rel_starts, const int64_t* rel_lens,
+    const char* sid_blob, const int64_t* sid_starts, const int64_t* sid_lens,
+    const char* sso_blob, const int64_t* sso_starts, const int64_t* sso_lens,
+    const char* ssr_blob, const int64_t* ssr_starts, const int64_t* ssr_lens,
+    const int64_t* wild_ns_ids, int64_t n_wild_ns) {
+    Graph* g = new Graph();
+    g->wild_ns_ids.assign(wild_ns_ids, wild_ns_ids + n_wild_ns);
+    reserve_rows(g, (size_t)n);
+    for (int64_t i = 0; i < n; ++i) {
+        add_row(*g, ns[i],
+                std::string_view(obj_blob + obj_starts[i], (size_t)obj_lens[i]),
+                std::string_view(rel_blob + rel_starts[i], (size_t)rel_lens[i]),
+                kind[i] != 0,
+                std::string_view(sid_blob + sid_starts[i], (size_t)sid_lens[i]),
+                sns[i],
+                std::string_view(sso_blob + sso_starts[i], (size_t)sso_lens[i]),
+                std::string_view(ssr_blob + ssr_starts[i], (size_t)ssr_lens[i]));
+    }
+    finish_edges(g);
+    return g;
+}
+
+// Parse the packed row buffer; returns a Graph handle or nullptr on a
+// malformed buffer.
+Graph* graph_build(const char* buf, int64_t len, const int64_t* wild_ns_ids,
+                   int64_t n_wild_ns) {
+    Graph* g = new Graph();
+    g->wild_ns_ids.assign(wild_ns_ids, wild_ns_ids + n_wild_ns);
+
+    const char* p = buf;
+    const char* end = buf + len;
+    std::string_view fields[7];
+    while (p < end) {
+        // split one record into 7 fields
+        int f = 0;
+        const char* field_start = p;
+        while (p < end && f < 7) {
+            if (*p == '\x1f' || *p == '\x1e') {
+                fields[f++] = std::string_view(field_start, (size_t)(p - field_start));
+                bool rec_end = (*p == '\x1e');
+                ++p;
+                field_start = p;
+                if (rec_end) break;
+            } else {
+                ++p;
+            }
+        }
+        if (f != 7) {
+            delete g;
+            return nullptr;
+        }
+        int64_t ns = 0;
+        for (char c : fields[0]) {
+            if (c < '0' || c > '9') { delete g; return nullptr; }
+            ns = ns * 10 + (c - '0');
+        }
+        int64_t sns = 0;
+        if (fields[3] != "1") {
+            for (char c : fields[4]) {
+                if (c < '0' || c > '9') { delete g; return nullptr; }
+                sns = sns * 10 + (c - '0');
+            }
+            add_row(*g, ns, fields[1], fields[2], false, std::string_view(), sns,
+                    fields[5], fields[6]);
+        } else {
+            add_row(*g, ns, fields[1], fields[2], true, fields[4], 0,
+                    std::string_view(), std::string_view());
+        }
+    }
+    finish_edges(g);
     return g;
 }
 
@@ -263,13 +421,16 @@ void graph_keys(const Graph* g, int64_t* key_ns, int64_t* key_obj, int64_t* key_
 // Resolution: -1 = not present.
 int64_t graph_resolve_set(const Graph* g, int64_t ns, const char* obj, int64_t obj_len,
                           const char* rel, int64_t rel_len) {
-    SetKey key{ns, std::string(obj, (size_t)obj_len), std::string(rel, (size_t)rel_len)};
-    auto it = g->set_ids.find(key);
+    auto oc = g->obj_codes.find(std::string_view(obj, (size_t)obj_len));
+    if (oc == g->obj_codes.end()) return -1;
+    auto rc = g->rel_codes.find(std::string_view(rel, (size_t)rel_len));
+    if (rc == g->rel_codes.end()) return -1;
+    auto it = g->set_ids.find(TripleKey{ns, oc->second, rc->second});
     return it == g->set_ids.end() ? -1 : it->second;
 }
 
 int64_t graph_resolve_leaf(const Graph* g, const char* s, int64_t len) {
-    auto it = g->leaf_ids.find(std::string(s, (size_t)len));
+    auto it = g->leaf_ids.find(std::string_view(s, (size_t)len));
     return it == g->leaf_ids.end() ? -1 : it->second;
 }
 
@@ -287,9 +448,15 @@ int64_t graph_resolve_queries(const Graph* g, const char* buf, int64_t len,
     const char* end = buf + len;
     const int64_t num_sets = (int64_t)g->set_ids.size();
     std::string_view fields[7];
-    SetKey key;
-    std::string leaf;
     int64_t i = 0;
+    auto resolve_set_sv = [&](int64_t ns, std::string_view obj, std::string_view rel) {
+        auto oc = g->obj_codes.find(obj);
+        if (oc == g->obj_codes.end()) return (int64_t)-1;
+        auto rc = g->rel_codes.find(rel);
+        if (rc == g->rel_codes.end()) return (int64_t)-1;
+        auto it = g->set_ids.find(TripleKey{ns, oc->second, rc->second});
+        return it == g->set_ids.end() ? (int64_t)-1 : it->second;
+    };
     while (p < end && i < n) {
         int f = 0;
         const char* field_start = p;
@@ -310,14 +477,9 @@ int64_t graph_resolve_queries(const Graph* g, const char* buf, int64_t len,
             if (c < '0' || c > '9') return -1;
             ns = ns * 10 + (c - '0');
         }
-        key.ns = ns;
-        key.obj.assign(fields[1]);
-        key.rel.assign(fields[2]);
-        auto it = g->set_ids.find(key);
-        out_start[i] = it == g->set_ids.end() ? -1 : it->second;
+        out_start[i] = resolve_set_sv(ns, fields[1], fields[2]);
         if (fields[3] == "1") {
-            leaf.assign(fields[4]);
-            auto lt = g->leaf_ids.find(leaf);
+            auto lt = g->leaf_ids.find(fields[4]);
             out_sub[i] = lt == g->leaf_ids.end() ? -1 : lt->second + num_sets;
         } else {
             int64_t sns = 0;
@@ -325,11 +487,7 @@ int64_t graph_resolve_queries(const Graph* g, const char* buf, int64_t len,
                 if (c < '0' || c > '9') return -1;
                 sns = sns * 10 + (c - '0');
             }
-            key.ns = sns;
-            key.obj.assign(fields[5]);
-            key.rel.assign(fields[6]);
-            auto st = g->set_ids.find(key);
-            out_sub[i] = st == g->set_ids.end() ? -1 : st->second;
+            out_sub[i] = resolve_set_sv(sns, fields[5], fields[6]);
         }
         ++i;
     }
@@ -337,12 +495,12 @@ int64_t graph_resolve_queries(const Graph* g, const char* buf, int64_t len,
 }
 
 int64_t graph_obj_code(const Graph* g, const char* s, int64_t len) {
-    auto it = g->obj_codes.find(std::string(s, (size_t)len));
+    auto it = g->obj_codes.find(std::string_view(s, (size_t)len));
     return it == g->obj_codes.end() ? -1 : it->second;
 }
 
 int64_t graph_rel_code(const Graph* g, const char* s, int64_t len) {
-    auto it = g->rel_codes.find(std::string(s, (size_t)len));
+    auto it = g->rel_codes.find(std::string_view(s, (size_t)len));
     return it == g->rel_codes.end() ? -1 : it->second;
 }
 

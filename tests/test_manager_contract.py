@@ -321,3 +321,25 @@ def test_sqlite_snapshot_cache_two_connections_no_duplicates():
         keys = [r.key7() + (r.seq,) for r in rows_a]
         assert len(keys) == len(set(keys)), f"duplicate rows after extension {i}"
         assert len(rows_a) == 2 + i
+
+
+def test_bulk_ingest_trailing_nul_and_long_strings_fall_back(make_persister):
+    """Fixed-width numpy columns strip trailing NULs and blow up on long
+    outliers — such batches must route through the exact per-row path."""
+    from keto_tpu.relationtuple.model import RelationQuery
+
+    p = make_persister([("g", 1)])
+    tuples = [T("g", f"o{i}", "m", SubjectID(f"u{i}")) for i in range(4200)]
+    tuples.append(T("g", "a\x00", "m", SubjectID("nul-user")))
+    tuples.append(T("g", "a", "m", SubjectID("plain-user")))
+    tuples.append(T("g", "x" * 5000, "m", SubjectID("long-user")))
+    p.write_relation_tuples(*tuples)
+    got, _ = p.get_relation_tuples(RelationQuery(namespace="g", object="a\x00"))
+    assert [t.subject.id for t in got] == ["nul-user"]
+    got, _ = p.get_relation_tuples(RelationQuery(namespace="g", object="a"))
+    assert [t.subject.id for t in got] == ["plain-user"]
+    got, _ = p.get_relation_tuples(RelationQuery(namespace="g", object="x" * 5000))
+    assert [t.subject.id for t in got] == ["long-user"]
+    # the unsafe batch must not have cached a column bundle
+    if hasattr(p, "snapshot_columns"):
+        assert p.snapshot_columns(p.watermark()) is None

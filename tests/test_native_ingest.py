@@ -66,11 +66,111 @@ def test_exact_equivalence(seed, wild_ns):
         assert nat.rel_code(s) == py.rel_code(s)
 
 
-def test_separator_bytes_fall_back():
-    rows = [InternalRow(0, "bad\x1fobj", "r", "u", None, None, None, 0)]
+def test_separator_bytes_handled_by_columnar_path():
+    # 0x1F/0x1E corrupt the packed-buffer framing, but the columnar fast
+    # path carries explicit lengths — these rows now intern natively with
+    # full parity instead of falling back
+    rows = [
+        InternalRow(0, "bad\x1fobj", "r", "u\x1eser", None, None, None, 0),
+        InternalRow(0, "bad\x1fobj", "r2", None, 0, "s\x1fet", "m", 1),
+    ]
+    nat = native_intern_rows(rows, frozenset())
+    py = intern_rows(rows, frozenset())
+    assert nat is not None
+    assert (nat.num_sets, nat.num_leaves) == (py.num_sets, py.num_leaves)
+    np.testing.assert_array_equal(nat.src, py.src)
+    np.testing.assert_array_equal(nat.dst, py.dst)
+    assert nat.resolve_set(0, "bad\x1fobj", "r") == py.resolve_set(0, "bad\x1fobj", "r")
+    assert nat.resolve_leaf("u\x1eser") == py.resolve_leaf("u\x1eser")
+
+
+def test_nul_bytes_route_to_packed_path():
+    # NUL separates the columnar blobs, so such rows fall through to the
+    # packed-buffer parser (where NUL is an ordinary byte) with parity
+    rows = [InternalRow(0, "bad\x00obj", "r", "u", None, None, None, 0)]
+    nat = native_intern_rows(rows, frozenset())
+    py = intern_rows(rows, frozenset())
+    assert nat is not None
+    assert nat.resolve_set(0, "bad\x00obj", "r") == py.resolve_set(0, "bad\x00obj", "r") == 0
+
+
+def test_nul_and_separator_bytes_fall_back():
+    # a string carrying BOTH kinds of separator defeats both native
+    # encodings → Python interner
+    rows = [InternalRow(0, "bad\x00\x1fobj", "r", "u", None, None, None, 0)]
     assert native_intern_rows(rows, frozenset()) is None
 
 
 def test_empty():
     nat = native_intern_rows([], frozenset())
     assert nat is not None and nat.num_nodes == 0 and nat.src.size == 0
+
+
+def test_ucs4_column_bundle_parity():
+    """The store's bulk-load column bundle must intern identically to the
+    row-based paths (same ids, same edges) — including unicode and the
+    empty string."""
+    import random
+
+    from keto_tpu import namespace as ns_pkg
+    from keto_tpu.persistence.memory import MemoryPersister
+    from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+
+    rng = random.Random(9)
+    nm = ns_pkg.MemoryManager([ns_pkg.Namespace(id=1, name="g"), ns_pkg.Namespace(id=2, name="d")])
+    p = MemoryPersister(nm)
+    objs = [f"o{i}" for i in range(40)] + ["ünïcode-объект", ""]
+    rels = ["member", "viewer", ""]
+    tuples = []
+    for _ in range(5000):  # > the 4096 bulk-sort threshold
+        if rng.random() < 0.5:
+            sub = SubjectID(rng.choice(["u1", "u2", "üser", "u-%d" % rng.randrange(50)]))
+        else:
+            sub = SubjectSet("g", rng.choice(objs), rng.choice(rels))
+        tuples.append(RelationTuple(rng.choice(["g", "d"]), rng.choice(objs), rng.choice(rels), sub))
+    p.write_relation_tuples(*tuples)
+    rows, wm = p.snapshot_rows()
+    bundle = p.snapshot_columns(wm)
+    assert bundle is not None, "bulk load into empty store must cache columns"
+
+    nat = native_intern_rows(rows, frozenset(), columns=bundle)
+    py = intern_rows(rows, frozenset())
+    assert nat is not None
+    assert (nat.num_sets, nat.num_leaves) == (py.num_sets, py.num_leaves)
+    np.testing.assert_array_equal(nat.src, py.src)
+    np.testing.assert_array_equal(nat.dst, py.dst)
+    np.testing.assert_array_equal(nat.key_ns, py.key_ns)
+    np.testing.assert_array_equal(nat.key_obj, py.key_obj)
+    np.testing.assert_array_equal(nat.key_rel, py.key_rel)
+    for (ns, obj, rel), raw in list(py.set_ids.items())[:200]:
+        assert nat.resolve_set(ns, obj, rel) == raw
+
+    # a follow-up write invalidates the bundle
+    p.write_relation_tuples(RelationTuple("g", "late", "member", SubjectID("u1")))
+    assert p.snapshot_columns(p.watermark()) is None
+
+
+def test_bulk_sort_matches_key_sort():
+    """The numpy lexsort bulk path must order rows exactly like
+    sort_key (NULL-first semantics, seq tie-break)."""
+    import random
+
+    from keto_tpu import namespace as ns_pkg
+    from keto_tpu.persistence.memory import MemoryPersister
+    from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+
+    rng = random.Random(4)
+    nm = ns_pkg.MemoryManager([ns_pkg.Namespace(id=1, name="g")])
+    p = MemoryPersister(nm)
+    tuples = []
+    for _ in range(5000):
+        sub = (
+            SubjectID(rng.choice(["", "a", "b", "ü"]))
+            if rng.random() < 0.5
+            else SubjectSet("g", rng.choice(["", "x", "y"]), rng.choice(["", "r"]))
+        )
+        tuples.append(RelationTuple("g", rng.choice(["", "o1", "o2"]), rng.choice(["", "r1"]), sub))
+    p.write_relation_tuples(*tuples)
+    rows, _ = p.snapshot_rows()
+    resorted = sorted(rows, key=InternalRow.sort_key)
+    assert [r.key7() + (r.seq,) for r in rows] == [r.key7() + (r.seq,) for r in resorted]
