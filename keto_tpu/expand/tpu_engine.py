@@ -41,14 +41,18 @@ suite compares trees order-insensitively like the reference's):
   can interleave differently than global row order when wildcard-bearing
   keys also match.
 
-While an insert-only delta overlay is pending, expand DELEGATES to the
-Manager-backed engine outright: overlay children would append after base
-children, shifting the DFS visit order — and with it which occurrence of
-a repeated set gets expanded vs visited-pruned, which at bounded depth
-changes which subtrees appear at all. The Manager path reproduces the
-reference's order exactly by construction; the snapshot fast path resumes
-at the next full rebuild (overlays are transient by design). Checks are
-unaffected — reachability is order-independent.
+While a delta overlay is pending, the fast path still serves: the
+snapshot's unified overlay adjacency (``ov_fwd``,
+keto_tpu/graph/overlay.py) is merged into each node's base child list
+**in Manager order** — base children are already in subject-sort order
+(one literal node's rows are contiguous in the store's ORDER BY), and
+overlay children sort by the same subject key, so a two-way ordered
+merge reproduces the Manager's page order exactly; tombstoned base
+edges are masked in place. Only two overlay cases still delegate to the
+Manager-backed engine: a graph containing wildcard-bearing set nodes
+(their child order is GLOBAL row order, not subject order — not
+reconstructible from the per-node merge) and a pattern root with no
+literal node (same reason, via _pattern_children).
 """
 
 from __future__ import annotations
@@ -96,9 +100,10 @@ class SnapshotExpandEngine:
         if not isinstance(subject, SubjectSet):
             return Tree(type=LEAF, subject=subject)
         snap = self._engine.snapshot()
-        if snap.has_overlay:
-            # pending insert-only overlay: serve the reference's exact
-            # tree from the Manager until the next rebuild (module doc)
+        if snap.has_overlay and snap.has_wildcards:
+            # wildcard-bearing nodes order children by GLOBAL row order —
+            # not reconstructible from the per-node overlay merge (module
+            # doc); serve the reference's exact tree from the Manager
             return self._manager_engine.build_tree(subject, rest_depth)
         nm = self._nm()
 
@@ -123,6 +128,10 @@ class SnapshotExpandEngine:
         if root_dev is None:
             if not pattern:
                 return None  # literal key absent → no tuples → nil tree
+            if snap.has_overlay:
+                # a pattern root concatenates MATCHING KEYS' lists in
+                # global row order — same non-reconstructible case
+                return self._manager_engine.build_tree(subject, rest_depth)
             starts = snap.resolve_starts(ns_id, subject.object, subject.relation)
             if starts.size == 0:
                 return None
@@ -172,6 +181,43 @@ class SnapshotExpandEngine:
 
     # -- phase A -------------------------------------------------------------
 
+    def _subject_order_key(self, snap: GraphSnapshot, dev: int):
+        """Manager ORDER BY position of a child: subject sets first
+        (NULL-first on the subject_id column), each group sorted by its
+        key fields — comparable tuples."""
+        kind, key = snap.key_of_dev(dev)
+        return (0, key) if kind == "set" else (1, (key,))
+
+    def _merge_overlay_children(
+        self, snap: GraphSnapshot, dev: int, base: np.ndarray
+    ) -> np.ndarray:
+        """Base children (already in subject-sort order — one literal
+        node's rows are contiguous in the store's ORDER BY) merged with
+        the node's overlay children in the SAME order: the Manager's page
+        order, reproduced without a storage round trip. Overlay lists are
+        tiny by design, so each overlay child bisects into the sorted
+        base list (O(k log n) key computations, not O(n)); the merged
+        array memoizes on the immutable snapshot."""
+        import bisect as _bisect
+
+        extra = snap.ov_fwd.get(int(dev))
+        if not extra:
+            return base
+        cache_key = ("_exp_merge", int(dev))
+        with snap._cache_lock:
+            hit = snap._pattern_cache.get(cache_key)
+        if hit is not None:
+            return hit
+        okey = lambda d: self._subject_order_key(snap, int(d))  # noqa: E731
+        ov_sorted = sorted(extra, key=okey)
+        positions = [
+            _bisect.bisect_left(base, okey(d), key=okey) for d in ov_sorted
+        ]
+        out = np.insert(base.astype(np.int64), positions, ov_sorted)
+        with snap._cache_lock:
+            snap._pattern_cache[cache_key] = out
+        return out
+
     def _capture_adjacency(
         self,
         snap: GraphSnapshot,
@@ -180,7 +226,9 @@ class SnapshotExpandEngine:
         children_of: dict[int, np.ndarray],
     ) -> None:
         """Fill ``children_of`` for every set node reachable within the
-        depth budget: one ``out_neighbors_bulk`` gather per BFS level."""
+        depth budget: one ``out_neighbors_bulk`` gather per BFS level
+        (base edges, tombstone-masked), plus the per-node overlay merge
+        when a delta is pending."""
         if root_dev == _PATTERN_ROOT:
             ch = children_of[_PATTERN_ROOT]
             m = snap.is_set_dev_bulk(ch)
@@ -189,11 +237,12 @@ class SnapshotExpandEngine:
             frontier = [root_dev]
         seen = set(frontier)
         level = 0
+        has_ov = bool(snap.ov_fwd)
         # a node at BFS level L expands with rest_depth - L; it consults
         # its children whenever that is ≥ 1
         while frontier and level <= rest_depth - 1:
             arr = np.asarray(frontier, np.int64)
-            rows, cnts = snap.out_neighbors_bulk(arr)
+            rows, cnts = snap.out_neighbors_bulk(arr, overlay=False)
             ends = np.cumsum(cnts)
             nxt: list[int] = []
             new_children: list[np.ndarray] = []
@@ -201,6 +250,8 @@ class SnapshotExpandEngine:
             for i, dev in enumerate(frontier):
                 ch = rows[start : ends[i]]
                 start = int(ends[i])
+                if has_ov:
+                    ch = self._merge_overlay_children(snap, dev, ch)
                 children_of[dev] = ch
                 new_children.append(ch)
             if new_children:

@@ -110,7 +110,7 @@ def apply_delta(
     interned = base.interned
     raw2dev = base.raw2dev
 
-    if del_keys and bool(np.any(np.asarray(interned.key_wild))):
+    if del_keys and base.has_wildcards:
         # a removed tuple's wildcard-attach edges survive iff another
         # matching row covers them — deciding that needs a store scan
         return None
@@ -119,6 +119,9 @@ def apply_delta(
     ov_leaf = _merged(base.ov_leaf_ids)
     ov_out = {k: v for k, v in (base.ov_out or {}).items()}
     ov_sink_in = {k: v for k, v in (base.ov_sink_in or {}).items()}
+    # unified per-source overlay out-adjacency (every added edge, whatever
+    # its kernel class) — the expand engine's complete child source
+    ov_fwd = {k: list(v) for k, v in (base.ov_fwd or {}).items()}
     ell = [tuple(e) for e in (() if base.ov_ell is None else base.ov_ell)]
     removed: set[int] = (
         set(int(k) for k in base.ov_removed) if base.ov_removed is not None else set()
@@ -257,6 +260,18 @@ def apply_delta(
     add_out: dict[int, list[int]] = {}
     add_sink_in: dict[int, list[int]] = {}
 
+    def fwd_add(src: int, dst: int) -> None:
+        lst = ov_fwd.setdefault(src, [])
+        if dst not in lst:
+            lst.append(dst)
+
+    def fwd_drop(src: int, dst: int) -> None:
+        lst = ov_fwd.get(src)
+        if lst is not None and dst in lst:
+            lst.remove(dst)
+            if not lst:
+                del ov_fwd[src]
+
     for src, dst in new_edges:
         if in_base_csr(src, dst):
             key = (src << 32) | dst
@@ -296,6 +311,7 @@ def apply_delta(
             add_out.setdefault(src, []).append(dst)
         else:
             return None  # sink source would need class change
+        fwd_add(src, dst)
 
     # deletes: resolve each key's endpoints (no creation) and remove the
     # edge wherever it lives — overlay structures for delta-added edges,
@@ -320,6 +336,7 @@ def apply_delta(
         if edge in ell_members:
             ell_members.discard(edge)
             dropped_ell.add(edge)
+            fwd_drop(lhs_dev, sub_dev)
             continue
         out_arr = ov_out.get(lhs_dev)
         if out_arr is not None and bool(np.any(out_arr == sub_dev)):
@@ -328,6 +345,7 @@ def apply_delta(
                 ov_out[lhs_dev] = rest
             else:
                 del ov_out[lhs_dev]
+            fwd_drop(lhs_dev, sub_dev)
             continue
         in_arr = ov_sink_in.get(sub_dev)
         if in_arr is not None and bool(np.any(in_arr == lhs_dev)):
@@ -336,6 +354,7 @@ def apply_delta(
                 ov_sink_in[sub_dev] = rest
             else:
                 del ov_sink_in[sub_dev]
+            fwd_drop(lhs_dev, sub_dev)
             continue
         key = (lhs_dev << 32) | sub_dev
         if key in removed or not in_base_csr(lhs_dev, sub_dev):
@@ -389,6 +408,7 @@ def apply_delta(
         ov_next=nxt,
         ov_out=ov_out,
         ov_sink_in=ov_sink_in,
+        ov_fwd=ov_fwd or None,
         ov_ell=ell_arr,
         ov_removed=removed_arr,
         ell_patch=ell_patch or None,

@@ -149,6 +149,10 @@ class GraphSnapshot:
     ov_next: int = 0  # next free overlay device id
     ov_out: Optional[dict] = None  # src dev → np.int64[...] out-neighbor devs
     ov_sink_in: Optional[dict] = None  # sink dev → np.int32[...] interior srcs
+    #: unified overlay out-adjacency: src dev → [dst devs] for EVERY added
+    #: edge regardless of kernel class (ov_out/ov_ell/ov_sink_in are the
+    #: class-partitioned device views; this is the expand engine's source)
+    ov_fwd: Optional[dict] = None
     ov_ell: Optional[np.ndarray] = None  # int64 [K, 2] (src, dst) edges
     #: tombstoned BASE edges as a sorted int64 key array ((src << 32) | dst)
     #: — deletes applied as deltas (keto_tpu/graph/overlay.py). Host
@@ -176,9 +180,21 @@ class GraphSnapshot:
             or bool(self.ov_leaf_ids)
             or bool(self.ov_out)
             or bool(self.ov_sink_in)
+            or bool(self.ov_fwd)
             or self.ov_ell is not None
             or (self.ov_removed is not None and self.ov_removed.size > 0)
         )
+
+    @property
+    def has_wildcards(self) -> bool:
+        """True when any set node is wildcard-bearing — fixed per
+        snapshot, cached (the raw scan is O(num_sets))."""
+        with self._cache_lock:
+            v = self._pattern_cache.get("_has_wild")
+            if v is None:
+                v = bool(np.any(np.asarray(self.interned.key_wild)))
+                self._pattern_cache["_has_wild"] = v
+            return v
 
     @property
     def sink_base(self) -> int:
@@ -296,15 +312,19 @@ class GraphSnapshot:
         seg = np.repeat(np.arange(cnts.shape[0]), cnts)
         return ~hit, cnts - np.bincount(seg[hit], minlength=cnts.shape[0])
 
-    def out_neighbors_bulk(self, nodes: np.ndarray):
+    def out_neighbors_bulk(self, nodes: np.ndarray, overlay: bool = True):
         """(concatenated out-neighbor devs of ``nodes``, per-node counts) —
-        base forward CSR merged with the delta overlay's adjacency (new
-        tuples since the base build) and masked by its tombstones (deleted
-        tuples). Node order is preserved. Base neighbor order within a node
-        is GUARANTEED to be store row order (= the Manager's page order;
+        base forward CSR merged with the delta overlay's host-propagation
+        adjacency (``ov_out`` — the class the check engine's batch-setup
+        walk needs) and masked by its tombstones (deleted tuples). Node
+        order is preserved. Base neighbor order within a node is
+        GUARANTEED to be store row order (= the Manager's page order;
         interner dedup keeps first occurrence — the expand engine's
         tree-child parity depends on this, keto_tpu/expand/tpu_engine.py);
-        overlay extras append after base neighbors."""
+        overlay extras append after base neighbors. ``overlay=False``
+        skips the ov_out merge (still tombstone-masked) — the expand
+        engine merges the COMPLETE overlay adjacency (``ov_fwd``) itself,
+        in Manager order."""
         nodes = np.asarray(nodes)
         nb = self.n_base_nodes
         if nodes.size and int(nodes.max()) >= nb:
@@ -331,7 +351,7 @@ class GraphSnapshot:
                 keep, cnts = drop
                 rows = rows[keep]
         ov = self.ov_out
-        if ov is None or not ov:
+        if not overlay or ov is None or not ov:
             return rows, cnts
         # vectorized membership: pack_chunk's multi-hop propagation calls
         # this per hop with frontiers of thousands of rows — a Python

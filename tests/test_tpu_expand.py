@@ -349,3 +349,86 @@ def test_delta_self_loop_on_existing_node(make_persister):
     want = oracle.subject_is_allowed(q)
     assert want is True
     assert tpu._engine.subject_is_allowed(q) is want
+
+
+def test_overlay_fast_path_serves_without_manager(make_persister):
+    """A non-wildcard overlay (inserts AND tombstone deletes) is served by
+    the snapshot fast path — the Manager engine must NOT be consulted."""
+    p = make_persister([("g", 1)])
+    p.write_relation_tuples(
+        T("g", "root", "m", SubjectSet("g", "mid", "m")),
+        T("g", "mid", "m", SubjectID("zz")),
+        T("g", "mid", "m", SubjectID("kk")),
+    )
+    host, tpu = engines(p)
+    tpu.build_tree(SubjectSet("g", "root", "m"), 5)  # base snapshot
+
+    def boom(*a, **k):
+        raise AssertionError("expand delegated to the Manager engine")
+
+    tpu._manager_engine.build_tree = boom
+    p.write_relation_tuples(T("g", "mid", "m", SubjectID("aa")))
+    p.delete_relation_tuples(T("g", "mid", "m", SubjectID("kk")))
+    snap = tpu._engine.snapshot()
+    assert snap.has_overlay, "fixture must be served by a delta"
+    h = host.build_tree(SubjectSet("g", "root", "m"), 5)
+    t = tpu.build_tree(SubjectSet("g", "root", "m"), 5)
+    assert_tree_identical(h, t)
+    mid = t.children[0]
+    assert [str(c.subject) for c in mid.children] == ["aa", "zz"]
+
+
+@pytest.mark.parametrize("seed", [10, 11, 12])
+def test_overlay_order_parity_fuzz_no_wildcards(make_persister, seed):
+    """Order-parity fuzz on wildcard-free stores: with pending overlays
+    (inserts + deletes) the fast path's trees must be IDENTICAL (not just
+    semantically equal) to the Manager engine's, and the Manager engine
+    must never be consulted."""
+    rng = random.Random(seed)
+    p = make_persister([("g", 1), ("d", 2)])
+    objs = [f"o{i}" for i in range(6)]
+    rels = ["r0", "r1"]
+    users = [f"u{i}" for i in range(5)]
+    seen_tuples = set()
+
+    def rand_tuple():
+        # duplicate store rows are the DOCUMENTED fast-path divergence
+        # (host lists the child per row, snapshot dedups edges) — keep the
+        # fuzz on distinct tuples where trees must be identical
+        for _ in range(50):
+            sub = (
+                SubjectID(rng.choice(users))
+                if rng.random() < 0.5
+                else SubjectSet("g", rng.choice(objs), rng.choice(rels))
+            )
+            t = T(rng.choice(["g", "d"]), rng.choice(objs), rng.choice(rels), sub)
+            key = str(t)
+            if key not in seen_tuples:
+                seen_tuples.add(key)
+                return t
+        return t
+
+    p.write_relation_tuples(*[rand_tuple() for _ in range(25)])
+    host, tpu = engines(p)
+    tpu.build_tree(SubjectSet("g", objs[0], "r0"), 3)  # base snapshot
+
+    def boom(*a, **k):
+        raise AssertionError("expand delegated to the Manager engine")
+
+    tpu._manager_engine.build_tree = boom
+    from keto_tpu.relationtuple.model import RelationQuery
+
+    for round_ in range(5):
+        p.write_relation_tuples(*[rand_tuple() for _ in range(3)])
+        tuples, _ = p.get_relation_tuples(RelationQuery())
+        if tuples and rng.random() < 0.7:
+            p.delete_relation_tuples(rng.choice(tuples))
+        for _ in range(10):
+            sub = SubjectSet(rng.choice(["g", "d"]), rng.choice(objs), rng.choice(rels))
+            d = rng.choice([1, 2, 3, 100])
+            h = host.build_tree(sub, d)
+            t = tpu.build_tree(sub, d)
+            if h is None or t is None:
+                assert h is None and t is None, f"{sub}@{d}: {h} vs {t}"
+            else:
+                assert_tree_identical(h, t)
