@@ -414,6 +414,52 @@ class GraphSnapshot:
         cnts[mi] += lens
         return rows, cnts
 
+    def _pattern_index(self, kind: str):
+        """Lazily built sorted key index for pattern resolution:
+        ``(order, sorted primary col, sorted secondary col | None)``.
+        Kinds: "no" = (ns, obj), "nr" = (ns, rel), "or" = (obj, rel),
+        "r" = (rel,). Built once per snapshot; every pattern family then
+        resolves with binary searches instead of an O(num_sets) scan —
+        the fix for wildcard-heavy batches serializing on the host."""
+        ck = ("_pidx", kind)
+        with self._cache_lock:
+            hit = self._pattern_cache.get(ck)
+        if hit is not None:
+            return hit
+        i = self.interned
+        kn = np.asarray(i.key_ns)
+        ko = np.asarray(i.key_obj)
+        kr = np.asarray(i.key_rel)
+        if kind == "no":
+            order = np.lexsort((ko, kn))
+            entry = (order, kn[order], ko[order])
+        elif kind == "nr":
+            order = np.lexsort((kr, kn))
+            entry = (order, kn[order], kr[order])
+        elif kind == "or":
+            order = np.lexsort((kr, ko))
+            entry = (order, ko[order], kr[order])
+        else:  # "r"
+            order = np.argsort(kr, kind="stable")
+            entry = (order, kr[order], None)
+        with self._cache_lock:
+            self._pattern_cache[ck] = entry
+        return entry
+
+    @staticmethod
+    def _index_range(entry, v1, v2=None) -> np.ndarray:
+        """Raw set ids whose primary key equals ``v1`` (and secondary
+        equals ``v2`` when given), via the sorted index."""
+        order, c1, c2 = entry
+        lo = int(np.searchsorted(c1, v1, "left"))
+        hi = int(np.searchsorted(c1, v1, "right"))
+        if v2 is None or c2 is None:
+            return order[lo:hi]
+        seg = c2[lo:hi]
+        l2 = int(np.searchsorted(seg, v2, "left"))
+        h2 = int(np.searchsorted(seg, v2, "right"))
+        return order[lo + l2 : lo + h2]
+
     def resolve_starts(self, ns_id: int, obj: str, rel: str) -> np.ndarray:
         """Device ids of the set nodes a check starting at ``(ns, obj, rel)``
         expands — the graph analog of the reference's wildcarding tuple query
@@ -436,16 +482,29 @@ class GraphSnapshot:
             hit = self._pattern_cache.get(key)
         if hit is not None:
             return hit
-        m = np.ones(self.num_sets, bool)
-        if not ns_wild:
-            m &= self.interned.key_ns == ns_id
-        if obj != "":
-            code = self.interned.obj_code(obj)
-            m &= (self.interned.key_obj == code) if code >= 0 else False
-        if rel != "":
-            code = self.interned.rel_code(rel)
-            m &= (self.interned.key_rel == code) if code >= 0 else False
-        starts = self.raw2dev[: self.num_sets][np.nonzero(m)[0]]
+        oc = self.interned.obj_code(obj) if obj != "" else None
+        rc = self.interned.rel_code(rel) if rel != "" else None
+        if (obj != "" and oc < 0) or (rel != "" and rc < 0):
+            cand = np.zeros(0, np.int64)  # a literal field never interned
+        elif not ns_wild:
+            if oc is not None:  # (ns, obj, *)
+                cand = self._index_range(self._pattern_index("no"), ns_id, oc)
+            elif rc is not None:  # (ns, *, rel)
+                cand = self._index_range(self._pattern_index("nr"), ns_id, rc)
+            else:  # (ns, *, *)
+                cand = self._index_range(self._pattern_index("no"), ns_id)
+        else:
+            if oc is not None and rc is not None:  # (*, obj, rel)
+                cand = self._index_range(self._pattern_index("or"), oc, rc)
+            elif oc is not None:  # (*, obj, *)
+                cand = self._index_range(self._pattern_index("or"), oc)
+            elif rc is not None:  # (*, *, rel)
+                cand = self._index_range(self._pattern_index("r"), rc)
+            else:  # (*, *, *)
+                cand = np.arange(self.num_sets, dtype=np.int64)
+        # ascending raw-id order: bitwise-identical to the old full-scan
+        # nonzero() result (multi-host lockstep determinism)
+        starts = self.raw2dev[np.sort(cand)] if cand.size else np.zeros(0, np.int64)
         if self.ov_set_ids:
             # overlay keys are always fully literal (a new wildcard key
             # forces a full rebuild), so pattern-match them directly

@@ -375,3 +375,64 @@ def test_it_cap_truncation_rerun_exact_stream(make_persister):
     got = np.concatenate(list(engine.batch_check_stream(iter(queries)))).tolist()
     want = [oracle.subject_is_allowed(q) for q in queries]
     assert got == want
+
+
+def test_bulk_wildcard_batch_resolves_indexed(make_persister):
+    """A wildcard-heavy batch must resolve through the snapshot's sorted
+    pattern indexes (binary searches), matching the oracle on every
+    pattern family — the old path re-scanned all set keys per pattern."""
+    import random as _random
+
+    import numpy as np
+
+    rng = _random.Random(77)
+    p = make_persister([("g", 1), ("d", 2), ("", 3)])
+    objs = [f"o{i}" for i in range(40)]
+    rels = ["r0", "r1", "r2"]
+    rows = []
+    for i in range(3000):
+        sub = (
+            SubjectID(f"u{i % 50}")
+            if rng.random() < 0.6
+            else SubjectSet("g", rng.choice(objs), rng.choice(rels))
+        )
+        rows.append(T(rng.choice(["g", "d"]), rng.choice(objs), rng.choice(rels), sub))
+    p.write_relation_tuples(*rows)
+    oracle, engine = both_engines(p)
+    snap = engine.snapshot()
+
+    # every pattern family hits its index; parity vs the direct key scan
+    interned = snap.interned
+    kn = np.asarray(interned.key_ns)
+    ko = np.asarray(interned.key_obj)
+    kr = np.asarray(interned.key_rel)
+    for ns_id, obj, rel in [
+        (1, "o1", ""), (1, "", "r0"), (1, "", ""),
+        (-1, "o2", "r1"), (-1, "o3", ""), (-1, "", "r2"), (-1, "", ""),
+        (1, "absent-obj", ""), (-1, "", "absent-rel"),
+    ]:
+        got = np.sort(engine.snapshot().resolve_starts(ns_id, obj, rel))
+        m = np.ones(kn.shape[0], bool)
+        if ns_id != -1:
+            m &= kn == ns_id
+        if obj != "":
+            c = interned.obj_code(obj)
+            m = (m & (ko == c)) if c >= 0 else np.zeros_like(m)
+        if rel != "":
+            c = interned.rel_code(rel)
+            m = (m & (kr == c)) if c >= 0 else np.zeros_like(m)
+        want = np.sort(snap.raw2dev[np.nonzero(m)[0]])
+        assert got.tolist() == want.tolist(), (ns_id, obj, rel)
+
+    # a wildcard-heavy check batch end-to-end vs oracle
+    queries = []
+    for _ in range(300):
+        pattern = rng.randrange(4)
+        o = rng.choice(objs) if pattern in (0, 2) else ""
+        r = rng.choice(rels) if pattern in (0, 1) else ""
+        ns = rng.choice(["g", "d", ""])
+        queries.append(T(ns, o, r, SubjectID(f"u{rng.randrange(60)}")))
+    got = engine.batch_check(queries)
+    for q, g in zip(queries, got):
+        w = oracle.subject_is_allowed(q)
+        assert g == w, f"{q}: tpu={g} oracle={w}"
