@@ -470,11 +470,13 @@ class TpuCheckEngine:
     ``mesh`` spans more than one process, every host executes one SPMD
     program — so every host must call ``batch_check``/``snapshot`` with
     identical inputs in identical order over identical store contents
-    (same batches, same write points). Divergent per-host traffic or
-    store state produces mismatched collective programs (hangs or
-    corrupt results). See ``parallel/mesh.py init_distributed`` and the
-    README's multi-host section for the serving pattern that provides
-    this.
+    (same batches, same write points). This is ENFORCED, not assumed:
+    route traffic through ``parallel.lockstep.LockstepFrontend`` (host 0
+    replicates every op to all hosts before execution), and the engine
+    itself all-gathers a per-batch (snapshot, batch) fingerprint before
+    every multi-process dispatch (``lockstep_verify``, default on),
+    failing loudly on divergence instead of hanging mismatched
+    collectives or corrupting results.
     """
 
     def __init__(
@@ -490,6 +492,7 @@ class TpuCheckEngine:
         compact_after_s: float = 5.0,
         peel_seed_cap: float = 4.0,
         sync_rebuild_budget_s: float = 0.25,
+        lockstep_verify: bool = True,
     ):
         if it_cap < 1:
             raise ValueError("it_cap must be >= 1 (the answer pull needs one step)")
@@ -512,6 +515,10 @@ class TpuCheckEngine:
         self._mesh = mesh
         self._shard_rows = shard_rows
         self._multiprocess = mesh is not None and jax.process_count() > 1
+        # per-batch (snapshot, batch) fingerprint agreement across hosts:
+        # divergence fails loudly instead of hanging mismatched collectives
+        # or corrupting decisions (keto_tpu/parallel/lockstep.py)
+        self._lockstep_verify = lockstep_verify and self._multiprocess
         self._bitmap_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1098,6 +1105,13 @@ class TpuCheckEngine:
         """``batch_check`` plus the id of the snapshot that produced the
         decisions — the snaptoken the API returns to callers."""
         snap = self._snapshot_for(at_least, mode)
+        if self._lockstep_verify:
+            from keto_tpu.parallel.lockstep import verify_lockstep
+
+            # BEFORE the empty-graph early-out: hosts disagreeing on
+            # whether the graph is empty is exactly the divergence that
+            # must fail loudly rather than skew answers silently
+            verify_lockstep(snap.snapshot_id, tuples)
         if snap.n_nodes == 0 or snap.n_edges == 0 or not tuples:
             return [False] * len(tuples), snap.snapshot_id
         out, max_iters = self._run_exact(snap, tuples)
@@ -1168,6 +1182,9 @@ class TpuCheckEngine:
         depth = depth or self._dispatch_window
         inflight: deque = deque()
         max_iters = 0
+        lockstep = self._lockstep_verify
+        if lockstep:
+            from keto_tpu.parallel.lockstep import verify_lockstep
 
         def _land(rec):
             nonlocal max_iters
@@ -1192,6 +1209,10 @@ class TpuCheckEngine:
             batch = list(itertools.islice(it, cap))
             if not batch:
                 break
+            if lockstep:
+                # per stream slice, BEFORE any dispatch (same contract as
+                # batch_check_with_token): divergent streams fail loudly
+                verify_lockstep(snap.snapshot_id, batch)
             if snap.n_nodes == 0 or snap.n_edges == 0:
                 yield np.zeros(len(batch), dtype=bool)
                 continue

@@ -19,4 +19,13 @@ stays on gRPC/REST over DCN (SURVEY §2.3 table).
 
 from keto_tpu.parallel.mesh import DATA_AXIS, GRAPH_AXIS, make_mesh
 
-__all__ = ["make_mesh", "DATA_AXIS", "GRAPH_AXIS"]
+__all__ = ["make_mesh", "DATA_AXIS", "GRAPH_AXIS", "LockstepFrontend"]
+
+
+def __getattr__(name):
+    # lazy: lockstep pulls in multihost_utils, not needed single-host
+    if name == "LockstepFrontend":
+        from keto_tpu.parallel.lockstep import LockstepFrontend
+
+        return LockstepFrontend
+    raise AttributeError(name)

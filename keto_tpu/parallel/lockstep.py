@@ -1,0 +1,179 @@
+"""Multi-host lockstep: request replication + divergence detection.
+
+A multi-controller engine executes ONE SPMD program across every host, so
+every host must issue the same engine calls with identical inputs in
+identical order over identical store contents (keto_tpu/check/tpu_engine.py
+class docstring). The reference never needs this — its replicas are
+stateless over one SQL database (reference
+internal/driver/registry_default.go:206-224) — but a sharded device graph
+does. Two components make the contract REAL instead of prose:
+
+- **LockstepFrontend** — the request-replicating ingress. Host 0 (the
+  primary) takes external traffic; every op (tuple write, check batch,
+  shutdown) is serialized and broadcast to all hosts
+  (``jax.experimental.multihost_utils.broadcast_one_to_all`` — a
+  collective every host participates in), then executed identically
+  everywhere: writes mutate each host's store replica, checks run the
+  SPMD batch. Followers run ``follow()``; the primary's ``check``/
+  ``write`` calls pair with it one broadcast at a time, so call order is
+  identical BY CONSTRUCTION — the failure mode that would otherwise hang
+  mismatched collectives cannot be expressed.
+- **verify_lockstep** — the per-batch agreement check the engine runs
+  before every multi-process dispatch (``engine.lockstep_verify``, on by
+  default): all-gather a fingerprint of (snapshot id, query batch) and
+  fail LOUDLY with per-host values on divergence, instead of hanging in
+  mismatched collectives or silently corrupting decisions. It catches
+  data divergence (different stores, different batches); a call-count
+  divergence still deadlocks the runtime — which is exactly what the
+  frontend exists to prevent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Optional, Sequence
+
+import numpy as np
+
+from keto_tpu.relationtuple.model import RelationTuple
+
+
+def batch_fingerprint(snapshot_id: int, tuples: Sequence[RelationTuple]) -> int:
+    """Order-sensitive 64-bit fingerprint of (snapshot id, batch) — stable
+    across hosts and processes (no Python hash randomization)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(str(snapshot_id).encode())
+    h.update(b"\x00")  # unambiguous (id, batch) framing
+    for t in tuples:
+        h.update(str(t).encode())
+        h.update(b"\x00")
+    return int.from_bytes(h.digest(), "little")
+
+
+def verify_lockstep(snapshot_id: int, tuples: Sequence[RelationTuple]) -> None:
+    """All-gather the batch fingerprint across processes; raise with every
+    host's value when they disagree (the loud alternative to a hang)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    fp = batch_fingerprint(snapshot_id, tuples)
+    gathered = np.asarray(
+        multihost_utils.process_allgather(np.asarray([fp], np.uint64))
+    ).reshape(-1)
+    if not bool(np.all(gathered == gathered[0])):
+        raise RuntimeError(
+            "multi-host lockstep divergence: per-process batch fingerprints "
+            f"{[int(g) for g in gathered]} differ (this process="
+            f"{jax.process_index()}, snapshot={snapshot_id}, "
+            f"batch={len(tuples)} queries). Hosts issued different batches "
+            "or serve different store contents — route traffic through "
+            "LockstepFrontend."
+        )
+
+
+def _bcast_payload(payload: Optional[bytes]) -> bytes:
+    """Broadcast ``payload`` from process 0 to every process (two
+    collectives: length, then bytes). Non-primaries pass None."""
+    from jax.experimental import multihost_utils
+
+    n = np.asarray([0 if payload is None else len(payload)], np.int32)
+    n = int(np.asarray(multihost_utils.broadcast_one_to_all(n)).reshape(-1)[0])
+    if payload is None:
+        buf = np.zeros(n, np.uint8)
+    else:
+        buf = np.frombuffer(payload.ljust(n, b"\0"), np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return out.tobytes()
+
+
+class LockstepFrontend:
+    """Request-replicating ingress for a multi-controller engine.
+
+    Host 0 (``jax.process_index() == 0``) calls ``write``/``check``/
+    ``stop``; every other host calls ``follow()`` (blocks until stop).
+    All hosts execute every op identically — only host 0 takes external
+    traffic, yet every host's store and device snapshot advance in
+    lockstep (the 2-process test asserts identical decision streams).
+    """
+
+    def __init__(self, engine, store):
+        import jax
+
+        self._engine = engine
+        self._store = store
+        self._primary = jax.process_index() == 0
+
+    # -- primary API ---------------------------------------------------------
+
+    def write(self, insert: Sequence[RelationTuple], delete: Sequence[RelationTuple] = ()):
+        assert self._primary, "only host 0 takes traffic"
+        self._step(
+            {
+                "op": "write",
+                "insert": [t.to_json() for t in insert],
+                "delete": [t.to_json() for t in delete],
+            }
+        )
+
+    def check(
+        self,
+        tuples: Sequence[RelationTuple],
+        *,
+        at_least: Optional[int] = None,
+        mode: str = "latest",
+    ) -> tuple[list[bool], int]:
+        assert self._primary, "only host 0 takes traffic"
+        return self._step(
+            {
+                "op": "check",
+                "tuples": [t.to_json() for t in tuples],
+                "at_least": at_least,
+                "mode": mode,
+            }
+        )
+
+    def stop(self) -> None:
+        assert self._primary, "only host 0 takes traffic"
+        self._step({"op": "stop"})
+
+    # -- follower ------------------------------------------------------------
+
+    def follow(self, on_result=None) -> None:
+        """Execute replicated ops until the primary stops. ``on_result``
+        observes each check's (decisions, snapshot id) — the 2-process
+        test uses it to prove identical decision streams."""
+        assert not self._primary
+        while True:
+            op, result = self._recv_and_run(None)
+            if op == "stop":
+                return
+            if op == "check" and on_result is not None:
+                on_result(*result)
+
+    # -- shared --------------------------------------------------------------
+
+    def _step(self, op_dict):
+        payload = json.dumps(op_dict, sort_keys=True).encode()
+        _, result = self._recv_and_run(payload)
+        return result
+
+    def _recv_and_run(self, payload: Optional[bytes]):
+        raw = _bcast_payload(payload)
+        op_dict = json.loads(raw.rstrip(b"\0").decode())
+        op = op_dict["op"]
+        if op == "stop":
+            return op, None
+        if op == "write":
+            self._store.transact_relation_tuples(
+                [RelationTuple.from_json(j) for j in op_dict["insert"]],
+                [RelationTuple.from_json(j) for j in op_dict["delete"]],
+            )
+            return op, None
+        if op == "check":
+            tuples = [RelationTuple.from_json(j) for j in op_dict["tuples"]]
+            result = self._engine.batch_check_with_token(
+                tuples, at_least=op_dict["at_least"], mode=op_dict["mode"]
+            )
+            return op, result
+        raise ValueError(f"unknown replicated op {op!r}")

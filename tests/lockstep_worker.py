@@ -1,0 +1,89 @@
+"""Worker for the lockstep-frontend test (tests/test_multihost.py).
+
+Usage: python lockstep_worker.py <process_id> <coordinator_port>
+
+The VERDICT-r4 done-criterion scenario: ONLY host 0 takes traffic. Both
+hosts start with an EMPTY store; every tuple write and every check batch
+reaches host 1 exclusively through the LockstepFrontend's replication,
+and both hosts must produce identical decision streams (digest-compared
+by the parent test).
+"""
+
+import hashlib
+import os
+import random
+import sys
+
+
+def main() -> int:
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    from keto_tpu.parallel.mesh import init_distributed
+
+    init_distributed(
+        f"127.0.0.1:{port}", num_processes=2, process_id=pid,
+        local_device_count=4, platform="cpu",
+    )
+    import jax
+
+    from keto_tpu import namespace as namespace_pkg
+    from keto_tpu.check.tpu_engine import TpuCheckEngine
+    from keto_tpu.parallel import make_mesh
+    from keto_tpu.parallel.lockstep import LockstepFrontend
+    from keto_tpu.persistence.memory import MemoryPersister
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    def T(ns, obj, rel, sub):
+        return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+    nm = namespace_pkg.MemoryManager(
+        [namespace_pkg.Namespace(id=1, name="g"), namespace_pkg.Namespace(id=2, name="d")]
+    )
+    store = MemoryPersister(nm)  # EMPTY — content arrives only via replication
+    mesh = make_mesh(graph=2)
+    engine = TpuCheckEngine(store, store.namespaces, mesh=mesh, shard_rows=True)
+    assert engine._multiprocess and engine._lockstep_verify
+    front = LockstepFrontend(engine, store)
+
+    digest = hashlib.blake2b(digest_size=16)
+
+    if jax.process_index() == 0:
+        rng = random.Random(11)
+        objs = [f"o{i}" for i in range(8)]
+        users = [f"u{i}" for i in range(6)]
+        front.write(
+            [
+                T("d", o, "view", SubjectSet("g", f"grp{i % 4}", "m"))
+                for i, o in enumerate(objs)
+            ]
+            + [T("g", f"grp{i % 4}", "m", SubjectID(u)) for i, u in enumerate(users)]
+        )
+        for round_ in range(3):
+            qs = [
+                T("d", rng.choice(objs), "view", SubjectID(rng.choice(users + ["ghost"])))
+                for _ in range(40)
+            ]
+            got, token = front.check(qs, mode="latest")
+            digest.update(bytes(got))
+            digest.update(str(token).encode())
+            # interleave a write (incl. a tombstone delete) between batches
+            front.write(
+                [T("g", f"grp{round_ % 4}", "m", SubjectID(f"w{round_}"))],
+                [T("g", "grp0", "m", SubjectID(users[round_]))],
+            )
+        front.stop()
+    else:
+        def record(got, token):
+            digest.update(bytes(got))
+            digest.update(str(token).encode())
+
+        front.follow(on_result=record)
+
+    print(f"LOCKSTEP_DIGEST p{pid} {digest.hexdigest()}", flush=True)
+    print(f"LOCKSTEP_OK p{pid}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
