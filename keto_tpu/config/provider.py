@@ -56,7 +56,10 @@ _DEFAULTS: dict[str, Any] = {
     },
     "limit": {"max_read_depth": 5},
     "log": {"level": "info", "format": "text"},
-    "tracing": {"provider": ""},
+    "tracing": {
+        "provider": "",
+        "otlp": {"file": "", "endpoint": "http://127.0.0.1:4318/v1/traces"},
+    },
     "profiling": "",
     "telemetry": {"enabled": False},
 }
@@ -78,6 +81,9 @@ _ENV_KEYS = [
     "log.level",
     "log.format",
     "profiling",
+    "tracing.provider",
+    "tracing.otlp.file",
+    "tracing.otlp.endpoint",
 ]
 
 

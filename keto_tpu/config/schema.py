@@ -108,8 +108,29 @@ CONFIG_SCHEMA = {
         "tracing": {
             "type": "object",
             "additionalProperties": False,
+            "description": "Span export, config-selected like the reference's tracing.provider (reference internal/driver/config/provider.go:145-155).",
             "properties": {
-                "provider": {"type": "string", "enum": ["", "log", "memory"], "default": ""},
+                "provider": {
+                    "type": "string",
+                    "enum": ["", "log", "memory", "otlp-file", "otlp-http"],
+                    "default": "",
+                },
+                "otlp": {
+                    "type": "object",
+                    "additionalProperties": False,
+                    "properties": {
+                        "file": {
+                            "type": "string",
+                            "default": "",
+                            "description": "otlp-file provider: path appended with one OTLP/JSON ExportTraceServiceRequest per line (tail it with a collector's filelog receiver).",
+                        },
+                        "endpoint": {
+                            "type": "string",
+                            "default": "http://127.0.0.1:4318/v1/traces",
+                            "description": "otlp-http provider: OTLP/HTTP collector endpoint (standard local listener by default).",
+                        },
+                    },
+                },
             },
         },
         "profiling": {"type": "string", "enum": ["", "cpu", "mem"], "default": ""},

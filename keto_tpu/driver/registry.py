@@ -145,11 +145,18 @@ class Registry:
     # -- observability -------------------------------------------------------
 
     def tracer(self):
-        from keto_tpu.x.tracing import Tracer
+        from keto_tpu.x.tracing import DEFAULT_OTLP_ENDPOINT, Tracer
 
         return self._memo(
             "tracer",
-            lambda: Tracer(self._config.get("tracing.provider", ""), self.logger()),
+            lambda: Tracer(
+                self._config.get("tracing.provider", ""),
+                self.logger(),
+                otlp_file=self._config.get("tracing.otlp.file", ""),
+                otlp_endpoint=self._config.get(
+                    "tracing.otlp.endpoint", DEFAULT_OTLP_ENDPOINT
+                ),
+            ),
         )
 
     def telemetry(self):
@@ -168,6 +175,9 @@ class Registry:
         batcher = self._singletons.get("check_batcher")
         if batcher:
             batcher.stop()
+        tracer = self._singletons.get("tracer")
+        if tracer is not None:
+            tracer.close()
         store = self._singletons.get("manager")
         if store is not None and hasattr(store, "close"):
             store.close()

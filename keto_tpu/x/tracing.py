@@ -5,20 +5,27 @@ internal/driver/config/provider.go:145-155 for config,
 registry_default.go:288-290 HTTP middleware, :331-333/:344-346 gRPC
 interceptors, pop_connection.go:17-23 SQL-level spans): spans carry a trace
 id, name, duration, and tags, propagate via a context variable, and export
-through a pluggable provider. Providers:
+through a pluggable provider (the reference selects jaeger/zipkin/etc. from
+config the same way). Providers:
 
 - ``""`` (default): tracing disabled, spans are no-ops;
 - ``log``: finished spans go to the structured logger at debug level;
-- ``memory``: spans collect in a ring buffer (tests, /debug introspection).
-
-Zero-egress environments get no jaeger/zipkin exporter; the provider seam is
-where one would plug in.
+- ``memory``: spans collect in a ring buffer (tests, /debug introspection);
+- ``otlp-file``: spans append to ``tracing.otlp.file`` as OTLP/JSON lines
+  (one ExportTraceServiceRequest per line) — a local OpenTelemetry
+  collector tails it with the filelog receiver; suits zero-egress hosts;
+- ``otlp-http``: spans POST (batched, background thread, drop-on-overflow
+  — telemetry never blocks serving) to an OTLP/HTTP collector at
+  ``tracing.otlp.endpoint`` (default the collector's standard local
+  listener, http://127.0.0.1:4318/v1/traces).
 """
 
 from __future__ import annotations
 
 import collections
 import contextvars
+import json
+import queue
 import threading
 import time
 import uuid
@@ -30,6 +37,8 @@ _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar
     "keto_tpu_span", default=None
 )
 
+DEFAULT_OTLP_ENDPOINT = "http://127.0.0.1:4318/v1/traces"
+
 
 @dataclass
 class Span:
@@ -38,6 +47,9 @@ class Span:
     span_id: str
     parent_id: Optional[str]
     start: float
+    #: wall-clock epoch nanoseconds at span start (OTLP export needs
+    #: absolute time; ``start`` stays monotonic for exact durations)
+    start_unix_ns: int = 0
     end: Optional[float] = None
     tags: dict[str, Any] = field(default_factory=dict)
 
@@ -45,13 +57,139 @@ class Span:
     def duration_ms(self) -> Optional[float]:
         return None if self.end is None else (self.end - self.start) * 1e3
 
+    def to_otlp(self) -> dict:
+        """This span as an OTLP/JSON span object."""
+        dur_ns = 0 if self.end is None else int((self.end - self.start) * 1e9)
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentSpanId": self.parent_id or "",
+            "name": self.name,
+            # root spans are the request entry points (SERVER); nested
+            # spans are INTERNAL — backends derive per-service request
+            # rates from server spans, so children must not double-count
+            "kind": 2 if self.parent_id is None else 1,
+            "startTimeUnixNano": str(self.start_unix_ns),
+            "endTimeUnixNano": str(self.start_unix_ns + dur_ns),
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}}
+                for k, v in self.tags.items()
+            ],
+        }
+
+
+def spans_to_otlp_request(spans: list[Span], service: str = "keto-tpu") -> dict:
+    """An OTLP/JSON ExportTraceServiceRequest wrapping ``spans``."""
+    return {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": [
+                        {"key": "service.name", "value": {"stringValue": service}}
+                    ]
+                },
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "keto_tpu"},
+                        "spans": [s.to_otlp() for s in spans],
+                    }
+                ],
+            }
+        ]
+    }
+
+
+class _OtlpHttpExporter:
+    """Background batcher POSTing OTLP/JSON to a local collector. Spans
+    enqueue without blocking; a full queue drops (and counts) instead of
+    stalling the serving path."""
+
+    def __init__(self, endpoint: str, flush_interval_s: float = 1.0, batch: int = 64):
+        self.endpoint = endpoint
+        self._q: queue.Queue = queue.Queue(maxsize=4096)
+        self._interval = flush_interval_s
+        self._batch = batch
+        self.dropped = 0
+        self.exported = 0
+        self._inflight = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="keto-tpu-otlp", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, span: Span) -> None:
+        try:
+            self._q.put_nowait(span)
+        except queue.Full:
+            self.dropped += 1
+
+    def _loop(self) -> None:
+        import urllib.request
+
+        while True:
+            spans: list[Span] = []
+            try:
+                spans.append(self._q.get(timeout=self._interval))
+            except queue.Empty:
+                if self._stop.is_set():
+                    return  # drained: queue empty after stop
+                continue
+            while len(spans) < self._batch:
+                try:
+                    spans.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            self._inflight = len(spans)
+            body = json.dumps(spans_to_otlp_request(spans)).encode()
+            req = urllib.request.Request(
+                self.endpoint, data=body, method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=5):
+                    self.exported += len(spans)
+            except Exception:
+                self.dropped += len(spans)  # collector down: drop, never block
+            self._inflight = 0
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Drain the queue AND any in-flight batch (tests, shutdown)."""
+        deadline = time.monotonic() + timeout
+        while (not self._q.empty() or self._inflight) and time.monotonic() < deadline:
+            time.sleep(0.02)
+
+    def stop(self) -> None:
+        """Flush, then stop and join the worker — spans accepted before
+        stop() are exported, not dropped."""
+        self.flush()
+        self._stop.set()
+        self._thread.join(timeout=10)
+
 
 class Tracer:
-    def __init__(self, provider: str = "", logger=None, capacity: int = 1024):
+    def __init__(
+        self,
+        provider: str = "",
+        logger=None,
+        capacity: int = 1024,
+        otlp_file: str = "",
+        otlp_endpoint: str = DEFAULT_OTLP_ENDPOINT,
+    ):
         self.provider = provider
         self._logger = logger
         self._lock = threading.Lock()
         self.finished: collections.deque[Span] = collections.deque(maxlen=capacity)
+        self._otlp_file = otlp_file
+        self._file_handle = None
+        self._file_failed = False
+        self._http: Optional[_OtlpHttpExporter] = None
+        if provider == "otlp-file" and not otlp_file:
+            raise ValueError(
+                "tracing.provider=otlp-file requires tracing.otlp.file"
+            )
+        if provider == "otlp-http":
+            self._http = _OtlpHttpExporter(otlp_endpoint or DEFAULT_OTLP_ENDPOINT)
 
     @property
     def enabled(self) -> bool:
@@ -69,6 +207,7 @@ class Tracer:
             span_id=uuid.uuid4().hex[:16],
             parent_id=parent.span_id if parent else None,
             start=time.perf_counter(),
+            start_unix_ns=time.time_ns(),
             tags=dict(tags),
         )
         token = _current_span.set(s)
@@ -87,6 +226,43 @@ class Tracer:
         elif self.provider == "memory":
             with self._lock:
                 self.finished.append(s)
+        elif self.provider == "otlp-file" and self._otlp_file:
+            # telemetry never breaks serving: an unwritable path logs once
+            # and disables the exporter instead of failing every request;
+            # the handle stays open (O_APPEND line writes) so the hot path
+            # pays one write syscall, not open/write/close per span
+            line = json.dumps(spans_to_otlp_request([s])) + "\n"
+            with self._lock:
+                if self._file_failed:
+                    return
+                try:
+                    if self._file_handle is None:
+                        self._file_handle = open(self._otlp_file, "a")
+                    self._file_handle.write(line)
+                    self._file_handle.flush()
+                except OSError as e:
+                    self._file_failed = True
+                    if self._logger is not None:
+                        self._logger.error(
+                            "otlp-file exporter disabled: %s (%s)", e, self._otlp_file
+                        )
+        elif self.provider == "otlp-http" and self._http is not None:
+            self._http.submit(s)
+
+    def flush(self) -> None:
+        if self._http is not None:
+            self._http.flush()
+
+    def close(self) -> None:
+        if self._http is not None:
+            self._http.stop()
+        with self._lock:
+            if self._file_handle is not None:
+                try:
+                    self._file_handle.close()
+                except OSError:
+                    pass
+                self._file_handle = None
 
 
 #: process-wide no-op tracer used before a registry exists

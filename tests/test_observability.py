@@ -67,3 +67,137 @@ def test_profiling_attach_validates():
     with pytest.raises(ValueError):
         profiling.attach("gpu")
     profiling.attach("")  # no-op
+
+
+def test_otlp_file_exporter_from_quickstart(tmp_path):
+    """tracing.provider=otlp-file: serving a request appends valid
+    OTLP/JSON ExportTraceServiceRequest lines a local collector's filelog
+    receiver can tail."""
+    import json as _json
+
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.registry import Registry
+    from keto_tpu.servers.rest import READ, RestServer
+
+    out = tmp_path / "spans.otlp.jsonl"
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 1, "name": "g"}],
+            "tracing.provider": "otlp-file",
+            "tracing.otlp.file": str(out),
+        }
+    )
+    reg = Registry(cfg)
+    srv = RestServer(reg, READ, port=0)
+    srv.start()
+    try:
+        import urllib.request
+
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/check?namespace=g&object=o&relation=r&subject_id=u"
+            )
+        except urllib.error.HTTPError:
+            pass  # 403 deny is fine — the span still exports
+    finally:
+        srv.stop()
+        reg.close()
+    lines = out.read_text().strip().splitlines()
+    assert lines, "no spans exported"
+    req = _json.loads(lines[0])
+    spans = req["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert spans and spans[0]["name"].startswith("http.GET /check")
+    assert len(spans[0]["traceId"]) == 32 and len(spans[0]["spanId"]) == 16
+    assert int(spans[0]["endTimeUnixNano"]) >= int(spans[0]["startTimeUnixNano"]) > 0
+    svc = req["resourceSpans"][0]["resource"]["attributes"][0]
+    assert svc == {"key": "service.name", "value": {"stringValue": "keto-tpu"}}
+
+
+def test_otlp_http_exporter_reaches_local_collector():
+    """tracing.provider=otlp-http: spans arrive at a local OTLP/HTTP
+    collector (stand-in server records the POSTed request bodies)."""
+    import json as _json
+    import threading
+    import time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from keto_tpu.x.tracing import Tracer
+
+    received = []
+
+    class Collector(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            received.append(_json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Collector)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        tracer = Tracer(
+            "otlp-http",
+            otlp_endpoint=f"http://127.0.0.1:{httpd.server_address[1]}/v1/traces",
+        )
+        with tracer.span("grpc.CheckService/Check", role="read"):
+            with tracer.span("engine.batch"):
+                pass
+        tracer.flush()
+        deadline = time.monotonic() + 10
+        while not received and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert received, "collector saw no spans"
+        names = [
+            s["name"]
+            for r in received
+            for s in r["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        ]
+        assert "grpc.CheckService/Check" in names and "engine.batch" in names
+        # child links to parent within one trace
+        spans = [
+            s
+            for r in received
+            for s in r["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        ]
+        child = next(s for s in spans if s["name"] == "engine.batch")
+        parent = next(s for s in spans if s["name"] == "grpc.CheckService/Check")
+        assert child["parentSpanId"] == parent["spanId"]
+        assert child["traceId"] == parent["traceId"]
+        tracer.close()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_otlp_file_unwritable_path_never_breaks_serving():
+    from keto_tpu.x.tracing import Tracer
+
+    tracer = Tracer("otlp-file", otlp_file="/nonexistent-dir/spans.jsonl")
+    with tracer.span("http.GET /check"):
+        pass  # export failure must be swallowed (logged), not raised
+    with tracer.span("http.GET /check"):
+        pass  # exporter disabled after first failure, still no raise
+    tracer.close()
+
+
+def test_otlp_file_provider_requires_path():
+    from keto_tpu.x.tracing import Tracer
+
+    with pytest.raises(ValueError, match="requires tracing.otlp.file"):
+        Tracer("otlp-file")
+
+
+def test_otlp_span_kinds():
+    from keto_tpu.x.tracing import Tracer
+
+    tracer = Tracer("memory")
+    with tracer.span("root"):
+        with tracer.span("child"):
+            pass
+    spans = {s.name: s.to_otlp() for s in tracer.finished}
+    assert spans["root"]["kind"] == 2  # SERVER entry point
+    assert spans["child"]["kind"] == 1  # INTERNAL
