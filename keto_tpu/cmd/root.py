@@ -398,13 +398,18 @@ def migrate():
 
 def _migrator(config):
     from keto_tpu.config.provider import Config
-    from keto_tpu.persistence.sqlite import SQLitePersister
 
     cfg = Config(config_file=config)
     dsn = cfg.dsn
-    if not dsn.startswith("sqlite://"):
-        raise SystemExit(f"migrations apply to sqlite DSNs; got {dsn!r}")
-    return SQLitePersister(dsn, cfg.namespace_manager, auto_migrate=False)
+    if dsn.startswith("sqlite://"):
+        from keto_tpu.persistence.sqlite import SQLitePersister
+
+        return SQLitePersister(dsn, cfg.namespace_manager, auto_migrate=False)
+    if dsn.startswith(("postgres://", "postgresql://", "cockroach://")):
+        from keto_tpu.persistence.postgres import PostgresPersister
+
+        return PostgresPersister(dsn, cfg.namespace_manager, auto_migrate=False)
+    raise SystemExit(f"migrations apply to SQL DSNs (sqlite/postgres); got {dsn!r}")
 
 
 @migrate.command()

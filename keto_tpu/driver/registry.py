@@ -79,6 +79,12 @@ class Registry:
                 return SQLitePersister(
                     dsn, self.namespaces_source(), network_id=self._network_id
                 )
+            if dsn.startswith(("postgres://", "postgresql://", "cockroach://")):
+                from keto_tpu.persistence.postgres import PostgresPersister
+
+                return PostgresPersister(
+                    dsn, self.namespaces_source(), network_id=self._network_id
+                )
             raise ValueError(f"unsupported dsn {dsn!r}")
 
         return self._memo("manager", build)

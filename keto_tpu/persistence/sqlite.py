@@ -17,156 +17,21 @@ internal/persistence/sql/migrations/sql/20210623162417000000-000003):
 - versioned migrations with up/down/status driven by ``keto migrate``
   (reference cmd/migrate/*.go), tracked in ``keto_migrations``.
 
+The full implementation lives in the dialect-shared base
+(keto_tpu/persistence/sql_base.py — the postgres persister reuses it);
+this module holds only the sqlite3 driver seams.
+
 DSNs: ``sqlite://:memory:`` or ``sqlite://<path>``.
 """
 
 from __future__ import annotations
 
 import sqlite3
-import threading
-import uuid
-from typing import Optional, Sequence
 
-from keto_tpu import namespace as namespace_pkg
-from keto_tpu.persistence.memory import InternalRow
-from keto_tpu.relationtuple.manager import Manager
-from keto_tpu.relationtuple.model import RelationQuery, RelationTuple, SubjectID, SubjectSet
-from keto_tpu.x.errors import ErrMalformedPageToken, ErrNilSubject
-from keto_tpu.x.pagination import (
-    DEFAULT_PAGE_SIZE,
-    PaginationOptionSetter,
-    get_pagination_options,
-)
-
-MIGRATIONS: list[tuple[str, str, str]] = [
-    (
-        "20210623000000_relation_tuples",
-        """
-        CREATE TABLE keto_relation_tuples (
-            shard_id TEXT NOT NULL,
-            nid TEXT NOT NULL,
-            namespace_id INTEGER NOT NULL,
-            object TEXT NOT NULL,
-            relation TEXT NOT NULL,
-            subject_id TEXT NULL,
-            subject_set_namespace_id INTEGER NULL,
-            subject_set_object TEXT NULL,
-            subject_set_relation TEXT NULL,
-            commit_time INTEGER NOT NULL,
-            PRIMARY KEY (shard_id, nid),
-            CHECK (
-                (subject_id IS NULL AND subject_set_namespace_id IS NOT NULL
-                    AND subject_set_object IS NOT NULL AND subject_set_relation IS NOT NULL)
-                OR
-                (subject_id IS NOT NULL AND subject_set_namespace_id IS NULL
-                    AND subject_set_object IS NULL AND subject_set_relation IS NULL)
-            )
-        )
-        """,
-        "DROP TABLE keto_relation_tuples",
-    ),
-    (
-        "20210623000001_subject_id_idx",
-        """
-        CREATE INDEX keto_relation_tuples_subject_ids_idx
-        ON keto_relation_tuples (nid, namespace_id, object, relation, subject_id)
-        WHERE subject_id IS NOT NULL
-        """,
-        "DROP INDEX keto_relation_tuples_subject_ids_idx",
-    ),
-    (
-        "20210623000002_subject_set_idx",
-        """
-        CREATE INDEX keto_relation_tuples_subject_sets_idx
-        ON keto_relation_tuples (nid, namespace_id, object, relation,
-            subject_set_namespace_id, subject_set_object, subject_set_relation)
-        WHERE subject_set_namespace_id IS NOT NULL
-        """,
-        "DROP INDEX keto_relation_tuples_subject_sets_idx",
-    ),
-    (
-        "20210623000003_full_idx",
-        """
-        CREATE INDEX keto_relation_tuples_full_idx
-        ON keto_relation_tuples (nid, namespace_id, object, relation, subject_id,
-            subject_set_namespace_id, subject_set_object, subject_set_relation, commit_time)
-        """,
-        "DROP INDEX keto_relation_tuples_full_idx",
-    ),
-    (
-        "20210623000004_watermarks",
-        """
-        CREATE TABLE keto_watermarks (
-            nid TEXT PRIMARY KEY,
-            watermark INTEGER NOT NULL DEFAULT 0
-        )
-        """,
-        "DROP TABLE keto_watermarks",
-    ),
-    (
-        # delete watermark: lets snapshot readers tell insert-only advances
-        # (delta-overlayable, keto_tpu/graph/overlay.py) from ones that
-        # removed rows (full rebuild) in O(1)
-        "20210623000005_delete_watermark",
-        "ALTER TABLE keto_watermarks ADD COLUMN delete_wm INTEGER NOT NULL DEFAULT 0",
-        "ALTER TABLE keto_watermarks DROP COLUMN delete_wm",
-    ),
-    (
-        # delete log: the commit_time-ordered record of *effective* delete
-        # keys, read by ``changes_since`` so the device engine can apply
-        # deletes as tombstone overlays (keto_tpu/graph/overlay.py) instead
-        # of rebuilding. Bounded: del_log_floor rises as old entries prune;
-        # deltas reaching below the floor fall back to a rebuild.
-        "20210623000006_delete_log",
-        """
-        CREATE TABLE keto_tuple_delete_log (
-            nid TEXT NOT NULL,
-            namespace_id INTEGER NOT NULL,
-            object TEXT NOT NULL,
-            relation TEXT NOT NULL,
-            subject_id TEXT NULL,
-            subject_set_namespace_id INTEGER NULL,
-            subject_set_object TEXT NULL,
-            subject_set_relation TEXT NULL,
-            commit_time INTEGER NOT NULL
-        )
-        """,
-        "DROP TABLE keto_tuple_delete_log",
-    ),
-    (
-        "20210623000007_delete_log_idx_floor",
-        """
-        CREATE INDEX keto_tuple_delete_log_idx
-        ON keto_tuple_delete_log (nid, commit_time)
-        """,
-        "DROP INDEX keto_tuple_delete_log_idx",
-    ),
-    (
-        "20210623000008_delete_log_floor",
-        "ALTER TABLE keto_watermarks ADD COLUMN del_log_floor INTEGER NOT NULL DEFAULT 0",
-        "ALTER TABLE keto_watermarks DROP COLUMN del_log_floor",
-    ),
-    (
-        # commit-time range index: rows_since/changes_since (the delta
-        # seams on the steady-state serving path) are one indexed range
-        # read, not a table scan — commit_time is the LAST column of the
-        # full covering index, unusable for this range
-        "20210623000009_commit_time_idx",
-        """
-        CREATE INDEX keto_relation_tuples_commit_time_idx
-        ON keto_relation_tuples (nid, commit_time)
-        """,
-        "DROP INDEX keto_relation_tuples_commit_time_idx",
-    ),
-]
-
-#: delete-log retention window in watermark units; older entries prune and
-#: the floor rises (matching the in-memory store's bounded logs)
-_DELETE_LOG_KEEP = 8192
-
-_ORDER = (
-    "ORDER BY namespace_id, object, relation, subject_id, "
-    "subject_set_namespace_id, subject_set_object, subject_set_relation, commit_time"
+from keto_tpu.persistence.sql_base import (  # noqa: F401 - re-exported API
+    _DELETE_LOG_KEEP,
+    MIGRATIONS,
+    SQLPersisterBase,
 )
 
 
@@ -177,417 +42,20 @@ def _path_from_dsn(dsn: str) -> str:
     return path or ":memory:"
 
 
-class SQLitePersister(Manager):
-    def __init__(
-        self,
-        dsn: str,
-        namespace_manager_source,
-        network_id: str = "default",
-        auto_migrate: bool = True,
-        _conn: Optional[sqlite3.Connection] = None,
-        _lock: Optional[threading.RLock] = None,
-    ):
-        if isinstance(namespace_manager_source, namespace_pkg.Manager):
-            self._nm = lambda: namespace_manager_source
-        else:
-            self._nm = namespace_manager_source
-        self.network_id = network_id
-        # views created by with_network share the parent's connection AND
-        # lock, so transactions from different network scopes serialize on
-        # one connection instead of interleaving BEGINs
-        self._lock = _lock or threading.RLock()
-        self._owns_conn = _conn is None
-        self._conn = _conn or sqlite3.connect(
+class SQLitePersister(SQLPersisterBase):
+    PARAM = "?"
+
+    def _connect(self, dsn: str):
+        # isolation_level=None → autocommit; the base drives BEGIN/COMMIT
+        return sqlite3.connect(
             _path_from_dsn(dsn), check_same_thread=False, isolation_level=None
         )
-        self._dsn = dsn
-        # snapshot-row cache: (sorted InternalRow list, watermark). Full
-        # rebuild reads at 50M rows would otherwise re-read and re-encode
-        # every row per snapshot; insert-only advances extend the cache
-        # from the commit_time log instead (deletes invalidate).
-        self._snap_cache: Optional[tuple[list, int]] = None
-        with self._lock:
-            self._conn.execute(
-                "CREATE TABLE IF NOT EXISTS keto_migrations "
-                "(version TEXT PRIMARY KEY, applied_at INTEGER NOT NULL)"
-            )
-        if auto_migrate:
-            self.migrate_up()
 
-    def with_network(self, network_id: str) -> "SQLitePersister":
-        """Second view over the same database bound to another network id
-        (reference internal/relationtuple/manager_isolation.go:39-116)."""
-        return SQLitePersister(
-            self._dsn, self._nm, network_id,
-            auto_migrate=False, _conn=self._conn, _lock=self._lock,
-        )
+    def _null_safe_eq(self, col: str) -> str:
+        return f"{col} IS ?"  # sqlite's IS is null-safe equality
 
-    def close(self) -> None:
-        # derived views never close the shared connection
-        if self._owns_conn:
-            with self._lock:
-                self._conn.close()
-
-    # -- migrations ----------------------------------------------------------
-
-    def _applied(self) -> set[str]:
-        rows = self._conn.execute("SELECT version FROM keto_migrations").fetchall()
-        return {r[0] for r in rows}
-
-    def migration_status(self) -> list[tuple[str, bool]]:
-        with self._lock:
-            applied = self._applied()
-            return [(v, v in applied) for v, _, _ in MIGRATIONS]
-
-    @property
-    def namespaces(self):
-        """Zero-arg callable returning the current namespace manager."""
-        return self._nm
-
-    def migrate_up(self) -> int:
-        with self._lock:
-            applied = self._applied()
-            n = 0
-            for version, up, _ in MIGRATIONS:
-                if version in applied:
-                    continue
-                self._conn.execute(up)
-                self._conn.execute(
-                    "INSERT INTO keto_migrations (version, applied_at) VALUES (?, strftime('%s','now'))",
-                    (version,),
-                )
-                n += 1
-            return n
-
-    def migrate_down(self, steps: int = 1) -> int:
-        with self._lock:
-            applied = self._applied()
-            n = 0
-            for version, _, down in reversed(MIGRATIONS):
-                if n >= steps:
-                    break
-                if version not in applied:
-                    continue
-                self._conn.execute(down)
-                self._conn.execute("DELETE FROM keto_migrations WHERE version = ?", (version,))
-                n += 1
-            return n
-
-    # -- helpers -------------------------------------------------------------
-
-    def _row_values(self, rt: RelationTuple):
-        nm = self._nm()
-        ns_id = nm.get_namespace_by_name(rt.namespace).id
-        if rt.subject is None:
-            raise ErrNilSubject()
-        if isinstance(rt.subject, SubjectID):
-            return (ns_id, rt.object, rt.relation, rt.subject.id, None, None, None)
-        sns_id = nm.get_namespace_by_name(rt.subject.namespace).id
-        return (ns_id, rt.object, rt.relation, None, sns_id, rt.subject.object, rt.subject.relation)
-
-    def _to_tuple(self, row) -> RelationTuple:
-        nm = self._nm()
-        ns = nm.get_namespace_by_config_id(row[0])
-        if row[3] is not None:
-            subject = SubjectID(id=row[3])
-        else:
-            sns = nm.get_namespace_by_config_id(row[4])
-            subject = SubjectSet(namespace=sns.name, object=row[5], relation=row[6])
-        return RelationTuple(namespace=ns.name, object=row[1], relation=row[2], subject=subject)
-
-    def _where(self, query: RelationQuery):
-        """WHERE clauses with the reference's skip-empty-field wildcarding
-        (relationtuples.go:218-235) and explicit NULL filters on the subject
-        so the partial indexes apply (relationtuples.go:151-176)."""
-        nm = self._nm()
-        clauses, params = ["nid = ?"], [self.network_id]
-        if query.relation != "":
-            clauses.append("relation = ?")
-            params.append(query.relation)
-        if query.object != "":
-            clauses.append("object = ?")
-            params.append(query.object)
-        if query.namespace != "":
-            clauses.append("namespace_id = ?")
-            params.append(nm.get_namespace_by_name(query.namespace).id)
-        sub = query.subject
-        if isinstance(sub, SubjectID):
-            clauses.append(
-                "subject_id = ? AND subject_set_namespace_id IS NULL "
-                "AND subject_set_object IS NULL AND subject_set_relation IS NULL"
-            )
-            params.append(sub.id)
-        elif isinstance(sub, SubjectSet):
-            clauses.append(
-                "subject_id IS NULL AND subject_set_namespace_id = ? "
-                "AND subject_set_object = ? AND subject_set_relation = ?"
-            )
-            params.extend([nm.get_namespace_by_name(sub.namespace).id, sub.object, sub.relation])
-        return " AND ".join(clauses), params
-
-    # -- Manager -------------------------------------------------------------
-
-    def get_relation_tuples(
-        self, query: RelationQuery, *options: PaginationOptionSetter
-    ) -> tuple[list[RelationTuple], str]:
-        opts = get_pagination_options(*options)
-        per_page = opts.size or DEFAULT_PAGE_SIZE
-        if opts.token == "":
-            page = 1
-        elif opts.token.isdigit():
-            page = max(int(opts.token), 1)
-        else:
-            raise ErrMalformedPageToken()
-
-        where, params = self._where(query)
-        with self._lock:
-            total = self._conn.execute(
-                f"SELECT COUNT(*) FROM keto_relation_tuples WHERE {where}", params
-            ).fetchone()[0]
-            rows = self._conn.execute(
-                f"SELECT namespace_id, object, relation, subject_id, subject_set_namespace_id, "
-                f"subject_set_object, subject_set_relation FROM keto_relation_tuples "
-                f"WHERE {where} {_ORDER} LIMIT ? OFFSET ?",
-                params + [per_page, (page - 1) * per_page],
-            ).fetchall()
-        total_pages = -(-total // per_page)
-        next_token = "" if page >= total_pages else str(page + 1)
-        return [self._to_tuple(r) for r in rows], next_token
-
-    def write_relation_tuples(self, *tuples: RelationTuple) -> None:
-        self.transact_relation_tuples(tuples, ())
-
-    def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
-        self.transact_relation_tuples((), tuples)
-
-    def transact_relation_tuples(
-        self, insert: Sequence[RelationTuple], delete: Sequence[RelationTuple]
-    ) -> None:
-        with self._lock:
-            # resolve everything before mutating so namespace errors roll
-            # back cleanly (reference relationtuples.go:271-278)
-            ins_rows = [self._row_values(rt) for rt in insert]
-            del_rows = [self._row_values(rt) for rt in delete]
-            self._conn.execute("BEGIN")
-            try:
-                # commit_time is the per-network watermark + 1: O(1) to
-                # obtain (vs. a MAX() scan per row), monotone across
-                # transactions, constant within one (like the reference's
-                # commit_time=now(), relationtuples.go:128-149)
-                row = self._conn.execute(
-                    "SELECT watermark FROM keto_watermarks WHERE nid = ?",
-                    (self.network_id,),
-                ).fetchone()
-                commit_time = (row[0] if row else 0) + 1
-                changed = bool(ins_rows)
-                if ins_rows:
-                    shard_ids = uuid.uuid4().hex
-                    self._conn.executemany(
-                        "INSERT INTO keto_relation_tuples (shard_id, nid, namespace_id, "
-                        "object, relation, subject_id, subject_set_namespace_id, "
-                        "subject_set_object, subject_set_relation, commit_time) "
-                        "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
-                        [
-                            (f"{shard_ids}-{i}", self.network_id) + values + (commit_time,)
-                            for i, values in enumerate(ins_rows)
-                        ],
-                    )
-                effective_dels: list[tuple] = []
-                if del_rows:
-                    null_safe = " AND ".join(
-                        f"{col} IS ?" for col in (
-                            "subject_id",
-                            "subject_set_namespace_id",
-                            "subject_set_object",
-                            "subject_set_relation",
-                        )
-                    )
-                    # per-key deletes (like the reference's per-tuple loop,
-                    # relationtuples.go:178-201) so only keys that actually
-                    # removed rows enter the delete log — a logged no-op
-                    # under an unbumped watermark would leak into a later
-                    # delta read
-                    for values in dict.fromkeys(del_rows):
-                        cur = self._conn.execute(
-                            "DELETE FROM keto_relation_tuples WHERE nid = ? "
-                            "AND namespace_id = ? AND object = ? AND relation = ? "
-                            "AND " + null_safe,
-                            (self.network_id,) + values,
-                        )
-                        if cur.rowcount > 0:
-                            effective_dels.append(values)
-                    changed = changed or bool(effective_dels)
-                if changed:
-                    # bump only when the data actually moved, so the device
-                    # snapshot is not rebuilt for no-op transactions
-                    self._conn.execute(
-                        "INSERT INTO keto_watermarks (nid, watermark) VALUES (?, 1) "
-                        "ON CONFLICT(nid) DO UPDATE SET watermark = watermark + 1",
-                        (self.network_id,),
-                    )
-                    if effective_dels:
-                        self._conn.execute(
-                            "UPDATE keto_watermarks SET delete_wm = watermark "
-                            "WHERE nid = ?",
-                            (self.network_id,),
-                        )
-                        self._conn.executemany(
-                            "INSERT INTO keto_tuple_delete_log (nid, namespace_id, "
-                            "object, relation, subject_id, subject_set_namespace_id, "
-                            "subject_set_object, subject_set_relation, commit_time) "
-                            "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
-                            [
-                                (self.network_id,) + values + (commit_time,)
-                                for values in effective_dels
-                            ],
-                        )
-                        floor = commit_time - _DELETE_LOG_KEEP
-                        if floor > 0:
-                            self._conn.execute(
-                                "DELETE FROM keto_tuple_delete_log "
-                                "WHERE nid = ? AND commit_time <= ?",
-                                (self.network_id, floor),
-                            )
-                            self._conn.execute(
-                                "UPDATE keto_watermarks SET del_log_floor = ? "
-                                "WHERE nid = ?",
-                                (floor, self.network_id),
-                            )
-                self._conn.execute("COMMIT")
-            except Exception:
-                self._conn.execute("ROLLBACK")
-                raise
-
-    def watermark(self) -> int:
-        with self._lock:
-            row = self._conn.execute(
-                "SELECT watermark FROM keto_watermarks WHERE nid = ?", (self.network_id,)
-            ).fetchone()
-            return row[0] if row else 0
-
-    # -- snapshot support (TPU graph builder) --------------------------------
-
-    def snapshot_rows(self) -> tuple[list[InternalRow], int]:
-        """Consistent (rows, watermark) view for the TPU graph builder.
-
-        Rows come back in the Manager's ORDER BY (the expand engine's
-        tree-child order rides on snapshot row order — see the interner
-        dedup note). Insert-only watermark advances extend the in-process
-        cache via the commit_time log, merge-inserted to keep the order;
-        deletes (delete_wm moved) fall back to the full ordered read."""
-        import heapq
-
-        with self._lock:
-            # one read transaction around the meta and row reads: another
-            # CONNECTION on the same file committing between them would
-            # otherwise mislabel the cache watermark and duplicate rows
-            # on the next extension
-            self._conn.execute("BEGIN")
-            try:
-                meta = self._conn.execute(
-                    "SELECT watermark, delete_wm FROM keto_watermarks WHERE nid = ?",
-                    (self.network_id,),
-                ).fetchone()
-                wm, delete_wm = meta if meta else (0, 0)
-                cache = self._snap_cache
-                if cache is not None:
-                    c_rows, c_wm = cache
-                    if c_wm == wm:
-                        return list(c_rows), wm
-                    if delete_wm <= c_wm:
-                        new = self._conn.execute(
-                            "SELECT namespace_id, object, relation, subject_id, "
-                            "subject_set_namespace_id, subject_set_object, "
-                            "subject_set_relation, commit_time FROM keto_relation_tuples "
-                            "WHERE nid = ? AND commit_time > ?",
-                            (self.network_id, c_wm),
-                        ).fetchall()
-                        # single linear merge — per-row insort would memmove
-                        # the whole list per insert (O(k·n) at 50M rows)
-                        new_rows = sorted(
-                            (InternalRow(*r[:7], seq=r[7]) for r in new),
-                            key=InternalRow.sort_key,
-                        )
-                        rows = list(
-                            heapq.merge(c_rows, new_rows, key=InternalRow.sort_key)
-                        )
-                        self._snap_cache = (rows, wm)
-                        return list(rows), wm
-                raw = self._conn.execute(
-                    f"SELECT namespace_id, object, relation, subject_id, subject_set_namespace_id, "
-                    f"subject_set_object, subject_set_relation, commit_time FROM keto_relation_tuples "
-                    f"WHERE nid = ? {_ORDER}",
-                    (self.network_id,),
-                ).fetchall()
-                rows = [InternalRow(*r[:7], seq=r[7]) for r in raw]
-                self._snap_cache = (rows, wm)
-            finally:
-                self._conn.execute("COMMIT")
-        return list(rows), wm
-
-    def rows_since(self, watermark: int):
-        """Rows inserted after ``watermark`` as ``(rows, new_watermark)``,
-        or ``None`` when a delete happened since (the delta-overlay seam —
-        commit_time doubles as the insert log, so this is one indexed
-        range read plus an O(1) delete-watermark check)."""
-        with self._lock:
-            meta = self._conn.execute(
-                "SELECT watermark, delete_wm FROM keto_watermarks WHERE nid = ?",
-                (self.network_id,),
-            ).fetchone()
-            if meta is None:
-                return [], 0
-            wm, delete_wm = meta
-            if delete_wm > watermark:
-                return None
-            rows = self._conn.execute(
-                "SELECT namespace_id, object, relation, subject_id, "
-                "subject_set_namespace_id, subject_set_object, subject_set_relation, "
-                "commit_time FROM keto_relation_tuples "
-                "WHERE nid = ? AND commit_time > ?",
-                (self.network_id, watermark),
-            ).fetchall()
-        return [InternalRow(*r[:7], seq=r[7]) for r in rows], wm
-
-    def changes_since(self, watermark: int):
-        """Ordered mutations after ``watermark`` as ``(ops, new_watermark)``
-        with ops ``("ins", InternalRow) | ("del", key7)`` — the
-        tombstone-capable delta seam (see MemoryPersister.changes_since).
-        ``None`` when the delete log no longer reaches back that far.
-        Surviving rows' commit_time doubles as the insert log; within one
-        commit_time inserts order before deletes (the transact path deletes
-        after inserting, so a tuple inserted+deleted in one transaction
-        nets to deleted)."""
-        with self._lock:
-            meta = self._conn.execute(
-                "SELECT watermark, del_log_floor FROM keto_watermarks WHERE nid = ?",
-                (self.network_id,),
-            ).fetchone()
-            if meta is None:
-                return [], 0
-            wm, floor = meta
-            if floor > watermark:
-                return None
-            ins = self._conn.execute(
-                "SELECT namespace_id, object, relation, subject_id, "
-                "subject_set_namespace_id, subject_set_object, subject_set_relation, "
-                "commit_time FROM keto_relation_tuples "
-                "WHERE nid = ? AND commit_time > ?",
-                (self.network_id, watermark),
-            ).fetchall()
-            dels = self._conn.execute(
-                "SELECT namespace_id, object, relation, subject_id, "
-                "subject_set_namespace_id, subject_set_object, subject_set_relation, "
-                "commit_time FROM keto_tuple_delete_log "
-                "WHERE nid = ? AND commit_time > ?",
-                (self.network_id, watermark),
-            ).fetchall()
-        merged = sorted(
-            [(r[7], 0, ("ins", InternalRow(*r[:7], seq=r[7]))) for r in ins]
-            + [(r[7], 1, ("del", tuple(r[:7]))) for r in dels],
-            key=lambda t: (t[0], t[1]),
-        )
-        return [op for _, _, op in merged], wm
+    def _epoch_expr(self) -> str:
+        return "strftime('%s','now')"
 
 
 #: import alias

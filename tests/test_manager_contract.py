@@ -66,6 +66,31 @@ try:  # SQLite backends register themselves if present — the DSN matrix
 except ImportError:
     pass
 
+import os as _os
+
+_PG_DSN = _os.environ.get("KETO_TEST_POSTGRES_DSN", "")
+if _PG_DSN:
+    # the server-backed analog of the reference's dockerized Postgres /
+    # CockroachDB matrix (dsn_testutils.go:22-78): opt-in via env (CI
+    # provides a service container). The env var being SET means the
+    # operator expects postgres coverage — a broken driver/server must
+    # fail the run loudly, never silently shrink the matrix to sqlite.
+    from keto_tpu.persistence.postgres import PostgresPersister, connect_postgres
+
+    connect_postgres(_PG_DSN).close()  # probe driver + server; raises loudly
+
+    def make_postgres(network_id="default"):
+        p = PostgresPersister(
+            _PG_DSN, namespace_pkg.MemoryManager(NAMESPACES),
+            network_id=network_id, auto_migrate=False,
+        )
+        # fresh schema per test (one shared server database)
+        p.migrate_down(steps=10_000)
+        p.migrate_up()
+        return p
+
+    BACKENDS["postgres"] = make_postgres
+
 
 @pytest.fixture(params=sorted(BACKENDS))
 def persister(request):

@@ -1,0 +1,119 @@
+"""Postgres persister: dialect seams testable without a server.
+
+The full Manager behavior is the dialect-shared base
+(keto_tpu/persistence/sql_base.py), exercised line for line by the
+contract suite on sqlite; a live server run joins the matrix via
+KETO_TEST_POSTGRES_DSN (tests/test_manager_contract.py — CI provides a
+service container, mirroring the reference's dockertest gating).
+"""
+
+import pytest
+
+from keto_tpu.persistence import postgres, sql_base
+
+
+def test_dsn_normalization():
+    assert postgres._normalize_dsn("cockroach://u@h:26257/db") == "postgres://u@h:26257/db"
+    assert postgres._normalize_dsn("postgresql://u@h/db") == "postgres://u@h/db"
+    assert postgres._normalize_dsn("postgres://u@h/db") == "postgres://u@h/db"
+
+
+def test_null_safe_and_epoch_dialect():
+    p = postgres.PostgresPersister.__new__(postgres.PostgresPersister)
+    assert p._null_safe_eq("subject_id") == "subject_id IS NOT DISTINCT FROM ?"
+    assert "EPOCH" in p._epoch_expr()
+    assert p.PARAM == "%s"
+
+
+def test_order_by_rewrite_adds_nulls_first():
+    # postgres defaults to NULLS LAST; the rewrite must pin the sqlite
+    # (reference) NULLS FIRST semantics on every nullable subject column
+    assert "NULLS FIRST" not in sql_base._ORDER
+    assert "subject_set_namespace_id NULLS FIRST" in postgres._PG_ORDER
+    for col in ("subject_id", "subject_set_object", "subject_set_relation"):
+        assert f'{col} COLLATE "C" NULLS FIRST' in postgres._PG_ORDER
+    # the rewrite hook triggers on any query embedding the base ORDER BY
+    sql = f"SELECT * FROM keto_relation_tuples WHERE nid = ? {sql_base._ORDER} LIMIT ?"
+    rewritten = sql.replace(sql_base._ORDER, postgres._PG_ORDER)
+    assert "NULLS FIRST" in rewritten and "LIMIT" in rewritten
+
+
+def test_missing_driver_error_is_actionable(monkeypatch):
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_pg(name, *a, **k):
+        if name.split(".")[0] in ("psycopg", "psycopg2", "pg8000"):
+            raise ImportError(name)
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_pg)
+    with pytest.raises(RuntimeError, match="no postgres driver"):
+        postgres.connect_postgres("postgres://u@h/db")
+
+
+def test_registry_routes_postgres_dsn(monkeypatch):
+    """dsn=postgres://… reaches PostgresPersister (connection stubbed)."""
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.registry import Registry
+
+    class FakeCursor:
+        rowcount = 0
+
+        def execute(self, sql, params=()):
+            self.sql = sql
+
+        def executemany(self, sql, rows):
+            pass
+
+        def fetchone(self):
+            return None
+
+        def fetchall(self):
+            return []
+
+    class FakeConn:
+        autocommit = True
+
+        def cursor(self):
+            return FakeCursor()
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(postgres, "connect_postgres", lambda dsn: FakeConn())
+    cfg = Config(
+        overrides={
+            "dsn": "postgres://keto@127.0.0.1/keto",
+            "namespaces": [{"id": 1, "name": "g"}],
+        }
+    )
+    reg = Registry(cfg)
+    mgr = reg.relation_tuple_manager()
+    assert isinstance(mgr, postgres.PostgresPersister)
+    assert mgr.watermark() == 0  # rides the stubbed connection
+    cfg.close()
+
+
+def test_pg_order_rewrite_has_collate_c_and_nulls_first():
+    for col in ("object", "relation", "subject_id", "subject_set_object",
+                "subject_set_relation"):
+        assert f'{col} COLLATE "C"' in postgres._PG_ORDER
+
+
+def test_noop_transaction_does_not_bump_watermark():
+    """The atomic allocate-then-rollback path: deleting nonexistent tuples
+    must leave the watermark unchanged (shared base, driven on sqlite)."""
+    from keto_tpu import namespace as ns_pkg
+    from keto_tpu.persistence.sqlite import SQLitePersister
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID
+
+    nm = ns_pkg.MemoryManager([ns_pkg.Namespace(id=1, name="g")])
+    p = SQLitePersister("sqlite://:memory:", nm)
+    p.write_relation_tuples(RelationTuple("g", "o", "r", SubjectID("u")))
+    wm = p.watermark()
+    p.delete_relation_tuples(RelationTuple("g", "ghost", "r", SubjectID("nobody")))
+    assert p.watermark() == wm  # no-op rolled back, incl. the bump
+    p.delete_relation_tuples(RelationTuple("g", "o", "r", SubjectID("u")))
+    assert p.watermark() == wm + 1  # effective delete commits the bump
