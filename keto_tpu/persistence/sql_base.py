@@ -56,7 +56,7 @@ MIGRATIONS: list[tuple[str, str, str]] = [
             subject_set_namespace_id INTEGER NULL,
             subject_set_object TEXT NULL,
             subject_set_relation TEXT NULL,
-            commit_time INTEGER NOT NULL,
+            commit_time BIGINT NOT NULL,
             PRIMARY KEY (shard_id, nid),
             CHECK (
                 (subject_id IS NULL AND subject_set_namespace_id IS NOT NULL
@@ -102,7 +102,7 @@ MIGRATIONS: list[tuple[str, str, str]] = [
         """
         CREATE TABLE keto_watermarks (
             nid TEXT PRIMARY KEY,
-            watermark INTEGER NOT NULL DEFAULT 0
+            watermark BIGINT NOT NULL DEFAULT 0
         )
         """,
         "DROP TABLE keto_watermarks",
@@ -112,7 +112,7 @@ MIGRATIONS: list[tuple[str, str, str]] = [
         # (delta-overlayable, keto_tpu/graph/overlay.py) from ones that
         # removed rows (full rebuild) in O(1)
         "20210623000005_delete_watermark",
-        "ALTER TABLE keto_watermarks ADD COLUMN delete_wm INTEGER NOT NULL DEFAULT 0",
+        "ALTER TABLE keto_watermarks ADD COLUMN delete_wm BIGINT NOT NULL DEFAULT 0",
         "ALTER TABLE keto_watermarks DROP COLUMN delete_wm",
     ),
     (
@@ -132,7 +132,7 @@ MIGRATIONS: list[tuple[str, str, str]] = [
             subject_set_namespace_id INTEGER NULL,
             subject_set_object TEXT NULL,
             subject_set_relation TEXT NULL,
-            commit_time INTEGER NOT NULL
+            commit_time BIGINT NOT NULL
         )
         """,
         "DROP TABLE keto_tuple_delete_log",
@@ -147,7 +147,7 @@ MIGRATIONS: list[tuple[str, str, str]] = [
     ),
     (
         "20210623000008_delete_log_floor",
-        "ALTER TABLE keto_watermarks ADD COLUMN del_log_floor INTEGER NOT NULL DEFAULT 0",
+        "ALTER TABLE keto_watermarks ADD COLUMN del_log_floor BIGINT NOT NULL DEFAULT 0",
         "ALTER TABLE keto_watermarks DROP COLUMN del_log_floor",
     ),
     (
@@ -179,6 +179,15 @@ class SQLPersisterBase(Manager):
 
     #: DBAPI placeholder the dialect's driver expects
     PARAM = "?"
+    #: dialect-specific migrations appended after the shared list
+    EXTRA_MIGRATIONS: list[tuple[str, str, str]] = []
+
+    def _order_sql(self) -> str:
+        """The Manager ORDER BY — a composition-time dialect seam (postgres
+        needs NULLS FIRST + COLLATE "C" to match the byte-order semantics
+        of sqlite/memory; rewriting SQL text at execution time would fail
+        silently the day the base string changed)."""
+        return _ORDER
 
     def __init__(
         self,
@@ -268,10 +277,13 @@ class SQLPersisterBase(Manager):
         rows = self._exec("SELECT version FROM keto_migrations").fetchall()
         return {r[0] for r in rows}
 
+    def _all_migrations(self) -> list[tuple[str, str, str]]:
+        return MIGRATIONS + self.EXTRA_MIGRATIONS
+
     def migration_status(self) -> list[tuple[str, bool]]:
         with self._lock:
             applied = self._applied()
-            return [(v, v in applied) for v, _, _ in MIGRATIONS]
+            return [(v, v in applied) for v, _, _ in self._all_migrations()]
 
     @property
     def namespaces(self):
@@ -282,7 +294,7 @@ class SQLPersisterBase(Manager):
         with self._lock:
             applied = self._applied()
             n = 0
-            for version, up, _ in MIGRATIONS:
+            for version, up, _ in self._all_migrations():
                 if version in applied:
                     continue
                 self._exec(up)
@@ -298,7 +310,7 @@ class SQLPersisterBase(Manager):
         with self._lock:
             applied = self._applied()
             n = 0
-            for version, _, down in reversed(MIGRATIONS):
+            for version, _, down in reversed(self._all_migrations()):
                 if n >= steps:
                     break
                 if version not in applied:
@@ -382,7 +394,7 @@ class SQLPersisterBase(Manager):
             rows = self._exec(
                 f"SELECT namespace_id, object, relation, subject_id, subject_set_namespace_id, "
                 f"subject_set_object, subject_set_relation FROM keto_relation_tuples "
-                f"WHERE {where} {_ORDER} LIMIT ? OFFSET ?",
+                f"WHERE {where} {self._order_sql()} LIMIT ? OFFSET ?",
                 params + [per_page, (page - 1) * per_page],
             ).fetchall()
         total_pages = -(-total // per_page)
@@ -562,7 +574,7 @@ class SQLPersisterBase(Manager):
                 raw = self._exec(
                     f"SELECT namespace_id, object, relation, subject_id, subject_set_namespace_id, "
                     f"subject_set_object, subject_set_relation, commit_time FROM keto_relation_tuples "
-                    f"WHERE nid = ? {_ORDER}",
+                    f"WHERE nid = ? {self._order_sql()}",
                     (self.network_id,),
                 ).fetchall()
                 rows = [InternalRow(*r[:7], seq=r[7]) for r in raw]

@@ -25,17 +25,20 @@ def test_null_safe_and_epoch_dialect():
     assert p.PARAM == "%s"
 
 
-def test_order_by_rewrite_adds_nulls_first():
-    # postgres defaults to NULLS LAST; the rewrite must pin the sqlite
-    # (reference) NULLS FIRST semantics on every nullable subject column
+def test_order_seam_pins_nulls_first_and_collation():
+    # postgres defaults to NULLS LAST + locale collation; the dialect's
+    # _order_sql override must pin the sqlite (reference) semantics, and a
+    # matching C-collated index migration must exist so the sort is an
+    # index walk
     assert "NULLS FIRST" not in sql_base._ORDER
     assert "subject_set_namespace_id NULLS FIRST" in postgres._PG_ORDER
     for col in ("subject_id", "subject_set_object", "subject_set_relation"):
         assert f'{col} COLLATE "C" NULLS FIRST' in postgres._PG_ORDER
-    # the rewrite hook triggers on any query embedding the base ORDER BY
-    sql = f"SELECT * FROM keto_relation_tuples WHERE nid = ? {sql_base._ORDER} LIMIT ?"
-    rewritten = sql.replace(sql_base._ORDER, postgres._PG_ORDER)
-    assert "NULLS FIRST" in rewritten and "LIMIT" in rewritten
+    p = postgres.PostgresPersister.__new__(postgres.PostgresPersister)
+    assert p._order_sql() == postgres._PG_ORDER
+    names = [v for v, _, _ in postgres.PostgresPersister.EXTRA_MIGRATIONS]
+    assert "20210623000100_pg_c_order_idx" in names
+    assert 'COLLATE "C" NULLS FIRST' in postgres.PostgresPersister.EXTRA_MIGRATIONS[0][1]
 
 
 def test_missing_driver_error_is_actionable(monkeypatch):
