@@ -174,6 +174,51 @@ _ORDER = (
 )
 
 
+def _apply_delete_ops(rows: list, dels) -> list:
+    """Apply delete-log entries to an ORDER BY-sorted row list: a row is
+    deleted iff some delete of its key7 committed at-or-after the row's
+    commit_time (the transact path deletes after inserting, so same-
+    transaction inserts are covered). Rows of one key7 are CONTIGUOUS in
+    the sort order with commit_time ascending last, so each delete key
+    bisects to its range and removes a seq-prefix; survivors re-assemble
+    from slices (memcpy-speed) — no per-row key computation over the
+    whole list."""
+    import bisect
+
+    if not dels:
+        return rows
+    # max delete time per key7 (a row survives iff seq > every delete of
+    # its key, i.e. iff seq > max T)
+    max_t: dict[tuple, int] = {}
+    for r in dels:
+        k = tuple(r[:7])
+        t = r[7]
+        if max_t.get(k, -1) < t:
+            max_t[k] = t
+    cut: list[tuple[int, int]] = []
+    key = InternalRow.sort_key
+    for k7, t in max_t.items():
+        # bisect needles derive from sort_key itself (ONE definition of
+        # the NULL encoding — a hand-built copy would silently stop
+        # matching the day the encoding changes)
+        lo = InternalRow(*k7, seq=-1).sort_key()
+        hi = InternalRow(*k7, seq=t).sort_key()
+        a = bisect.bisect_left(rows, lo, key=key)
+        b = bisect.bisect_right(rows, hi, key=key)
+        if a < b:
+            cut.append((a, b))
+    if not cut:
+        return rows
+    cut.sort()
+    out: list = []
+    prev = 0
+    for a, b in cut:
+        out.extend(rows[prev:a])
+        prev = b
+    out.extend(rows[prev:])
+    return out
+
+
 class SQLPersisterBase(Manager):
     """Dialect-independent SQL persister core (see module docstring)."""
 
@@ -529,9 +574,12 @@ class SQLPersisterBase(Manager):
 
         Rows come back in the Manager's ORDER BY (the expand engine's
         tree-child order rides on snapshot row order — see the interner
-        dedup note). Insert-only watermark advances extend the in-process
-        cache via the commit_time log, merge-inserted to keep the order;
-        deletes (delete_wm moved) fall back to the full ordered read."""
+        dedup note). Watermark advances extend the in-process cache via
+        the commit_time log: inserts linear-merge in, and deletes splice
+        their key's contiguous row range out via the delete log (a row is
+        deleted iff some delete of its key committed at-or-after its own
+        commit_time) — a full re-read only happens when the delete log no
+        longer reaches back to the cache watermark."""
         import heapq
 
         with self._lock:
@@ -543,16 +591,20 @@ class SQLPersisterBase(Manager):
             self._begin_snapshot_read()
             try:
                 meta = self._exec(
-                    "SELECT watermark, delete_wm FROM keto_watermarks WHERE nid = ?",
+                    "SELECT watermark, delete_wm, del_log_floor "
+                    "FROM keto_watermarks WHERE nid = ?",
                     (self.network_id,),
                 ).fetchone()
-                wm, delete_wm = meta if meta else (0, 0)
+                wm, delete_wm, del_floor = meta if meta else (0, 0, 0)
                 cache = self._snap_cache
                 if cache is not None:
                     c_rows, c_wm = cache
                     if c_wm == wm:
                         return list(c_rows), wm
-                    if delete_wm <= c_wm:
+                    # floor < delete_wm always (set together on delete
+                    # transactions), so this single test also covers the
+                    # delete-free case
+                    if del_floor <= c_wm:
                         new = self._exec(
                             "SELECT namespace_id, object, relation, subject_id, "
                             "subject_set_namespace_id, subject_set_object, "
@@ -569,6 +621,16 @@ class SQLPersisterBase(Manager):
                         rows = list(
                             heapq.merge(c_rows, new_rows, key=InternalRow.sort_key)
                         )
+                        if delete_wm > c_wm:
+                            dels = self._exec(
+                                "SELECT namespace_id, object, relation, subject_id, "
+                                "subject_set_namespace_id, subject_set_object, "
+                                "subject_set_relation, commit_time "
+                                "FROM keto_tuple_delete_log "
+                                "WHERE nid = ? AND commit_time > ?",
+                                (self.network_id, c_wm),
+                            ).fetchall()
+                            rows = _apply_delete_ops(rows, dels)
                         self._snap_cache = (rows, wm)
                         return list(rows), wm
                 raw = self._exec(
