@@ -1,0 +1,59 @@
+// Typed surface of @keto-tpu/grpc-client (ory.keto.acl.v1alpha1 contract).
+import type { ChannelCredentials, Client } from "@grpc/grpc-js";
+
+export interface SubjectSet {
+  namespace: string;
+  object: string;
+  relation: string;
+}
+export interface Subject {
+  id?: string;
+  set?: SubjectSet;
+}
+export interface RelationTuple {
+  namespace: string;
+  object: string;
+  relation: string;
+  subject: Subject;
+}
+export interface CheckRequest {
+  namespace: string;
+  object: string;
+  relation: string;
+  subject: Subject;
+  /** read-your-writes when true */
+  latest?: boolean;
+  /** serve at least as fresh as this token (from a write response) */
+  snaptoken?: string;
+}
+export interface CheckResponse {
+  allowed: boolean;
+  /** id of the snapshot that decided — REAL in keto-tpu, stubbed upstream */
+  snaptoken: string;
+}
+export interface RelationTupleDelta {
+  action: "INSERT" | "DELETE" | number;
+  relation_tuple: RelationTuple;
+}
+
+export interface ReadClients {
+  check: Client & {
+    Check(req: CheckRequest, cb: (err: Error | null, resp: CheckResponse) => void): void;
+  };
+  expand: Client;
+  read: Client;
+  version: Client;
+}
+export interface WriteClients {
+  write: Client & {
+    TransactRelationTuples(
+      req: { relation_tuple_deltas: RelationTupleDelta[] },
+      cb: (err: Error | null, resp: { snaptokens: string[] }) => void
+    ): void;
+  };
+  version: Client;
+}
+
+export function loadPackage(): unknown;
+export function readClient(address: string, credentials?: ChannelCredentials): ReadClients;
+export function writeClient(address: string, credentials?: ChannelCredentials): WriteClients;

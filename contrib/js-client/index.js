@@ -1,0 +1,72 @@
+// gRPC client for keto-tpu — the analog of the reference's published npm
+// stubs (reference proto/ory/keto/acl/v1alpha1/*_pb.js). Rather than
+// checked-in codegen output, this loads the SAME wire-compatible .proto
+// contract at runtime via @grpc/proto-loader (the grpc-js ecosystem's
+// recommended path), so the package always matches the server's protos.
+//
+// Usage:
+//   const { readClient, writeClient } = require("@keto-tpu/grpc-client");
+//   const read = readClient("127.0.0.1:4466");
+//   read.check.Check({ namespace: "videos", object: "/cats/1.mp4",
+//                      relation: "view", subject: { id: "cat lady" } },
+//                    (err, resp) => console.log(resp.allowed, resp.snaptoken));
+"use strict";
+
+const fs = require("fs");
+const path = require("path");
+const grpc = require("@grpc/grpc-js");
+const protoLoader = require("@grpc/proto-loader");
+
+// packed tarballs vendor proto/ (package.json prepack); in-repo use reads
+// the repo-root contract directly — one source of truth, no checked-in copy
+const PROTO_DIR = fs.existsSync(path.join(__dirname, "proto", "ory"))
+  ? path.join(__dirname, "proto")
+  : path.join(__dirname, "..", "..", "proto");
+const FILES = [
+  "ory/keto/acl/v1alpha1/acl.proto",
+  "ory/keto/acl/v1alpha1/check_service.proto",
+  "ory/keto/acl/v1alpha1/expand_service.proto",
+  "ory/keto/acl/v1alpha1/read_service.proto",
+  "ory/keto/acl/v1alpha1/write_service.proto",
+  "ory/keto/acl/v1alpha1/version.proto",
+];
+
+let _pkg = null;
+function loadPackage() {
+  if (_pkg === null) {
+    const def = protoLoader.loadSync(FILES, {
+      includeDirs: [PROTO_DIR],
+      keepCase: true,
+      longs: String,
+      enums: String,
+      defaults: true,
+      oneofs: true,
+    });
+    _pkg = grpc.loadPackageDefinition(def).ory.keto.acl.v1alpha1;
+  }
+  return _pkg;
+}
+
+/** Clients for the read API (:4466): Check, Expand, ListRelationTuples. */
+function readClient(address, credentials) {
+  const pkg = loadPackage();
+  const creds = credentials || grpc.credentials.createInsecure();
+  return {
+    check: new pkg.CheckService(address, creds),
+    expand: new pkg.ExpandService(address, creds),
+    read: new pkg.ReadService(address, creds),
+    version: new pkg.VersionService(address, creds),
+  };
+}
+
+/** Clients for the write API (:4467): TransactRelationTuples. */
+function writeClient(address, credentials) {
+  const pkg = loadPackage();
+  const creds = credentials || grpc.credentials.createInsecure();
+  return {
+    write: new pkg.WriteService(address, creds),
+    version: new pkg.VersionService(address, creds),
+  };
+}
+
+module.exports = { loadPackage, readClient, writeClient };
