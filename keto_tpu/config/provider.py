@@ -44,6 +44,7 @@ _DEFAULTS: dict[str, Any] = {
     "serve": {
         "read": {"host": "", "port": 4466},  # reference provider.go:112-118
         "write": {"host": "", "port": 4467},  # reference provider.go:120-126
+        "http_backend": "async",
     },
     "namespaces": [],
     "engine": {
@@ -70,6 +71,7 @@ _ENV_KEYS = [
     "serve.read.port",
     "serve.write.host",
     "serve.write.port",
+    "serve.http_backend",
     "namespaces",
     "engine.backend",
     "engine.batch_size",

@@ -49,6 +49,12 @@ CONFIG_SCHEMA = {
                         "port": {"type": "integer", "default": 4467},
                     },
                 },
+                "http_backend": {
+                    "type": "string",
+                    "enum": ["async", "threading"],
+                    "default": "async",
+                    "description": "REST backend behind the port mux: 'async' (one asyncio reactor, keep-alive, bounded handler pool) or 'threading' (stdlib thread-per-connection).",
+                },
             },
         },
         "namespaces": {

@@ -19,9 +19,21 @@ from keto_tpu.servers.native_mux import make_port_mux
 from keto_tpu.servers.rest import READ, WRITE, RestServer
 
 
+def make_rest_server(registry, role: str, host: str = "127.0.0.1", port: int = 0):
+    """REST backend per ``serve.http_backend``: the asyncio reactor
+    (default — one event loop, bounded handler pool) or the stdlib
+    thread-per-connection server."""
+    backend = registry.config().get("serve.http_backend", "async")
+    if backend == "threading":
+        return RestServer(registry, role, host=host, port=port)
+    from keto_tpu.servers.async_rest import AsyncRestServer
+
+    return AsyncRestServer(registry, role, host=host, port=port)
+
+
 @dataclass
 class _RoleServers:
-    rest: RestServer
+    rest: object  # RestServer or AsyncRestServer
     grpc_server: object
     mux: object  # NativePortMux or PortMux
 
@@ -38,7 +50,7 @@ class Daemon:
         self._roles: dict[str, _RoleServers] = {}
 
     def _start_role(self, role: str, host: str, port: int) -> _RoleServers:
-        rest = RestServer(self.registry, role, host="127.0.0.1", port=0)
+        rest = make_rest_server(self.registry, role, host="127.0.0.1", port=0)
         rest.start()
         grpc_server, grpc_port = build_grpc_server(self.registry, role)
         grpc_server.start()

@@ -1,0 +1,241 @@
+"""Event-loop REST backend: one reactor, bounded handler pool.
+
+The stdlib ``ThreadingHTTPServer`` backend (keto_tpu/servers/rest.py)
+spends a thread per CONNECTION — fine for parity tests, thin behind the
+serving-grade C++ epoll mux (native/mux.cpp). This backend serves the
+same ``RestApp`` routes from one asyncio reactor: connections cost a
+coroutine, HTTP/1.1 keep-alive is honored, and handler execution (which
+blocks on engine futures) runs on a BOUNDED thread pool — concurrency
+backpressure lands in the pool's queue instead of in an unbounded thread
+count. Selected via ``serve.http_backend`` (default ``async``;
+``threading`` keeps the stdlib backend).
+
+Protocol scope matches the reference surface: Content-Length bodies
+(no chunked requests), small JSON responses, no upgrades.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from http import HTTPStatus
+from typing import Optional
+from urllib.parse import parse_qs, urlsplit
+
+from keto_tpu.servers.rest import RestApp
+
+_MAX_HEAD = 64 * 1024
+_MAX_BODY = 64 * 1024 * 1024
+
+_REASONS = {s.value: s.phrase for s in HTTPStatus}
+
+
+class AsyncRestServer:
+    """Drop-in for ``RestServer`` (same constructor surface, ``port``,
+    ``start``/``stop``) on an asyncio reactor."""
+
+    def __init__(
+        self,
+        registry,
+        role: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        workers: int = 32,
+    ):
+        self.app = RestApp(registry, role)
+        self._host = host or "0.0.0.0"
+        self._want_port = port
+        self._port: Optional[int] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._ready = threading.Event()
+        self._boot_error: Optional[BaseException] = None
+        self._conns: set[asyncio.StreamWriter] = set()
+        self._pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix=f"rest-{role}"
+        )
+
+    @property
+    def port(self) -> int:
+        assert self._port is not None, "server not started"
+        return self._port
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"rest-async-{self.app.role}", daemon=True
+        )
+        self._thread.start()
+        if not self._ready.wait(timeout=10):
+            raise RuntimeError("async REST server failed to start (timeout)")
+        if self._boot_error is not None:
+            raise RuntimeError(
+                f"async REST server failed to start: {self._boot_error!r}"
+            ) from self._boot_error
+
+    def _run(self) -> None:
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            self._server = await asyncio.start_server(
+                self._serve_connection, self._host, self._want_port,
+                limit=_MAX_HEAD,
+            )
+            self._port = self._server.sockets[0].getsockname()[1]
+
+        try:
+            try:
+                loop.run_until_complete(boot())
+            except BaseException as e:  # bind failures etc. → surface in start()
+                self._boot_error = e
+                return
+            finally:
+                self._ready.set()
+            loop.run_forever()
+        finally:
+            loop.close()
+
+    def stop(self) -> None:
+        loop = self._loop
+        if loop is None or not loop.is_running():
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            return
+
+        async def teardown():
+            if self._server is not None:
+                self._server.close()
+                # idle keep-alive connections would make wait_closed()
+                # (which on 3.12+ waits for EVERY connection) hang forever
+                # — abort them; in-flight handlers see a reset, matching
+                # what a process exit would do anyway
+                for w in list(self._conns):
+                    try:
+                        w.transport.abort()
+                    except Exception:
+                        pass
+                try:
+                    await asyncio.wait_for(self._server.wait_closed(), timeout=3)
+                except (TimeoutError, asyncio.TimeoutError):
+                    pass
+            loop.stop()
+
+        asyncio.run_coroutine_threadsafe(teardown(), loop)
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    # -- per-connection ------------------------------------------------------
+
+    async def _serve_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._conns.add(writer)
+        try:
+            while True:
+                head = await self._read_head(reader)
+                if head is None:
+                    return  # EOF / oversized / malformed — drop quietly
+                method, target, version, headers = head
+                if "transfer-encoding" in headers:
+                    # out of protocol scope (module doc): REJECT with
+                    # correct framing — parsing chunk framing as the next
+                    # request head would desync the connection
+                    await self._write_response(
+                        writer, 501,
+                        {"error": {"message": "chunked requests unsupported"}},
+                        {}, True,
+                    )
+                    return
+                if method == "HEAD":
+                    # RestApp has no HEAD routes and a HEAD response must
+                    # not carry a body (a client would misparse the next
+                    # response) — cleanly framed 501 + close, matching the
+                    # stdlib backend
+                    await self._write_response(writer, 501, None, {}, True)
+                    return
+                length = int(headers.get("content-length") or 0)
+                if length < 0 or length > _MAX_BODY:
+                    await self._write_response(
+                        writer, 413, {"error": {"message": "body too large"}}, {}, True
+                    )
+                    return
+                body = await reader.readexactly(length) if length else b""
+                parts = urlsplit(target)
+                query = parse_qs(parts.query, keep_blank_values=True)
+                status, payload, extra = await asyncio.get_running_loop().run_in_executor(
+                    self._pool, self.app.handle, method, parts.path, query, body
+                )
+                close = (
+                    version == "HTTP/1.0"
+                    or headers.get("connection", "").lower() == "close"
+                )
+                await self._write_response(writer, status, payload, extra, close)
+                if close:
+                    return
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            BrokenPipeError,
+            asyncio.LimitOverrunError,
+        ):
+            pass
+        except Exception:
+            # handler exceptions are already mapped to 500 envelopes inside
+            # RestApp; anything surfacing here is a protocol-level failure
+            pass
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    @staticmethod
+    async def _read_head(reader: asyncio.StreamReader):
+        """(method, target, version, lowercase header dict) or None."""
+        try:
+            # the stream limit (start_server limit=_MAX_HEAD) bounds the
+            # head size: oversized heads raise LimitOverrunError here
+            raw = await reader.readuntil(b"\r\n\r\n")
+        except asyncio.IncompleteReadError:
+            return None
+        except asyncio.LimitOverrunError:
+            return None
+        try:
+            lines = raw.decode("latin-1").split("\r\n")
+            method, target, version = lines[0].split(" ", 2)
+            headers: dict[str, str] = {}
+            for line in lines[1:]:
+                if not line:
+                    continue
+                k, _, v = line.partition(":")
+                headers[k.strip().lower()] = v.strip()
+            return method.upper(), target, version.strip(), headers
+        except ValueError:
+            return None
+
+    async def _write_response(
+        self, writer: asyncio.StreamWriter, status: int, payload, extra: dict,
+        close: bool,
+    ) -> None:
+        data = b"" if payload is None else json.dumps(payload).encode()
+        reason = _REASONS.get(status, "")
+        head = [
+            f"HTTP/1.1 {status} {reason}",
+            "Content-Type: application/json",
+            f"Content-Length: {len(data)}",
+            "Server: keto-tpu",
+        ]
+        for k, v in extra.items():
+            head.append(f"{k}: {v}")
+        head.append("Connection: close" if close else "Connection: keep-alive")
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + data)
+        await writer.drain()

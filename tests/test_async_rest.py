@@ -1,0 +1,171 @@
+"""Asyncio REST backend: protocol behavior the stdlib backend gave for free.
+
+The route logic itself is RestApp (shared, covered by tests/test_rest_api.py
+and the e2e suite — which exercises THIS backend through the daemon's
+default); these tests pin the reactor-level protocol: keep-alive reuse,
+connection-close honoring, oversized bodies, malformed requests, and the
+config selection seam.
+"""
+
+import http.client
+import json
+
+import pytest
+
+from keto_tpu.config.provider import Config
+from keto_tpu.driver.registry import Registry
+from keto_tpu.servers.async_rest import AsyncRestServer
+from keto_tpu.servers.rest import READ, WRITE
+
+
+@pytest.fixture
+def servers():
+    cfg = Config(overrides={"namespaces": [{"id": 0, "name": "videos"}]})
+    reg = Registry(cfg)
+    read = AsyncRestServer(reg, READ, port=0)
+    write = AsyncRestServer(reg, WRITE, port=0)
+    read.start()
+    write.start()
+    yield read, write
+    read.stop()
+    write.stop()
+    reg.close()
+
+
+def test_keep_alive_reuses_one_connection(servers):
+    read, write = servers
+    conn = http.client.HTTPConnection("127.0.0.1", write.port)
+    try:
+        for i in range(5):
+            body = json.dumps(
+                {"namespace": "videos", "object": f"v{i}", "relation": "view",
+                 "subject_id": "alice"}
+            )
+            conn.request("PUT", "/relation-tuples", body=body)
+            resp = conn.getresponse()
+            assert resp.status == 201
+            resp.read()
+            assert resp.headers.get("Connection") == "keep-alive"
+        # same socket served all five requests
+        assert conn.sock is not None
+    finally:
+        conn.close()
+
+    conn = http.client.HTTPConnection("127.0.0.1", read.port)
+    try:
+        conn.request("GET", "/check?namespace=videos&object=v1&relation=view&subject_id=alice")
+        resp = conn.getresponse()
+        assert resp.status == 200 and json.loads(resp.read())["allowed"] is True
+        conn.request("GET", "/check?namespace=videos&object=v1&relation=view&subject_id=bob")
+        resp = conn.getresponse()
+        assert resp.status == 403
+        resp.read()
+    finally:
+        conn.close()
+
+
+def test_stop_with_idle_keepalive_connection(servers):
+    """stop() must not hang on an idle keep-alive connection (3.12+
+    wait_closed waits for every connection; teardown aborts them)."""
+    import time
+
+    from keto_tpu.config.provider import Config as _C
+    from keto_tpu.driver.registry import Registry as _R
+
+    cfg = _C(overrides={"namespaces": [{"id": 0, "name": "videos"}]})
+    reg = _R(cfg)
+    srv = AsyncRestServer(reg, READ, port=0)
+    srv.start()
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port)
+    conn.request("GET", "/health/alive")
+    conn.getresponse().read()  # keep-alive: socket stays open and idle
+    t0 = time.monotonic()
+    srv.stop()
+    assert time.monotonic() - t0 < 4.5, "stop() hung on an idle connection"
+    conn.close()
+    reg.close()
+
+
+def test_chunked_and_head_rejected_with_framing(servers):
+    read, _ = servers
+    import socket
+
+    s = socket.create_connection(("127.0.0.1", read.port), timeout=10)
+    try:
+        s.sendall(b"POST /check HTTP/1.1\r\nHost: x\r\nTransfer-Encoding: chunked\r\n\r\n")
+        assert b"501" in s.recv(4096).split(b"\r\n", 1)[0]
+    finally:
+        s.close()
+    conn = http.client.HTTPConnection("127.0.0.1", read.port)
+    try:
+        conn.request("HEAD", "/health/alive")
+        resp = conn.getresponse()
+        assert resp.status == 501
+        assert resp.read() == b""  # HEAD: correctly framed, no body
+    finally:
+        conn.close()
+
+
+def test_connection_close_honored(servers):
+    read, _ = servers
+    conn = http.client.HTTPConnection("127.0.0.1", read.port)
+    try:
+        conn.request("GET", "/health/alive", headers={"Connection": "close"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.headers.get("Connection") == "close"
+        resp.read()
+    finally:
+        conn.close()
+
+
+def test_oversized_body_rejected(servers):
+    read, _ = servers
+    import socket
+
+    s = socket.create_connection(("127.0.0.1", read.port), timeout=10)
+    try:
+        s.sendall(
+            b"POST /check HTTP/1.1\r\nHost: x\r\nContent-Length: 99999999999\r\n\r\n"
+        )
+        data = s.recv(4096)
+        assert b"413" in data.split(b"\r\n", 1)[0]
+    finally:
+        s.close()
+
+
+def test_malformed_request_drops_quietly(servers):
+    read, _ = servers
+    import socket
+
+    s = socket.create_connection(("127.0.0.1", read.port), timeout=10)
+    try:
+        s.sendall(b"garbage\r\n\r\n")
+        assert s.recv(4096) == b""  # connection closed, no crash
+    finally:
+        s.close()
+    # the server still serves afterwards
+    conn = http.client.HTTPConnection("127.0.0.1", read.port)
+    try:
+        conn.request("GET", "/health/ready")
+        assert conn.getresponse().status == 200
+    finally:
+        conn.close()
+
+
+def test_backend_config_selection():
+    from keto_tpu.driver.daemon import make_rest_server
+    from keto_tpu.servers.rest import RestServer
+
+    cfg = Config(overrides={"namespaces": [], "serve.http_backend": "threading"})
+    reg = Registry(cfg)
+    srv = make_rest_server(reg, READ)
+    assert isinstance(srv, RestServer)
+    srv.httpd.server_close()  # bound in __init__ — do not leak the socket
+    reg.close()
+    cfg2 = Config(overrides={"namespaces": []})
+    reg2 = Registry(cfg2)
+    srv2 = make_rest_server(reg2, READ)
+    assert isinstance(srv2, AsyncRestServer)
+    srv2.stop()  # never started: releases the handler pool
+    reg2.close()
