@@ -88,6 +88,10 @@ class Daemon:
         return self._roles[WRITE].port
 
     def shutdown(self) -> None:
+        """Stop muxes, drain backends, close the registry. Idempotent —
+        callers (tests, signal handlers) may race a second invocation."""
+        if not self._roles:
+            return
         for role in self._roles.values():
             role.mux.stop()
         for role in self._roles.values():

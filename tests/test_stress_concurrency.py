@@ -19,6 +19,7 @@ this drives the real concurrency instead:
 import os
 import random
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -51,6 +52,19 @@ def _seed_store(p, rng):
         tuples.append(T("d", f"doc{d}", "view", SubjectSet("g", f"grp{d % 10}", "m")))
     p.write_relation_tuples(*tuples)
     return users
+
+
+def _check_params(q: RelationTuple) -> str:
+    """/check query string for a SubjectID query (one definition for every
+    stress client)."""
+    return urllib.parse.urlencode(
+        {
+            "namespace": q.namespace,
+            "object": q.object,
+            "relation": q.relation,
+            "subject_id": q.subject.id,
+        }
+    )
 
 
 def _rand_query(rng, users):
@@ -152,14 +166,7 @@ def test_daemon_mux_stress(stress_daemon):
     stop = threading.Event()
 
     def rest_check(q: RelationTuple) -> bool:
-        params = urllib.parse.urlencode(
-            {
-                "namespace": q.namespace,
-                "object": q.object,
-                "relation": q.relation,
-                "subject_id": q.subject.id,
-            }
-        )
+        params = _check_params(q)
         try:
             r = urllib.request.urlopen(
                 f"http://127.0.0.1:{d.read_port}/check?{params}", timeout=60
@@ -193,3 +200,63 @@ def test_daemon_mux_stress(stress_daemon):
     for _ in range(60):
         q = _rand_query(rng, users)
         assert rest_check(q) == oracle.subject_is_allowed(q), f"divergence on {q}"
+
+
+def test_daemon_keepalive_stress(stress_daemon):
+    """Persistent keep-alive connections (client pooling) hammering the
+    async REST backend through the mux while the store mutates: one
+    socket per client serves its whole request stream, every response is
+    a decision, and shutdown afterwards must not hang on the pooled
+    (still-open) connections."""
+    import http.client
+    import json as json_mod
+
+    d, reg = stress_daemon
+    rng = random.Random(17)
+    p = reg.relation_tuple_manager()
+    users = _seed_store(p, rng)
+
+    errors: list = []
+    stop = threading.Event()
+    held_open: list = []
+
+    def client(seed):
+        crng = random.Random(seed)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", d.read_port, timeout=60)
+            for _ in range(N_REQUESTS):
+                q = _rand_query(crng, users)
+                conn.request("GET", f"/check?{_check_params(q)}")
+                r = conn.getresponse()
+                body = r.read()
+                if r.status not in (200, 403):
+                    errors.append(("status", r.status, body[:200]))
+                    stop.set()
+                    return
+                if json_mod.loads(body).get("allowed") not in (True, False):
+                    errors.append(("body", body[:200]))
+                    stop.set()
+                    return
+            held_open.append(conn)  # keep the socket open into shutdown
+        except Exception as e:
+            errors.append(("client", repr(e)))
+            stop.set()
+
+    threads = [threading.Thread(target=client, args=(300 + i,)) for i in range(N_CLIENTS)]
+    threads.append(threading.Thread(target=_writer, args=(p, random.Random(13), stop, errors)))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+        assert not t.is_alive(), "stress thread hung (deadlock)"
+    stop.set()
+    assert not errors, errors[:5]
+    assert held_open, "no client completed its stream"
+    # shut down WHILE the pooled sockets are provably open (they live in
+    # held_open until after the assertion below): the async backend must
+    # abort idle keep-alive connections instead of hanging
+    t0 = time.monotonic()
+    d.shutdown()
+    assert time.monotonic() - t0 < 15, "shutdown hung on pooled keep-alive sockets"
+    for conn in held_open:
+        conn.close()
