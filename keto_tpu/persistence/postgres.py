@@ -57,9 +57,31 @@ def _normalize_dsn(dsn: str) -> str:
     return dsn
 
 
-def connect_postgres(dsn: str):
+def connect_postgres(dsn: str, max_wait_s: float = 300.0):
     """Open an autocommit DBAPI connection with whichever postgres driver
-    the host has (psycopg v3 → psycopg2 → pg8000)."""
+    the host has (psycopg v3 → psycopg2 → pg8000), dialing with
+    exponential backoff up to ``max_wait_s`` — the reference retries its
+    database dial for up to five minutes the same way (reference
+    internal/driver/pop_connection.go:38-63; servers routinely boot
+    before their database accepts connections). A missing DRIVER fails
+    immediately (retrying cannot install one)."""
+    import time
+
+    deadline = time.monotonic() + max_wait_s
+    delay = 0.2
+    while True:
+        try:
+            return _connect_postgres_once(dsn)
+        except RuntimeError:
+            raise  # no driver — not retryable
+        except Exception:
+            if time.monotonic() + delay > deadline:
+                raise
+            time.sleep(delay)
+            delay = min(delay * 2, 10.0)
+
+
+def _connect_postgres_once(dsn: str):
     dsn = _normalize_dsn(dsn)
     try:
         import psycopg  # type: ignore

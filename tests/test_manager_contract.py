@@ -77,7 +77,9 @@ if _PG_DSN:
     # fail the run loudly, never silently shrink the matrix to sqlite.
     from keto_tpu.persistence.postgres import PostgresPersister, connect_postgres
 
-    connect_postgres(_PG_DSN).close()  # probe driver + server; raises loudly
+    # probe driver + server; raises loudly (short dial window — the CI
+    # service container is health-checked before tests start)
+    connect_postgres(_PG_DSN, max_wait_s=15).close()
 
     def make_postgres(network_id="default"):
         p = PostgresPersister(

@@ -53,7 +53,7 @@ def test_missing_driver_error_is_actionable(monkeypatch):
 
     monkeypatch.setattr(builtins, "__import__", no_pg)
     with pytest.raises(RuntimeError, match="no postgres driver"):
-        postgres.connect_postgres("postgres://u@h/db")
+        postgres.connect_postgres("postgres://u@h/db", max_wait_s=1)
 
 
 def test_registry_routes_postgres_dsn(monkeypatch):
@@ -85,7 +85,7 @@ def test_registry_routes_postgres_dsn(monkeypatch):
         def close(self):
             pass
 
-    monkeypatch.setattr(postgres, "connect_postgres", lambda dsn: FakeConn())
+    monkeypatch.setattr(postgres, "connect_postgres", lambda dsn, **kw: FakeConn())
     cfg = Config(
         overrides={
             "dsn": "postgres://keto@127.0.0.1/keto",
@@ -161,3 +161,31 @@ def test_snapshot_cache_extends_through_deletes(tmp_path):
         assert [r.key7() + (r.seq,) for r in cached] == [
             r.key7() + (r.seq,) for r in cold
         ], f"cache drift at round {round_}"
+
+
+def test_dial_backoff_retries_then_succeeds(monkeypatch):
+    """The reference dials its database with exponential backoff
+    (pop_connection.go:38-63); server-down-then-up must connect."""
+    attempts = []
+
+    def flaky_once(dsn):
+        attempts.append(dsn)
+        if len(attempts) < 3:
+            raise ConnectionRefusedError("server still booting")
+        return "CONN"
+
+    monkeypatch.setattr(postgres, "_connect_postgres_once", flaky_once)
+    assert postgres.connect_postgres("postgres://u@h/db", max_wait_s=30) == "CONN"
+    assert len(attempts) == 3
+
+    # missing driver is NOT retried
+    calls = []
+
+    def no_driver(dsn):
+        calls.append(dsn)
+        raise RuntimeError("no postgres driver available")
+
+    monkeypatch.setattr(postgres, "_connect_postgres_once", no_driver)
+    with pytest.raises(RuntimeError):
+        postgres.connect_postgres("postgres://u@h/db", max_wait_s=30)
+    assert len(calls) == 1
