@@ -320,6 +320,53 @@ def make_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T):
     return [q for q, _ in pairs], [e for _, e in pairs]
 
 
+def stream_pass(engine, snap, queries, tag):
+    """Adaptive streamed pass (the serving path's default): the engine's
+    latency controller sizes slices toward serve.stream_slice_target_ms.
+    Every ladder geometry pre-warms so no compile lands in the timed
+    window; per-slice latency is measured two ways — caller-visible
+    inter-yield gaps (first yield excluded: it absorbs pipeline fill) and
+    the engine's own DurationStats, the numbers the controller steers by.
+    Returns ``(decisions, metrics)``."""
+    import numpy as _np
+
+    for w in engine.stream_widths(snap):
+        engine.batch_check(queries[:w])
+    engine.stream_slice_stats.reset()
+    slice_lat = []
+    outs = []
+    t_start = time.perf_counter()
+    t_prev = t_start
+    for out in engine.batch_check_stream(iter(queries)):
+        now = time.perf_counter()
+        slice_lat.append(now - t_prev)
+        t_prev = now
+        outs.append(out)
+    total_s = time.perf_counter() - t_start
+    got = _np.concatenate(outs)
+    steady = sorted(slice_lat[1:]) or slice_lat
+    p50 = steady[len(steady) // 2] * 1e3
+    p99 = steady[min(len(steady) - 1, int(len(steady) * 0.99))] * 1e3
+    svc = engine.stream_slice_stats.snapshot()
+    ctrl = engine.stream_ctrl.snapshot()
+    log(
+        f"[{tag}] stream (adaptive): {got.shape[0]/total_s:,.0f} checks/s; "
+        f"slice p50={p50:.0f} ms p99={p99:.0f} ms "
+        f"(service p50={svc['p50_ms']:.0f}/p99={svc['p99_ms']:.0f} ms, "
+        f"cap={ctrl['cap']}, {len(slice_lat)} slices)"
+    )
+    return got, {
+        "stream_total_s": round(total_s, 2),
+        "stream_checks_per_s": round(got.shape[0] / total_s, 1),
+        "stream_slice_p50_ms": round(p50, 1),
+        "stream_slice_p99_ms": round(p99, 1),
+        "stream_slice_service_p50_ms": svc["p50_ms"],
+        "stream_slice_service_p99_ms": svc["p99_ms"],
+        "stream_adaptive_cap": ctrl["cap"],
+        "stream_slices": len(slice_lat),
+    }
+
+
 def run_config2(rng):
     """BASELINE config 2: synthetic flat ACL — 100k direct
     (object#relation@user) tuples, 10k batched checks, depth 1. The
@@ -467,23 +514,11 @@ def run_config4(rng):
     tpu_qps = n_checks / tpu_s
     log(f"[c4] batch reps: {['%.0f ms' % (t*1e3) for t in times]}")
 
-    # streamed per-slice latency (p50/p99), pipeline-fill slice excluded
-    engine.batch_check(queries[:16384])  # stream-slice geometry warmup
-    slice_lat = []
-    stream_got = []
-    t_prev = time.perf_counter()
-    t_start = t_prev
-    for out in engine.batch_check_stream(iter(queries), depth=2, slice_cap=16384):
-        now = time.perf_counter()
-        slice_lat.append(now - t_prev)
-        t_prev = now
-        stream_got.append(out)
-    stream_s = time.perf_counter() - t_start
-    stream_got = _np.concatenate(stream_got)
+    # adaptive streamed per-slice latency (p50/p99)
+    stream_got, stream_metrics = stream_pass(engine, snap, queries, "c4")
     stream_wrong = int((stream_got != _np.asarray(expected)).sum())
-    steady = sorted(slice_lat[1:]) or slice_lat
-    p50 = steady[len(steady) // 2] * 1e3
-    p99 = steady[min(len(steady) - 1, int(len(steady) * 0.99))] * 1e3
+    p50 = stream_metrics["stream_slice_p50_ms"]
+    p99 = stream_metrics["stream_slice_p99_ms"]
 
     n_wrong = sum(g != e for g, e in zip(got, expected))
     oracle = CheckEngine(store)
@@ -506,8 +541,7 @@ def run_config4(rng):
         "interior_rows": snap.num_int,
         "checks_per_s": round(tpu_qps, 1),
         "tpu_batch_ms_all_reps": [round(t * 1e3, 1) for t in times],
-        "stream_slice_p50_ms": round(p50, 1),
-        "stream_slice_p99_ms": round(p99, 1),
+        **stream_metrics,
         "stream_wrong": stream_wrong,
         "ingest_s": round(ingest_s, 2),
         "snapshot_build_s": round(snapshot_s, 2),
@@ -547,18 +581,29 @@ def run_config5(rng):
     from keto_tpu.check.tpu_engine import TpuCheckEngine
     from keto_tpu.persistence.memory import MemoryPersister
 
-    n_tuples = int(os.environ.get("BENCH5_TUPLES", 50_000_000))
-    n_checks = int(os.environ.get("BENCH5_CHECKS", 1_000_000))
+    # defaults scale from BENCH_TUPLES/BENCH_CHECKS like the other configs
+    # (full size 50M/1M at the default 1M/100k knobs) — a tiny-shape CI run
+    # must not attempt the full 50M; explicit BENCH5_* still pins either
+    base_tuples = int(os.environ.get("BENCH_TUPLES", 1_000_000))
+    base_checks = int(os.environ.get("BENCH_CHECKS", 100_000))
+    n_tuples = int(os.environ.get("BENCH5_TUPLES", 50 * base_tuples))
+    n_checks = int(os.environ.get("BENCH5_CHECKS", 10 * base_checks))
     avail = _mem_available_bytes()
     if avail is not None:
         fit = int(avail * 0.8 / 450)
-        if fit < n_tuples:
-            log(
-                f"[c5] host RAM {avail/2**30:.0f} GiB fits ~{fit:,} tuples; "
-                f"downsizing from {n_tuples:,} (HONEST REDUCTION — rerun on a "
-                "larger host for the full 50M)"
-            )
-            n_tuples = fit
+    elif "BENCH5_TUPLES" not in os.environ:
+        # /proc/meminfo unavailable (non-Linux host): conservative cap
+        # rather than optimistically attempting the full workload
+        fit = 2_000_000
+        log("[c5] /proc/meminfo unavailable; capping at a conservative 2M tuples")
+    else:
+        fit = n_tuples  # operator pinned the size explicitly — trust it
+    if fit < n_tuples:
+        log(
+            f"[c5] host fits ~{fit:,} tuples; downsizing from {n_tuples:,} "
+            "(HONEST REDUCTION — rerun on a larger host for the full size)"
+        )
+        n_tuples = fit
 
     t0 = time.perf_counter()
     tuples, doc_grant, membership, user_reaches, member_of, n_users, T = build_workload(
@@ -596,41 +641,18 @@ def run_config5(rng):
     expected = _np.fromiter((e for _, e in pairs), bool, len(pairs))
     del pairs
 
-    engine.batch_check(queries[:131072])  # warmup the FULL slice geometry
-    # (a smaller warmup would compile a different query-word width and
-    # push the real slice's compile into the timed window)
-    log("[c5] warmup done")
-
-    slice_lat = []
-    outs = []
-    t_start = time.perf_counter()
-    t_prev = t_start
-    for out in engine.batch_check_stream(iter(queries), depth=2, slice_cap=131072):
-        now = time.perf_counter()
-        slice_lat.append(now - t_prev)
-        t_prev = now
-        outs.append(out)
-    total_s = time.perf_counter() - t_start
-    got = _np.concatenate(outs)
+    got, stream_metrics = stream_pass(engine, snap, queries, "c5")
     n_done = int(got.shape[0])
     n_wrong = int((got != expected[:n_done]).sum())
-    steady = sorted(slice_lat[1:]) or slice_lat
-    p50 = steady[len(steady) // 2] * 1e3
-    p99 = steady[min(len(steady) - 1, int(len(steady) * 0.99))] * 1e3
-    qps = n_done / total_s
-    log(
-        f"[c5] stream: {qps:,.0f} checks/s over {n_done} checks "
-        f"({total_s:.1f}s total); slice p50={p50:.0f} ms p99={p99:.0f} ms; wrong={n_wrong}"
-    )
+    qps = stream_metrics["stream_checks_per_s"]
+    log(f"[c5] wrong={n_wrong} over {n_done} checks")
     metrics = {
         "tuples": n_tuples,
         "checks": n_done,
         "nodes": snap.n_nodes,
         "edges": snap.n_edges,
-        "checks_per_s": round(qps, 1),
-        "stream_total_s": round(total_s, 1),
-        "stream_slice_p50_ms": round(p50, 1),
-        "stream_slice_p99_ms": round(p99, 1),
+        "checks_per_s": qps,
+        **stream_metrics,
         "wrong": n_wrong,
         "ingest_s": round(ingest_s, 1),
         "snapshot_build_s": round(snapshot_s, 1),
@@ -720,34 +742,12 @@ def main():
     log(f"batch reps: {['%.0f ms' % (t*1e3) for t in times]}")
 
     # streamed pass: per-slice service latency at flat memory (BASELINE's
-    # target metric is p50 for 1M-check streams). depth=2 keeps the
-    # pipeline saturated but yields in steady state, so the inter-yield
-    # gap (first yield excluded — it absorbs pipeline fill) is the real
-    # per-slice service time; decisions are validated below like the
-    # batch pass.
-    engine.batch_check(queries[:16384])  # stream-slice geometry warmup
-    slice_lat = []
-    stream_got = []
-    t0 = time.perf_counter()
-    t_prev = t0
-    for out in engine.batch_check_stream(iter(queries), depth=2, slice_cap=16384):
-        now = time.perf_counter()
-        slice_lat.append(now - t_prev)
-        t_prev = now
-        stream_got.append(out)
-    stream_s = time.perf_counter() - t0
+    # target metric is p50 for 1M-check streams), latency-adaptive slice
+    # widths; decisions are validated below like the batch pass.
     import numpy as _np
 
-    stream_got = _np.concatenate(stream_got)
-    n_stream = int(stream_got.shape[0])
+    stream_got, stream_metrics = stream_pass(engine, snap, queries, "c3")
     stream_wrong = int((stream_got != _np.asarray(expected)).sum())
-    steady = sorted(slice_lat[1:]) or slice_lat
-    p50 = steady[len(steady) // 2] * 1e3
-    p99 = steady[min(len(steady) - 1, int(len(steady) * 0.99))] * 1e3
-    log(
-        f"stream: {n_stream/stream_s:,.0f} checks/s; slice p50={p50:.0f} ms "
-        f"p99={p99:.0f} ms ({len(slice_lat)} slices, wrong={stream_wrong})"
-    )
 
     n_wrong = sum(g != e for g, e in zip(got, expected))
     if n_wrong:
@@ -817,9 +817,7 @@ def main():
                     "edges": snap_edges,
                     "tpu_batch_ms_total": round(tpu_s * 1e3, 1),
                     "tpu_batch_ms_all_reps": [round(t * 1e3, 1) for t in times],
-                    "stream_checks_per_s": round(n_stream / stream_s, 1),
-                    "stream_slice_p50_ms": round(p50, 1),
-                    "stream_slice_p99_ms": round(p99, 1),
+                    **stream_metrics,
                     "stream_wrong": stream_wrong,
                     "snapshot_build_s": round(snapshot_s, 2),
                     "ingest_s": round(ingest_s, 2),

@@ -60,6 +60,7 @@ from keto_tpu import namespace as namespace_pkg
 from keto_tpu.graph.snapshot import WILDCARD, GraphSnapshot, build_snapshot
 from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
 from keto_tpu.x.errors import ErrNamespaceUnknown
+from keto_tpu.x.telemetry import DurationStats
 
 _log = logging.getLogger("keto_tpu.check")
 
@@ -456,6 +457,92 @@ def pack_chunk(
     )
 
 
+class StreamSliceController:
+    """Latency-adaptive slice-width controller for the streaming pipeline.
+
+    The memory-derived ``_slice_cap`` optimizes pure throughput — the
+    widest bitmap the workspace budget allows — which on a tunneled device
+    means multi-hundred-ms service time per slice. This controller instead
+    picks the widest width on the compiled ladder (``32·_WORD_WIDTHS``:
+    only those geometries ever jit, so adapting never compiles a new
+    kernel) whose observed per-slice service time stays at or below
+    ``target_ms`` (config ``serve.stream_slice_target_ms``):
+
+    - **narrow** multiplicatively on an overshoot — the new rung is
+      predicted from the slice's observed per-query cost, so one bad
+      observation jumps straight to a fitting width instead of walking
+      down rung by rung while callers wait;
+    - **re-widen** one rung at a time, only after ``patience`` consecutive
+      full-width slices with clear headroom — a rung up is 2–8× the
+      queries, so widening is the cautious direction.
+
+    ``floor`` bounds narrowing so a latency spike cannot collapse
+    throughput (2048 queries/slice keeps > 50k checks/s even at 25
+    slices/s).
+    """
+
+    #: widen when observed ms < WIDEN_FRAC · target, ``patience`` times in a row
+    WIDEN_FRAC = 0.5
+    #: narrow when observed ms > NARROW_FRAC · target
+    NARROW_FRAC = 1.25
+
+    def __init__(self, target_ms: float = 40.0, floor: int = 2048, patience: int = 2):
+        self._ladder = [32 * w for w in _WORD_WIDTHS]
+        self.target_ms = float(target_ms)
+        self._lo = next(
+            (i for i, c in enumerate(self._ladder) if c >= floor),
+            len(self._ladder) - 1,
+        )
+        self._patience = patience
+        self._lock = threading.Lock()
+        # start two rungs under the top: wide enough that a fast link is
+        # near peak throughput from slice one, narrow enough that the
+        # first observations on a slow link land near the target
+        self._i = max(self._lo, len(self._ladder) - 3)
+        self._good = 0
+        self._ewma_ms_per_q: Optional[float] = None
+
+    def cap(self) -> int:
+        """Current per-slice query cap (always a compiled ladder width)."""
+        with self._lock:
+            return self._ladder[self._i]
+
+    def observe(self, nq: int, ms: float) -> None:
+        """Feed one slice's service time: dispatch→ready when the pipeline
+        ran dry, ready→ready interval when saturated."""
+        if nq <= 0:
+            return
+        per_q = ms / nq
+        with self._lock:
+            e = self._ewma_ms_per_q
+            self._ewma_ms_per_q = per_q if e is None else 0.7 * e + 0.3 * per_q
+            cap = self._ladder[self._i]
+            if ms > self.NARROW_FRAC * self.target_ms:
+                want = self._lo
+                for k in range(self._i, self._lo - 1, -1):
+                    if self._ladder[k] * per_q <= self.target_ms:
+                        want = k
+                        break
+                self._i = min(self._i, max(self._lo, want))
+                self._good = 0
+            elif ms < self.WIDEN_FRAC * self.target_ms and nq >= cap:
+                self._good += 1
+                if self._good >= self._patience and self._i + 1 < len(self._ladder):
+                    self._i += 1
+                    self._good = 0
+            else:
+                self._good = 0
+
+    def snapshot(self) -> dict:
+        """Controller state for introspection (bench, /debug)."""
+        with self._lock:
+            return {
+                "cap": self._ladder[self._i],
+                "target_ms": self.target_ms,
+                "ewma_ms_per_query": self._ewma_ms_per_q,
+            }
+
+
 class TpuCheckEngine:
     """Drop-in check engine answering batched queries on the device graph.
 
@@ -493,6 +580,7 @@ class TpuCheckEngine:
         peel_seed_cap: float = 4.0,
         sync_rebuild_budget_s: float = 0.25,
         lockstep_verify: bool = True,
+        stream_slice_target_ms: float = 40.0,
     ):
         if it_cap < 1:
             raise ValueError("it_cap must be >= 1 (the answer pull needs one step)")
@@ -512,6 +600,12 @@ class TpuCheckEngine:
         self._block_iters = 8
         # concurrently in-flight chunks (bounds device bitmap workspaces)
         self._dispatch_window = 16
+        # streaming pipeline: the latency-adaptive width controller is
+        # shared across streams so a serving process stays converged, and
+        # per-slice service times land in stream_slice_stats — the
+        # controller, bench.py, and operators all read the same numbers
+        self.stream_ctrl = StreamSliceController(target_ms=stream_slice_target_ms)
+        self.stream_slice_stats = DurationStats()
         self._mesh = mesh
         self._shard_rows = shard_rows
         self._multiprocess = mesh is not None and jax.process_count() > 1
@@ -942,15 +1036,102 @@ class TpuCheckEngine:
                         multi[i] = m1[j]
         return sd, tg, multi
 
+    def _ns_resolver(self):
+        """Per-batch namespace-name → id resolver with a cache: ``None`` =
+        unknown (→ denied, engine.go:76-77), ``WILDCARD`` = empty name."""
+        nm = self._nm()
+        cache: dict = {}
+
+        def _ns(name: str):
+            hit = cache.get(name, _UNSET)
+            if hit is not _UNSET:
+                return hit
+            if name == "":
+                r: object = WILDCARD
+            else:
+                try:
+                    r = nm.get_namespace_by_name(name).id
+                except ErrNamespaceUnknown:
+                    r = None
+            cache[name] = r
+            return r
+
+        return _ns
+
+    def _subject_target(self, snap: GraphSnapshot, rt: RelationTuple, ns_of):
+        """Resolve a query's subject to its target device row: the id, -1
+        when no such node exists (target unreachable), or ``None`` when the
+        subject itself forces a deny (nil subject, unknown subject
+        namespace)."""
+        interned = snap.interned
+        raw2dev = snap.raw2dev
+        sub = rt.subject
+        if type(sub) is SubjectID:
+            rawl = interned.resolve_leaf(sub.id)
+            if rawl >= 0:
+                return int(raw2dev[rawl + snap.num_sets])
+            ov_leaf = snap.ov_leaf_ids
+            return ov_leaf.get(sub.id, -1) if ov_leaf else -1
+        if isinstance(sub, SubjectSet):
+            sns_id = ns_of(sub.namespace)
+            if sns_id is None:
+                return None
+            if sns_id == WILDCARD:
+                # subjects are matched literally; an empty subject
+                # namespace can only equal a stored subject in a
+                # namespace named ""
+                wild_list = list(snap.wild_ns_ids)
+                if not wild_list:
+                    return -1
+                skey = (wild_list[0], sub.object, sub.relation)
+            else:
+                skey = (sns_id, sub.object, sub.relation)
+            rawt = interned.resolve_set(*skey)
+            if rawt >= 0:
+                return int(raw2dev[rawt])
+            ov_set = snap.ov_set_ids
+            return ov_set.get(skey, -1) if ov_set else -1
+        return None  # nil subject → denied
+
     def _resolve_specials(self, snap, tuples, indices, sd, tg, multi):
-        """Pattern/wildcard queries: reuse the Python resolver per query and
-        splice its results into the bulk arrays."""
+        """Wildcard/pattern queries, resolved in bulk: namespace names go
+        through one cache, starts through the snapshot's family-grouped
+        sorted indexes (``GraphSnapshot.resolve_starts_bulk`` — one
+        vectorized searchsorted pass per pattern family instead of a
+        per-query probe), subjects literally. Results splice into the
+        caller's bulk arrays."""
+        _ns = self._ns_resolver()
+        live: list[int] = []
+        pats: list[tuple] = []
         for i in indices:
-            s1, t1, m1 = self._resolve_bulk_py(snap, [tuples[i]])
-            sd[i] = s1[0]
-            tg[i] = t1[0]
-            if 0 in m1:
-                multi[i] = m1[0]
+            rt = tuples[i]
+            ns_id = _ns(rt.namespace)
+            if ns_id is None:
+                continue  # unknown namespace → denied
+            live.append(i)
+            pats.append((ns_id, rt.object, rt.relation))
+        if not live:
+            return
+        starts_l = snap.resolve_starts_bulk(pats)
+        ni = snap.num_int
+        sbase = snap.sink_base
+        nl = snap.num_live
+        for i, starts in zip(live, starts_l):
+            if starts.size == 0:
+                continue  # no matching start node → denied
+            t = self._subject_target(snap, tuples[i], _ns)
+            if t is None:
+                continue  # nil subject / unknown subject namespace → denied
+            if 0 <= t < nl or (t >= nl and snap.is_answerable_target(t)):
+                tg[i] = t
+            sd[i] = -2
+            # interior starts seed the bitmap; sink starts (no out-edges)
+            # contribute nothing; peeled/static starts are host-propagated
+            # at pack time (pack_chunk)
+            multi[i] = (
+                starts[starts < ni],
+                starts[((starts >= ni) & (starts < sbase)) | (starts >= nl)],
+            )
 
     def _resolve_bulk_py(
         self, snap: GraphSnapshot, tuples: Sequence[RelationTuple]
@@ -974,7 +1155,8 @@ class TpuCheckEngine:
           queries.
 
         The common case (literal query, SubjectID) costs two intern-table
-        lookups and two ``raw2dev`` reads — no numpy allocation.
+        lookups and two ``raw2dev`` reads — no numpy allocation. Pattern
+        queries defer to ``_resolve_specials``'s bulk family resolver.
         """
         n = len(tuples)
         nl = snap.num_live
@@ -983,93 +1165,35 @@ class TpuCheckEngine:
         multi: dict = {}
         interned = snap.interned
         resolve_set = interned.resolve_set
-        resolve_leaf = interned.resolve_leaf
         raw2dev = snap.raw2dev
-        num_sets = snap.num_sets
         wild_ids = snap.wild_ns_ids
-        wild_list = list(wild_ids)
         ov_set = snap.ov_set_ids or {}
-        ov_leaf = snap.ov_leaf_ids or {}
-        nm = self._nm()
-        ns_cache: dict = {}
+        _ns = self._ns_resolver()
 
-        def _ns(name: str):
-            hit = ns_cache.get(name, _UNSET)
-            if hit is not _UNSET:
-                return hit
-            if name == "":
-                r: object = WILDCARD
-            else:
-                try:
-                    r = nm.get_namespace_by_name(name).id
-                except ErrNamespaceUnknown:
-                    r = None
-            ns_cache[name] = r
-            return r
-
+        special: list[int] = []
         for i, rt in enumerate(tuples):
             ns_id = _ns(rt.namespace)
             if ns_id is None:
                 continue  # unknown namespace → denied (engine.go:76-77)
             obj, rel = rt.object, rt.relation
-            starts = None
-            if ns_id != WILDCARD and ns_id not in wild_ids and obj != "" and rel != "":
-                raw = resolve_set(ns_id, obj, rel)
-                if raw >= 0:
-                    start_dev = int(raw2dev[raw])
-                else:
-                    start_dev = ov_set.get((ns_id, obj, rel), -1) if ov_set else -1
-                    if start_dev < 0:
-                        continue
+            if ns_id == WILDCARD or ns_id in wild_ids or obj == "" or rel == "":
+                special.append(i)  # wildcard pattern → bulk family resolver
+                continue
+            raw = resolve_set(ns_id, obj, rel)
+            if raw >= 0:
+                start_dev = int(raw2dev[raw])
             else:
-                starts = snap.resolve_starts(ns_id, obj, rel)
-                if starts.size == 0:
+                start_dev = ov_set.get((ns_id, obj, rel), -1) if ov_set else -1
+                if start_dev < 0:
                     continue
-                start_dev = -2
-
-            sub = rt.subject
-            t = -1
-            if type(sub) is SubjectID:
-                rawl = resolve_leaf(sub.id)
-                if rawl >= 0:
-                    t = int(raw2dev[rawl + num_sets])
-                elif ov_leaf:
-                    t = ov_leaf.get(sub.id, -1)
-            elif isinstance(sub, SubjectSet):
-                sns_id = _ns(sub.namespace)
-                if sns_id is None:
-                    continue
-                if sns_id == WILDCARD:
-                    # subjects are matched literally; an empty subject
-                    # namespace can only equal a stored subject in a
-                    # namespace named ""
-                    rawt = (
-                        resolve_set(wild_list[0], sub.object, sub.relation)
-                        if wild_list
-                        else -1
-                    )
-                    skey = (wild_list[0], sub.object, sub.relation) if wild_list else None
-                else:
-                    rawt = resolve_set(sns_id, sub.object, sub.relation)
-                    skey = (sns_id, sub.object, sub.relation)
-                if rawt >= 0:
-                    t = int(raw2dev[rawt])
-                elif ov_set and skey is not None:
-                    t = ov_set.get(skey, -1)
-            else:
-                continue  # nil subject → denied
+            t = self._subject_target(snap, rt, _ns)
+            if t is None:
+                continue  # nil subject / unknown subject namespace → denied
             if 0 <= t < nl or (t >= nl and snap.is_answerable_target(t)):
                 tg[i] = t
             sd[i] = start_dev
-            if starts is not None:
-                # interior starts seed the bitmap; sink starts (no
-                # out-edges) contribute nothing; peeled/static starts are
-                # host-propagated at pack time (pack_chunk)
-                ni = snap.num_int
-                sbase = snap.sink_base
-                live = starts[starts < ni]
-                hostp = starts[((starts >= ni) & (starts < sbase)) | (starts >= nl)]
-                multi[i] = (live, hostp)
+        if special:
+            self._resolve_specials(snap, tuples, special, sd, tg, multi)
         return sd, tg, multi
 
     # -- public API ----------------------------------------------------------
@@ -1166,64 +1290,194 @@ class TpuCheckEngine:
         slice_cap: Optional[int] = None,
         at_least: Optional[int] = None,
         mode: str = "latest",
+        ordered: bool = True,
     ):
         """Streaming check: consume an iterable of RelationTuples, yield
-        ``numpy bool[slice]`` decision arrays in order, keeping at most
-        ``depth`` slices in flight (flat memory for arbitrarily long
-        streams — BASELINE config 5's 1M-check batches never materialize
-        device state for more than ``depth`` slices). Each yielded slice
-        pays one D2H transfer, overlapped with later slices' host+device
-        work via ``copy_to_host_async``. ``slice_cap`` bounds the queries
-        per slice below the memory-derived maximum — smaller slices trade
-        throughput for per-slice service latency."""
-        from collections import deque
+        decision slices while keeping at most ``depth`` slices in flight
+        (flat memory for arbitrarily long streams — BASELINE config 5's
+        1M-check batches never materialize device state for more than
+        ``depth`` slices).
 
+        The pipeline is latency-adaptive and lands slices in READY order:
+
+        - slice widths follow ``StreamSliceController``: narrowed toward
+          ``stream_slice_target_ms`` when kernels/transfers run slow,
+          re-widened when headroom returns — instead of the
+          throughput-only memory-derived maximum. ``slice_cap`` still
+          bounds them from above. (Multi-controller meshes pin the fixed
+          bound: slice geometry must be identical on every host.)
+        - the dispatch window is decoupled from landing: host resolve/pack
+          of slice k+2 proceeds while k+1 executes and k transfers, and an
+          early-finished slice is unpacked the moment its
+          ``copy_to_host_async`` completes — no head-of-line blocking on
+          a straggler.
+        - ``ordered=True`` (default) preserves the yield contract — numpy
+          ``bool[slice]`` arrays in request order, via an in-order
+          delivery buffer. ``ordered=False`` is the fast path for callers
+          that re-associate results by index (e.g. ``CheckBatcher``): it
+          yields ``(offset, bool[slice])`` the moment each slice lands,
+          where ``offset`` is the stream index of the slice's first query.
+
+        Per-slice service times are recorded in ``stream_slice_stats``
+        (x/telemetry.DurationStats): the width controller and bench.py
+        read the same numbers.
+        """
+        gen, _ = self.batch_check_stream_with_token(
+            tuples_iter, depth=depth, slice_cap=slice_cap,
+            at_least=at_least, mode=mode, ordered=ordered,
+        )
+        return gen
+
+    def batch_check_stream_with_token(
+        self,
+        tuples_iter,
+        *,
+        depth: Optional[int] = None,
+        slice_cap: Optional[int] = None,
+        at_least: Optional[int] = None,
+        mode: str = "latest",
+        ordered: bool = True,
+    ):
+        """``batch_check_stream`` plus the deciding snapshot's id, resolved
+        eagerly so serving callers can attach the snaptoken to responses
+        they assemble as slices land. Returns ``(generator, token)``."""
         snap = self._snapshot_for(at_least, mode)
+        gen = self._stream(
+            snap, tuples_iter, depth=depth, slice_cap=slice_cap, ordered=ordered
+        )
+        return gen, snap.snapshot_id
+
+    @staticmethod
+    def _slice_ready(dev) -> bool:
+        """Has this slice's async device→host copy completed? Host-only
+        slices are always ready. A seam on purpose: skew tests patch it to
+        force adversarial landing orders."""
+        if dev is None:
+            return True
+        ready = getattr(dev, "is_ready", None)
+        return True if ready is None else bool(ready())
+
+    def stream_widths(self, snap: GraphSnapshot) -> list[int]:
+        """The slice-width ladder the adaptive stream can choose from on
+        this snapshot (ascending) — callers pre-warm jit geometries by
+        running one batch per width."""
+        cap = self._slice_cap(snap)
+        return [32 * w for w in _WORD_WIDTHS if 32 * w <= cap]
+
+    def _stream(self, snap, tuples_iter, *, depth, slice_cap, ordered):
         depth = depth or self._dispatch_window
-        inflight: deque = deque()
-        max_iters = 0
+        bound = self._slice_cap(snap)
+        if slice_cap:
+            bound = min(bound, slice_cap)
+        # multi-controller lockstep: every host must dispatch identical
+        # slice geometries, and adaptive widths are a per-host latency
+        # measurement — pin the deterministic fixed bound instead
+        ctrl = None if self._multiprocess else self.stream_ctrl
+        stats = self.stream_slice_stats
         lockstep = self._lockstep_verify
         if lockstep:
             from keto_tpu.parallel.lockstep import verify_lockstep
-
-        def _land(rec):
-            nonlocal max_iters
-            out, it, tr = self._unpack_slice(rec[0], rec[1], rec[2])
-            max_iters = max(max_iters, it)
-            if tr:
-                # truncated frontier: the slice's decisions are unusable —
-                # re-run these queries exactly (escalating cap ladder)
-                out, redo_iters = self._run_exact(
-                    snap, rec[3], it_cap=min(
-                        max(self._it_cap * 8, 8), self._cap_limit(snap)
-                    )
-                )
-                max_iters = max(max_iters, redo_iters)
-            return out
-
-        cap = self._slice_cap(snap)
-        if slice_cap:
-            cap = min(cap, slice_cap)
         it = iter(tuples_iter)
+        max_iters = 0
+        t_prev_ready = time.perf_counter()
+
+        def slices():
+            off = 0
+            while True:
+                cap = min(bound, ctrl.cap()) if ctrl is not None else bound
+                batch = list(itertools.islice(it, cap))
+                if not batch:
+                    return
+                if lockstep:
+                    # per stream slice, BEFORE any dispatch (same contract
+                    # as batch_check_with_token): divergence fails loudly
+                    verify_lockstep(snap.snapshot_id, batch)
+                if snap.n_nodes == 0 or snap.n_edges == 0:
+                    yield off, None, np.zeros(len(batch), dtype=bool), len(batch), batch
+                    off += len(batch)
+                    continue
+                for dev, host_ans, nq, chunk in self._dispatch_slices(snap, batch):
+                    yield off, dev, host_ans, nq, chunk
+                    off += nq
+
+        def land(rec):
+            # unpack one slice (blocks iff its transfer hasn't finished);
+            # a truncated frontier re-runs exactly, mid-stream
+            nonlocal max_iters, t_prev_ready
+            _seq, off, dev, host_ans, nq, chunk, t_disp = rec
+            out, iters, truncated = self._unpack_slice(dev, host_ans, nq)
+            if truncated:
+                out, redo_iters = self._run_exact(
+                    snap, chunk, it_cap=min(
+                        max(self._it_cap * 8, 8), self._cap_limit(snap)
+                    ),
+                )
+                iters = max(iters, redo_iters)
+            max_iters = max(max_iters, iters)
+            now = time.perf_counter()
+            # the service time attributable to THIS slice: dispatch→ready
+            # when the pipeline ran dry, ready→ready interval when
+            # saturated (both equal the caller-visible inter-yield gap)
+            ms = (now - max(t_disp, t_prev_ready)) * 1e3
+            t_prev_ready = now
+            stats.observe(ms)
+            if ctrl is not None:
+                ctrl.observe(nq, ms)
+            return off, out
+
+        src = slices()
+        exhausted = False
+        inflight: list = []
+        done: dict[int, tuple[int, np.ndarray]] = {}  # landed, awaiting in-order yield
+        seq = 0
+        next_seq = 0
         while True:
-            batch = list(itertools.islice(it, cap))
-            if not batch:
+            # keep the dispatch window full: resolve/pack/dispatch is host
+            # work that overlaps device execution of every in-flight slice
+            while not exhausted and len(inflight) < depth:
+                nxt = next(src, None)
+                if nxt is None:
+                    exhausted = True
+                    break
+                off, dev, host_ans, nq, chunk = nxt
+                if dev is not None:
+                    dev.copy_to_host_async()
+                inflight.append((seq, off, dev, host_ans, nq, chunk, time.perf_counter()))
+                seq += 1
+            if not inflight and exhausted:
                 break
-            if lockstep:
-                # per stream slice, BEFORE any dispatch (same contract as
-                # batch_check_with_token): divergent streams fail loudly
-                verify_lockstep(snap.snapshot_id, batch)
-            if snap.n_nodes == 0 or snap.n_edges == 0:
-                yield np.zeros(len(batch), dtype=bool)
-                continue
-            for rec in self._dispatch_slices(snap, batch):
-                if rec[0] is not None:
-                    rec[0].copy_to_host_async()
-                inflight.append(rec)
-                while len(inflight) > depth:
-                    yield _land(inflight.popleft())
-        while inflight:
-            yield _land(inflight.popleft())
+            # ready-order landing: every finished slice unpacks now — an
+            # early finisher never waits behind a straggler's transfer
+            progressed = False
+            still = []
+            for rec in inflight:
+                if self._slice_ready(rec[2]):
+                    res = land(rec)
+                    if ordered:
+                        done[rec[0]] = res
+                    else:
+                        yield res
+                    progressed = True
+                else:
+                    still.append(rec)
+            inflight = still
+            if ordered:
+                while next_seq in done:
+                    yield done.pop(next_seq)[1]
+                    next_seq += 1
+            if not progressed and inflight and (exhausted or len(inflight) >= depth):
+                # nothing ready and the window is full (or input is done):
+                # block on the oldest slice — in ordered mode it is the
+                # next to deliver anyway
+                rec = inflight.pop(0)
+                res = land(rec)
+                if ordered:
+                    done[rec[0]] = res
+                    while next_seq in done:
+                        yield done.pop(next_seq)[1]
+                        next_seq += 1
+                else:
+                    yield res
         self._after_batch(max_iters)
 
     def _slice_cap(self, snap: GraphSnapshot) -> int:

@@ -55,6 +55,11 @@ CONFIG_SCHEMA = {
                     "default": "async",
                     "description": "REST backend behind the port mux: 'async' (one asyncio reactor, keep-alive, bounded handler pool) or 'threading' (stdlib thread-per-connection).",
                 },
+                "stream_slice_target_ms": {
+                    "type": "number",
+                    "default": 40.0,
+                    "description": "Streaming check pipeline: per-slice service-time target in milliseconds. The engine's adaptive controller narrows/widens the per-slice query cap along the compiled width ladder toward this target — lower values trade batch throughput for per-slice serving latency. Ignored on multi-controller meshes (slice geometry must be identical on every host).",
+                },
             },
         },
         "namespaces": {

@@ -8,6 +8,14 @@ blocks on a future; a collector thread drains the queue up to
 ``batch_size`` or ``window_ms`` (whichever first) and dispatches one
 ``batch_check``. This is the serving-plane analog of the data-parallel axis
 (SURVEY §2.3: request concurrency → batch parallelism).
+
+Against the TPU engine the dispatch is STREAMING: the coalesced batch goes
+through ``batch_check_stream_with_token(ordered=False)`` — the engine's
+latency-adaptive ready-order pipeline — and each caller's future resolves
+the moment its slice lands, re-associated by query offset. Production
+``/check`` traffic (REST async/threading backends and gRPC all route
+through this batcher) therefore sees per-slice serving latency, not
+whole-batch latency, when the device splits a large batch.
 """
 
 from __future__ import annotations
@@ -123,23 +131,45 @@ class CheckBatcher:
         """Pre-batched requests skip the queue entirely."""
         return self._engine.batch_check(list(tuples))
 
+    @staticmethod
+    def _consistency_kw(at_leasts, latests) -> dict:
+        """The strongest requested consistency wins (freshness is monotone
+        — a fresher snapshot satisfies every weaker requirement in the
+        batch)."""
+        if any(latests):
+            # read-your-writes dominates every floor in the batch
+            return {"mode": "latest"}
+        floors = [a for a in at_leasts if a is not None]
+        return {"at_least": max(floors) if floors else None, "mode": "serving"}
+
     def _dispatch(self, tuples, at_leasts, latests):
-        """One engine call for a coalesced batch: the strongest requested
-        consistency wins (freshness is monotone — a fresher snapshot
-        satisfies every weaker requirement in the batch)."""
+        """One engine call for a coalesced batch."""
         if hasattr(self._engine, "batch_check_with_token"):
-            if any(latests):
-                # read-your-writes dominates every floor in the batch
-                return self._engine.batch_check_with_token(tuples, mode="latest")
-            floors = [a for a in at_leasts if a is not None]
             return self._engine.batch_check_with_token(
-                tuples, at_least=max(floors) if floors else None, mode="serving"
+                tuples, **self._consistency_kw(at_leasts, latests)
             )
         # oracle engine: always fresh (reads the store per traversal
         # step), no snapshot concept
         if hasattr(self._engine, "batch_check"):
             return self._engine.batch_check(tuples), None
         return [self._engine.subject_is_allowed(t) for t in tuples], None
+
+    def _dispatch_stream(self, batch, tuples, at_leasts, latests) -> None:
+        """Streaming dispatch for engines with the ready-order stream API:
+        each caller's future resolves the moment ITS slice lands (the
+        ``ordered=False`` fast path — re-association is by query offset),
+        so early-finishing slices of a large coalesced batch don't wait
+        behind stragglers. Mid-stream failures propagate to the caller
+        (``_loop`` fails every still-unresolved future)."""
+        gen, token = self._engine.batch_check_stream_with_token(
+            iter(tuples), ordered=False,
+            **self._consistency_kw(at_leasts, latests),
+        )
+        for off, out in gen:
+            for j, allowed in enumerate(out.tolist()):
+                fut = batch[off + j][1]
+                if not fut.done():
+                    fut.set_result((bool(allowed), token))
 
     # -- collector -----------------------------------------------------------
 
@@ -171,12 +201,13 @@ class CheckBatcher:
                 batch.append(nxt)
 
             tuples = [t for t, _, _, _ in batch]
+            at_leasts = [a for _, _, a, _ in batch]
+            latests = [l for _, _, _, l in batch]
             try:
-                results, token = self._dispatch(
-                    tuples,
-                    [a for _, _, a, _ in batch],
-                    [l for _, _, _, l in batch],
-                )
+                if hasattr(self._engine, "batch_check_stream_with_token"):
+                    self._dispatch_stream(batch, tuples, at_leasts, latests)
+                    continue
+                results, token = self._dispatch(tuples, at_leasts, latests)
             except Exception as e:  # engine failure → every caller sees it
                 for _, fut, _, _ in batch:
                     if not fut.done():

@@ -109,6 +109,9 @@ class Registry:
                     sync_rebuild_budget_s=float(
                         self._config.get("engine.sync_rebuild_budget_s", 0.25)
                     ),
+                    stream_slice_target_ms=float(
+                        self._config.get("serve.stream_slice_target_ms", 40.0)
+                    ),
                 )
             return CheckEngine(store)
 

@@ -416,11 +416,15 @@ class GraphSnapshot:
 
     def _pattern_index(self, kind: str):
         """Lazily built sorted key index for pattern resolution:
-        ``(order, sorted primary col, sorted secondary col | None)``.
+        ``(order, sorted primary col, sorted secondary col | None,
+        composite (primary<<32 | secondary) col | None)``.
         Kinds: "no" = (ns, obj), "nr" = (ns, rel), "or" = (obj, rel),
         "r" = (rel,). Built once per snapshot; every pattern family then
         resolves with binary searches instead of an O(num_sets) scan —
-        the fix for wildcard-heavy batches serializing on the host."""
+        the fix for wildcard-heavy batches serializing on the host. The
+        composite column is sorted under the same lexsort, so a BULK of
+        two-field patterns resolves with one vectorized searchsorted over
+        pairs (``resolve_starts_bulk``)."""
         ck = ("_pidx", kind)
         with self._cache_lock:
             hit = self._pattern_cache.get(ck)
@@ -432,16 +436,18 @@ class GraphSnapshot:
         kr = np.asarray(i.key_rel)
         if kind == "no":
             order = np.lexsort((ko, kn))
-            entry = (order, kn[order], ko[order])
+            c1, c2 = kn[order], ko[order]
         elif kind == "nr":
             order = np.lexsort((kr, kn))
-            entry = (order, kn[order], kr[order])
+            c1, c2 = kn[order], kr[order]
         elif kind == "or":
             order = np.lexsort((kr, ko))
-            entry = (order, ko[order], kr[order])
+            c1, c2 = ko[order], kr[order]
         else:  # "r"
             order = np.argsort(kr, kind="stable")
-            entry = (order, kr[order], None)
+            c1, c2 = kr[order], None
+        comp = None if c2 is None else (c1.astype(np.int64) << 32) | c2.astype(np.int64)
+        entry = (order, c1, c2, comp)
         with self._cache_lock:
             self._pattern_cache[ck] = entry
         return entry
@@ -450,7 +456,7 @@ class GraphSnapshot:
     def _index_range(entry, v1, v2=None) -> np.ndarray:
         """Raw set ids whose primary key equals ``v1`` (and secondary
         equals ``v2`` when given), via the sorted index."""
-        order, c1, c2 = entry
+        order, c1, c2, _comp = entry
         lo = int(np.searchsorted(c1, v1, "left"))
         hi = int(np.searchsorted(c1, v1, "right"))
         if v2 is None or c2 is None:
@@ -502,6 +508,14 @@ class GraphSnapshot:
                 cand = self._index_range(self._pattern_index("r"), rc)
             else:  # (*, *, *)
                 cand = np.arange(self.num_sets, dtype=np.int64)
+        return self._starts_from_candidates(key, ns_wild, ns_id, obj, rel, cand)
+
+    def _starts_from_candidates(
+        self, key, ns_wild: bool, ns_id, obj: str, rel: str, cand: np.ndarray
+    ) -> np.ndarray:
+        """Candidate raw set ids → device start rows (+ overlay extras),
+        cached under ``key`` — the shared tail of ``resolve_starts`` and
+        ``resolve_starts_bulk``."""
         # ascending raw-id order: bitwise-identical to the old full-scan
         # nonzero() result (multi-host lockstep determinism)
         starts = self.raw2dev[np.sort(cand)] if cand.size else np.zeros(0, np.int64)
@@ -520,6 +534,97 @@ class GraphSnapshot:
         with self._cache_lock:
             self._pattern_cache[key] = starts
         return starts
+
+    def resolve_starts_bulk(self, pats) -> list:
+        """``resolve_starts`` for a whole batch of ``(ns_id, obj, rel)``
+        patterns in one pass. Duplicate patterns dedupe against the
+        pattern cache; uncached patterns group by wildcard family so each
+        family costs ONE vectorized searchsorted over its sorted index
+        (two-field families probe the composite key column) instead of a
+        per-query probe — the fix for wildcard-heavy batches serializing
+        on host pattern resolution. Results land in the same cache
+        ``resolve_starts`` uses, so follow-up streams stay O(1)."""
+        out: list = [None] * len(pats)
+        fresh: dict[tuple, list[int]] = {}
+        for j, (ns_id, obj, rel) in enumerate(pats):
+            ns_wild = ns_id == WILDCARD or ns_id in self.wild_ns_ids
+            if not ns_wild and obj != "" and rel != "":
+                out[j] = self.resolve_starts(ns_id, obj, rel)  # literal: ≤ 1 node
+                continue
+            key = (
+                WILDCARD if ns_wild else ns_id,
+                obj if obj != "" else None,
+                rel if rel != "" else None,
+            )
+            with self._cache_lock:
+                hit = self._pattern_cache.get(key)
+            if hit is not None:
+                out[j] = hit
+            else:
+                fresh.setdefault(key, []).append(j)
+        if not fresh:
+            return out
+        # one probe spec per distinct uncached pattern, grouped by family
+        groups: dict[tuple, list] = {}
+        for key, js in fresh.items():
+            kns, kobj, krel = key
+            ns_wild = kns == WILDCARD
+            obj = kobj if kobj is not None else ""
+            rel = krel if krel is not None else ""
+            oc = self.interned.obj_code(obj) if kobj is not None else None
+            rc = self.interned.rel_code(rel) if krel is not None else None
+            if (kobj is not None and oc < 0) or (krel is not None and rc < 0):
+                # a literal field never interned: no candidates
+                starts = self._starts_from_candidates(
+                    key, ns_wild, kns, obj, rel, np.zeros(0, np.int64)
+                )
+                for j in js:
+                    out[j] = starts
+                continue
+            if not ns_wild:
+                if oc is not None:  # (ns, obj, *)
+                    spec = ("no", kns, oc)
+                elif rc is not None:  # (ns, *, rel)
+                    spec = ("nr", kns, rc)
+                else:  # (ns, *, *)
+                    spec = ("no", kns, None)
+            else:
+                if oc is not None and rc is not None:  # (*, obj, rel)
+                    spec = ("or", oc, rc)
+                elif oc is not None:  # (*, obj, *)
+                    spec = ("or", oc, None)
+                elif rc is not None:  # (*, *, rel)
+                    spec = ("r", rc, None)
+                else:  # (*, *, *): every set node
+                    starts = self._starts_from_candidates(
+                        key, True, kns, obj, rel,
+                        np.arange(self.num_sets, dtype=np.int64),
+                    )
+                    for j in js:
+                        out[j] = starts
+                    continue
+            kind, v1, v2 = spec
+            groups.setdefault((kind, v2 is not None), []).append(
+                (key, js, v1, v2, ns_wild, kns, obj, rel)
+            )
+        for (kind, two), items in groups.items():
+            order, c1, _c2, comp = self._pattern_index(kind)
+            v1s = np.asarray([it[2] for it in items], np.int64)
+            if two:
+                probe = (v1s << 32) | np.asarray([it[3] for it in items], np.int64)
+                col = comp
+            else:
+                probe = v1s
+                col = c1
+            lo = np.searchsorted(col, probe, "left")
+            hi = np.searchsorted(col, probe, "right")
+            for (key, js, _v1, _v2, ns_wild, kns, obj, rel), l, h in zip(items, lo, hi):
+                starts = self._starts_from_candidates(
+                    key, ns_wild, kns, obj, rel, order[l:h]
+                )
+                for j in js:
+                    out[j] = starts
+        return out
 
 
 def build_snapshot(

@@ -15,6 +15,49 @@ import collections
 import threading
 
 
+class DurationStats:
+    """Thread-safe sliding-window duration recorder (milliseconds).
+
+    The streaming check pipeline records every slice's service time here,
+    and every consumer reads the SAME numbers: the engine's adaptive
+    slice-width controller (keto_tpu/check/tpu_engine.py), bench.py's
+    per-config ``stream_slice_*`` report, and operator introspection — so
+    the latency the controller steers by is exactly the latency the
+    benchmark grades."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._window: collections.deque = collections.deque(maxlen=capacity)
+        self._count = 0
+
+    def observe(self, ms: float) -> None:
+        with self._lock:
+            self._window.append(float(ms))
+            self._count += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._window.clear()
+            self._count = 0
+
+    def snapshot(self) -> dict:
+        """``{count, p50_ms, p99_ms, mean_ms, max_ms}`` over the window
+        (zeros when nothing was observed)."""
+        with self._lock:
+            vals = sorted(self._window)
+            count = self._count
+        if not vals:
+            return {"count": count, "p50_ms": 0.0, "p99_ms": 0.0, "mean_ms": 0.0, "max_ms": 0.0}
+        n = len(vals)
+        return {
+            "count": count,
+            "p50_ms": round(vals[n // 2], 3),
+            "p99_ms": round(vals[min(n - 1, int(n * 0.99))], 3),
+            "mean_ms": round(sum(vals) / n, 3),
+            "max_ms": round(vals[-1], 3),
+        }
+
+
 class Telemetry:
     def __init__(self, enabled: bool = False):
         self.enabled = enabled

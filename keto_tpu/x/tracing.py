@@ -111,7 +111,13 @@ class _OtlpHttpExporter:
         self._batch = batch
         self.dropped = 0
         self.exported = 0
-        self._inflight = 0
+        # spans accepted but not yet export-attempted: queued OR held in
+        # the worker's current batch. Incremented atomically with the
+        # enqueue and decremented only after the POST attempt, so flush()
+        # can never observe "empty queue" while a drained batch is still
+        # un-POSTed (the drain race a queue-emptiness check had).
+        self._pending = 0
+        self._pending_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="keto-tpu-otlp", daemon=True
@@ -119,10 +125,13 @@ class _OtlpHttpExporter:
         self._thread.start()
 
     def submit(self, span: Span) -> None:
-        try:
-            self._q.put_nowait(span)
-        except queue.Full:
-            self.dropped += 1
+        with self._pending_lock:
+            try:
+                self._q.put_nowait(span)
+            except queue.Full:
+                self.dropped += 1
+            else:
+                self._pending += 1
 
     def _loop(self) -> None:
         import urllib.request
@@ -140,7 +149,6 @@ class _OtlpHttpExporter:
                     spans.append(self._q.get_nowait())
                 except queue.Empty:
                     break
-            self._inflight = len(spans)
             body = json.dumps(spans_to_otlp_request(spans)).encode()
             req = urllib.request.Request(
                 self.endpoint, data=body, method="POST",
@@ -151,12 +159,17 @@ class _OtlpHttpExporter:
                     self.exported += len(spans)
             except Exception:
                 self.dropped += len(spans)  # collector down: drop, never block
-            self._inflight = 0
+            with self._pending_lock:
+                self._pending -= len(spans)
 
     def flush(self, timeout: float = 5.0) -> None:
-        """Drain the queue AND any in-flight batch (tests, shutdown)."""
+        """Wait until every span accepted so far has been export-attempted
+        — the queue AND the worker's in-flight batch (tests, shutdown)."""
         deadline = time.monotonic() + timeout
-        while (not self._q.empty() or self._inflight) and time.monotonic() < deadline:
+        while time.monotonic() < deadline:
+            with self._pending_lock:
+                if self._pending == 0:
+                    return
             time.sleep(0.02)
 
     def stop(self) -> None:

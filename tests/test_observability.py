@@ -201,3 +201,55 @@ def test_otlp_span_kinds():
     spans = {s.name: s.to_otlp() for s in tracer.finished}
     assert spans["root"]["kind"] == 2  # SERVER entry point
     assert spans["child"]["kind"] == 1  # INTERNAL
+
+
+def test_otlp_http_exporter_flush_waits_for_drained_batch(monkeypatch):
+    """Drain-race regression: flush() must NOT report done while the
+    worker holds a dequeued-but-un-POSTed batch (queue empty, POST not yet
+    attempted). The old queue-emptiness check returned early in exactly
+    that window, violating stop()'s "exported, not dropped" contract."""
+    import contextlib
+    import queue as _queue
+    import threading
+    import time
+    import urllib.request
+
+    from keto_tpu.x.tracing import Span, _OtlpHttpExporter
+
+    got_one = threading.Event()
+    hold = threading.Event()
+
+    class PausingQueue(_queue.Queue):
+        # models the race window: the span has LEFT the queue but the
+        # worker has not yet accounted for it / POSTed it
+        def get(self, *a, **kw):
+            item = super().get(*a, **kw)
+            got_one.set()
+            hold.wait(5)
+            return item
+
+    posted = threading.Event()
+
+    def fake_urlopen(req, timeout=None):
+        posted.set()
+        return contextlib.nullcontext()
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    exp = _OtlpHttpExporter("http://127.0.0.1:1/v1/traces", flush_interval_s=0.05)
+    exp._q = PausingQueue(maxsize=16)
+    time.sleep(0.1)  # let the worker move onto the swapped queue
+    exp.submit(Span(name="s", trace_id="t", span_id="i", parent_id=None, start=0.0, end=1.0))
+    assert got_one.wait(5), "worker never drained the queue"
+
+    # queue is empty, batch is held: flush must keep waiting (old code
+    # returned ~instantly here)
+    t0 = time.monotonic()
+    exp.flush(timeout=0.6)
+    assert time.monotonic() - t0 >= 0.5, "flush returned while a batch was in flight"
+    assert exp.exported == 0
+
+    hold.set()
+    exp.flush(timeout=5.0)
+    assert posted.is_set()
+    assert exp.exported == 1 and exp.dropped == 0
+    exp.stop()

@@ -436,3 +436,222 @@ def test_bulk_wildcard_batch_resolves_indexed(make_persister):
     for q, g in zip(queries, got):
         w = oracle.subject_is_allowed(q)
         assert g == w, f"{q}: tpu={g} oracle={w}"
+
+
+# -- latency-adaptive ready-order streaming pipeline --------------------------
+
+
+def _skewed_stream_store(make_persister):
+    rng = random.Random(123)
+    p = make_persister([("ns0", 0), ("ns1", 1)])
+    objects = [f"o{i}" for i in range(10)]
+    users = [f"u{i}" for i in range(8)]
+    rows = []
+    for _ in range(150):
+        sub = (
+            SubjectID(rng.choice(users))
+            if rng.random() < 0.5
+            else SubjectSet(rng.choice(["ns0", "ns1"]), rng.choice(objects), "r")
+        )
+        rows.append(T(rng.choice(["ns0", "ns1"]), rng.choice(objects), "r", sub))
+    p.write_relation_tuples(*rows)
+    queries = []
+    for _ in range(300):
+        sub = (
+            SubjectID(rng.choice(users + ["ghost"]))
+            if rng.random() < 0.6
+            else SubjectSet("ns0", rng.choice(objects), "r")
+        )
+        queries.append(T(rng.choice(["ns0", "ns1", "nope"]), rng.choice(objects), "r", sub))
+    return p, queries
+
+
+@pytest.mark.parametrize("pattern", ["never", "random", "always"])
+def test_stream_ready_order_preserves_order_under_skew(make_persister, pattern):
+    """Ready-order landing with artificially skewed slice readiness: some
+    slices are declared "finished" early (unpacked out of order into the
+    delivery buffer), others never poll ready and land via the blocking
+    path — the ordered yield contract must hold regardless."""
+    import numpy as np
+
+    p, queries = _skewed_stream_store(make_persister)
+    engine = TpuCheckEngine(p, p.namespaces, max_batch=32)
+    want = engine.batch_check(queries)
+
+    rng = random.Random(5)
+    ready = {"never": lambda dev: False, "always": lambda dev: True,
+             "random": lambda dev: rng.random() < 0.5}[pattern]
+    engine._slice_ready = ready  # instance seam shadows the staticmethod
+    slices = list(engine.batch_check_stream(iter(queries), depth=3))
+    assert len(slices) > 3
+    assert np.concatenate(slices).tolist() == want
+
+
+def test_stream_unordered_reassociates_by_offset(make_persister):
+    """ordered=False yields (offset, decisions) the moment a slice lands;
+    re-assembling by offset must reproduce the ordered decisions exactly
+    (the CheckBatcher fast path)."""
+    import numpy as np
+
+    p, queries = _skewed_stream_store(make_persister)
+    engine = TpuCheckEngine(p, p.namespaces, max_batch=32)
+    want = engine.batch_check(queries)
+    rng = random.Random(9)
+    engine._slice_ready = lambda dev: rng.random() < 0.5
+    got = np.zeros(len(queries), dtype=bool)
+    seen = 0
+    for off, out in engine.batch_check_stream(iter(queries), depth=3, ordered=False):
+        got[off : off + out.shape[0]] = out
+        seen += out.shape[0]
+    assert seen == len(queries)
+    assert got.tolist() == want
+
+
+def test_stream_with_token_matches_snapshot(make_persister):
+    p, queries = _skewed_stream_store(make_persister)
+    engine = TpuCheckEngine(p, p.namespaces, max_batch=32)
+    gen, token = engine.batch_check_stream_with_token(iter(queries))
+    import numpy as np
+
+    got = np.concatenate(list(gen)).tolist()
+    assert token == engine.snapshot().snapshot_id
+    assert got == engine.batch_check(queries)
+
+
+def test_stream_adaptive_controller_converges():
+    """The width controller narrows under slow slices (multiplicatively,
+    to the rung its per-query cost predicts) and re-widens rung by rung
+    once full-width slices show headroom again."""
+    from keto_tpu.check.tpu_engine import StreamSliceController
+
+    ctrl = StreamSliceController(target_ms=40.0, floor=32, patience=1)
+    top = 32 * 4096
+    start = ctrl.cap()
+    assert 32 <= start <= top
+
+    # slow transfers: one overshoot jumps straight to a fitting width
+    ctrl.observe(start, 400.0)  # 400 ms for `start` queries
+    narrowed = ctrl.cap()
+    assert narrowed < start
+    assert narrowed * (400.0 / start) <= 40.0 or narrowed == 32
+    # keep overshooting → collapses to the floor, never below
+    for _ in range(6):
+        ctrl.observe(ctrl.cap(), 400.0)
+    assert ctrl.cap() == 32
+
+    # headroom returns: re-widens one rung per good full-width slice
+    caps = []
+    for _ in range(16):
+        ctrl.observe(ctrl.cap(), 1.0)
+        caps.append(ctrl.cap())
+    assert caps[-1] == top
+    assert caps == sorted(caps)  # monotone climb, no oscillation
+
+    # partial (non-full-width) fast slices must NOT widen
+    ctrl2 = StreamSliceController(target_ms=40.0, floor=32, patience=1)
+    ctrl2.observe(ctrl2.cap(), 400.0)
+    low = ctrl2.cap()
+    ctrl2.observe(low // 2, 1.0)
+    assert ctrl2.cap() == low
+
+
+def test_stream_slice_stats_recorded(make_persister):
+    p, queries = _skewed_stream_store(make_persister)
+    engine = TpuCheckEngine(p, p.namespaces, max_batch=32)
+    engine.stream_slice_stats.reset()
+    list(engine.batch_check_stream(iter(queries)))
+    snap_stats = engine.stream_slice_stats.snapshot()
+    assert snap_stats["count"] >= len(queries) // 32
+    assert snap_stats["p50_ms"] >= 0.0
+
+
+def test_check_batcher_streams_tpu_engine(make_persister):
+    """CheckBatcher routes coalesced batches through the unordered stream
+    fast path against the TPU engine: every caller's future resolves with
+    the correct decision + snaptoken."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from keto_tpu.driver.batch import CheckBatcher
+
+    p, queries = _skewed_stream_store(make_persister)
+    engine = TpuCheckEngine(p, p.namespaces, max_batch=32)
+    want = engine.batch_check(queries)
+    b = CheckBatcher(engine, batch_size=64, window_ms=5.0)
+    b.start()
+    try:
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            got = list(pool.map(lambda q: b.check(q, timeout=30.0), queries))
+    finally:
+        b.stop()
+    assert got == want
+
+
+# -- bulk pattern resolution --------------------------------------------------
+
+
+def test_bulk_allwildcard_10k_batch(make_persister):
+    """10k all-wildcard queries (every field empty) resolve through ONE
+    bulk pass — and an all-wildcard check grants exactly the users that
+    are the subject of at least one tuple ("reached via >= 1 edge" from
+    the universal start set)."""
+    import numpy as np
+
+    rng = random.Random(31)
+    p = make_persister([("g", 1), ("d", 2)])
+    n_users = 400
+    rows = []
+    for i in range(2000):
+        if rng.random() < 0.7:
+            rows.append(T("g", f"o{rng.randrange(60)}", "r", SubjectID(f"u{rng.randrange(n_users)}")))
+        else:
+            rows.append(
+                T(rng.choice(["g", "d"]), f"o{rng.randrange(60)}", "r",
+                  SubjectSet("g", f"o{rng.randrange(60)}", "r"))
+            )
+    p.write_relation_tuples(*rows)
+    subjects = {r.subject.id for r in rows if isinstance(r.subject, SubjectID)}
+
+    engine = TpuCheckEngine(p, p.namespaces)
+    queries, expected = [], []
+    for i in range(10_000):
+        u = f"u{rng.randrange(2 * n_users)}"  # half the id space never granted
+        queries.append(T("", "", "", SubjectID(u)))
+        expected.append(u in subjects)
+    got = engine.batch_check(queries)
+    assert got == expected
+    # spot-check parity vs the oracle on a sample
+    oracle = CheckEngine(p)
+    sample = rng.sample(range(10_000), 40)
+    assert [got[i] for i in sample] == [
+        oracle.subject_is_allowed(queries[i]) for i in sample
+    ]
+
+
+def test_resolve_starts_bulk_matches_scalar(make_persister):
+    """resolve_starts_bulk == resolve_starts for every pattern family,
+    probed on a FRESH snapshot each way so the bulk path cannot ride the
+    scalar path's cache."""
+    rng = random.Random(44)
+    p = make_persister([("g", 1), ("d", 2), ("", 3)])
+    rows = []
+    for i in range(1500):
+        sub = (
+            SubjectID(f"u{i % 40}")
+            if rng.random() < 0.6
+            else SubjectSet("g", f"o{rng.randrange(30)}", rng.choice(["r0", "r1"]))
+        )
+        rows.append(
+            T(rng.choice(["g", "d"]), f"o{rng.randrange(30)}", rng.choice(["r0", "r1"]), sub)
+        )
+    p.write_relation_tuples(*rows)
+    engine = TpuCheckEngine(p, p.namespaces)
+    pats = [
+        (1, "o1", ""), (1, "", "r0"), (1, "", ""), (2, "o2", "r1"),
+        (-1, "o2", "r1"), (-1, "o3", ""), (-1, "", "r1"), (-1, "", ""),
+        (1, "absent", ""), (-1, "", "absent"), (1, "o1", ""),  # dup on purpose
+    ]
+    bulk = engine.snapshot().resolve_starts_bulk(pats)
+    fresh = TpuCheckEngine(p, p.namespaces).snapshot()
+    for pat, got in zip(pats, bulk):
+        want = fresh.resolve_starts(*pat)
+        assert got.tolist() == want.tolist(), pat
