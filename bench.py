@@ -263,6 +263,7 @@ def build_workload_github(rng, n_tuples):
 
     ctx = dict(
         n_users=n_users,
+        n_teams=n_teams,
         issue_repo=issue_repo,
         pull_repo=pull_repo,
         repo_reader=repo_reader,
@@ -365,6 +366,94 @@ def stream_pass(engine, snap, queries, tag):
         "stream_adaptive_cap": ctrl["cap"],
         "stream_slices": len(slice_lat),
     }
+
+
+def incremental_pass(engine, store, burst, sample_queries, tag, ingest_s, snapshot_s):
+    """Incremental-maintenance metrics for one config: write-burst
+    absorption (staleness window + compaction time vs the from-scratch
+    rebuild it replaces, with decision parity), then snapshot-cache save
+    and cold-start reload (with parity and the cold-start speedup vs
+    ingest+build). Returns a metrics dict; measurement failures degrade to
+    an ``incremental_error`` field rather than losing the config's
+    headline numbers."""
+    import tempfile
+
+    from keto_tpu.check.tpu_engine import TpuCheckEngine
+
+    out = {"burst_edges": len(burst)}
+    try:
+        t0 = time.perf_counter()
+        store.write_relation_tuples(*burst)
+        out["burst_write_s"] = round(time.perf_counter() - t0, 3)
+        # staleness window: how long mode="serving" answers lag the burst
+        t0 = time.perf_counter()
+        deadline = t0 + 600
+        while time.perf_counter() < deadline:
+            if engine.snapshot_serving().snapshot_id >= store.watermark():
+                break
+            time.sleep(0.005)
+        out["burst_staleness_s"] = round(time.perf_counter() - t0, 3)
+        # wait for the overlay to fold (inline on the next snapshot() when
+        # over budget, else the background compaction kick)
+        t0 = time.perf_counter()
+        while time.perf_counter() < deadline:
+            if not engine.snapshot().has_overlay:
+                break
+            time.sleep(0.05)
+        out["burst_fold_wait_s"] = round(time.perf_counter() - t0, 3)
+        maint = engine.maintenance.snapshot()
+        out["compactions"] = int(maint.get("compactions", 0))
+        out["compaction_s"] = round(maint.get("compaction_last_ms", 0.0) / 1e3, 3)
+        out["burst_full_rebuilds"] = int(maint.get("full_rebuilds", 0)) - 1  # -1: initial build
+
+        # decision parity + honest comparator: a from-scratch rebuild
+        t0 = time.perf_counter()
+        fresh = TpuCheckEngine(store, store.namespaces)
+        fresh.snapshot()
+        out["rebuild_after_burst_s"] = round(time.perf_counter() - t0, 2)
+        got = engine.batch_check(sample_queries)
+        ref = fresh.batch_check(sample_queries)
+        out["burst_mismatches_vs_rebuild"] = sum(g != r for g, r in zip(got, ref))
+
+        # snapshot cache: save the folded snapshot, reload cold, compare
+        cache_dir = os.environ.get("BENCH_CACHE_DIR") or tempfile.mkdtemp(
+            prefix=f"keto-snapcache-{tag}-"
+        )
+        engine._cache_dir = cache_dir
+        t0 = time.perf_counter()
+        path = engine.save_snapshot_cache()
+        out["cache_save_s"] = round(time.perf_counter() - t0, 2)
+        if path is None:
+            out["incremental_error"] = "snapshot not cacheable"
+            return out
+        cold = TpuCheckEngine(store, store.namespaces, snapshot_cache_dir=cache_dir)
+        t0 = time.perf_counter()
+        cold.snapshot()
+        out["cache_reload_s"] = round(time.perf_counter() - t0, 3)
+        base_cost = (ingest_s or 0.0) + (snapshot_s or 0.0)
+        out["cold_start_speedup_vs_build"] = (
+            round(base_cost / out["cache_reload_s"], 1)
+            if out["cache_reload_s"] > 0
+            else None
+        )
+        got_cold = cold.batch_check(sample_queries)
+        out["cache_mismatches_vs_rebuild"] = sum(
+            g != r for g, r in zip(got_cold, ref)
+        )
+        log(
+            f"[{tag}] incremental: burst {len(burst)} edges absorbed in "
+            f"{out['compaction_s']:.2f}s compaction (staleness "
+            f"{out['burst_staleness_s']*1e3:.0f} ms, rebuild would cost "
+            f"{out['rebuild_after_burst_s']:.1f}s, mismatches "
+            f"{out['burst_mismatches_vs_rebuild']}); cache save "
+            f"{out['cache_save_s']:.1f}s reload {out['cache_reload_s']:.2f}s "
+            f"({out['cold_start_speedup_vs_build']}x vs ingest+build, "
+            f"mismatches {out['cache_mismatches_vs_rebuild']})"
+        )
+    except Exception as e:  # pragma: no cover - diagnostic path
+        log(f"[{tag}] incremental pass FAILED: {e!r}")
+        out["incremental_error"] = repr(e)
+    return out
 
 
 def run_config2(rng):
@@ -533,6 +622,24 @@ def run_config4(rng):
         f"oracle: {oracle_qps:,.0f} checks/s; wrong_vs_expected={n_wrong} "
         f"tpu_vs_oracle_mismatch={mismatch}"
     )
+    # incremental maintenance: a write burst of new memberships (new leaf
+    # users on existing teams — the compactable common case) + cache
+    incremental = {}
+    if os.environ.get("BENCH_INCREMENTAL", "1") != "0":
+        from keto_tpu.relationtuple.model import SubjectID
+
+        n_burst = int(os.environ.get("BENCH_BURST", 5000))
+        burst = [
+            ctx["T"](
+                "teams", f"team-{rng.randrange(ctx['n_teams'])}", "member",
+                SubjectID(f"burst-user-{i}"),
+            )
+            for i in range(n_burst)
+        ]
+        incremental = incremental_pass(
+            engine, store, burst, queries[:2000], "c4", ingest_s, snapshot_s
+        )
+
     metrics = {
         "tuples": len(tuples),
         "checks": n_checks,
@@ -545,6 +652,7 @@ def run_config4(rng):
         "stream_wrong": stream_wrong,
         "ingest_s": round(ingest_s, 2),
         "snapshot_build_s": round(snapshot_s, 2),
+        **incremental,
         "hbm_bytes_est": hbm_buckets + hbm_bitmaps,
         "oracle_checks_per_s": round(oracle_qps, 1),
         "correct_vs_expected": n_wrong == 0,
@@ -646,6 +754,23 @@ def run_config5(rng):
     n_wrong = int((got != expected[:n_done]).sum())
     qps = stream_metrics["stream_checks_per_s"]
     log(f"[c5] wrong={n_wrong} over {n_done} checks")
+
+    incremental = {}
+    if os.environ.get("BENCH_INCREMENTAL", "1") != "0":
+        from keto_tpu.relationtuple.model import SubjectID
+
+        n_burst = int(os.environ.get("BENCH_BURST", 5000))
+        n_leaf = max(20, n_tuples // 125)  # build_workload's leaf-group count
+        brng = random.Random(9)
+        burst = [
+            T("groups", f"leaf-{brng.randrange(n_leaf)}", "member",
+              SubjectID(f"burst-{i}"))
+            for i in range(n_burst)
+        ]
+        incremental = incremental_pass(
+            engine, store, burst, queries[:2000], "c5", ingest_s, snapshot_s
+        )
+
     metrics = {
         "tuples": n_tuples,
         "checks": n_done,
@@ -656,6 +781,7 @@ def run_config5(rng):
         "wrong": n_wrong,
         "ingest_s": round(ingest_s, 1),
         "snapshot_build_s": round(snapshot_s, 1),
+        **incremental,
     }
     log("[c5] " + json.dumps({"metric": "check_throughput_50m_stream", "value": metrics["checks_per_s"], "unit": "checks/s", "detail": metrics}))
     return metrics

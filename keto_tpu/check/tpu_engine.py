@@ -60,7 +60,7 @@ from keto_tpu import namespace as namespace_pkg
 from keto_tpu.graph.snapshot import WILDCARD, GraphSnapshot, build_snapshot
 from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
 from keto_tpu.x.errors import ErrNamespaceUnknown
-from keto_tpu.x.telemetry import DurationStats
+from keto_tpu.x.telemetry import DurationStats, MaintenanceStats
 
 _log = logging.getLogger("keto_tpu.check")
 
@@ -581,6 +581,8 @@ class TpuCheckEngine:
         sync_rebuild_budget_s: float = 0.25,
         lockstep_verify: bool = True,
         stream_slice_target_ms: float = 40.0,
+        overlay_edge_budget: int = 4096,
+        snapshot_cache_dir: Optional[str] = None,
     ):
         if it_cap < 1:
             raise ValueError("it_cap must be >= 1 (the answer pull needs one step)")
@@ -629,14 +631,26 @@ class TpuCheckEngine:
             self._ov_dst_sharding = NamedSharding(mesh, P(GRAPH_AXIS))
         self._lock = threading.Lock()
         self._snapshot: Optional[GraphSnapshot] = None
-        # delta overlays beyond this edge count trigger a full rebuild (the
-        # overlay ELL stage and host merge costs grow with overlay size)
-        self._max_overlay_edges = 4096
-        # an overlay older than this compacts in the background (a full
-        # rebuild served from the old snapshot): without it an insert-only
-        # workload would keep a small overlay — and everything gated on it,
-        # e.g. expand's Manager delegation — alive forever
+        # delta overlays beyond this edge count trigger COMPACTION — the
+        # overlay folds into the base layout by segment
+        # (keto_tpu/graph/compaction.py) in seconds instead of the old
+        # full-rebuild fallback; only overlays past the hard cap (or
+        # shapes compaction can't fold) still rebuild from scratch
+        self._max_overlay_edges = int(overlay_edge_budget)
+        # an overlay older than this compacts in the background: without
+        # it an insert-only workload would keep a small overlay — and
+        # everything gated on it, e.g. expand's Manager delegation —
+        # alive forever
         self._compact_after_s = compact_after_s
+        # persistent snapshot cache (keto_tpu/graph/snapcache.py): reload
+        # on cold start, save in the background after every full build
+        self._cache_dir = snapshot_cache_dir or None
+        self._cache_save: Optional[threading.Thread] = None
+        #: maintenance counters operators + bench read (overlay occupancy,
+        #: compaction/rebuild counts and durations, cache save/reload)
+        self.maintenance = MaintenanceStats()
+        self.maintenance.set_gauge("overlay_budget", self._max_overlay_edges)
+        self.maintenance.set_gauge("overlay_edges", 0)
         self._peel_seed_cap = peel_seed_cap
         self._overlay_born: Optional[float] = None
         self._bg_rebuild: Optional[threading.Thread] = None
@@ -714,6 +728,10 @@ class TpuCheckEngine:
             try:
                 got = self._refresh_locked(delta_only=True)
                 if got is not None:
+                    if self._overlay_edge_count(got) > self._max_overlay_edges:
+                        # serve fresh NOW; fold the oversized overlay into
+                        # the base layout off the serving path
+                        self._kick_background_refresh(force_full=True)
                     return got
             finally:
                 self._lock.release()
@@ -761,12 +779,17 @@ class TpuCheckEngine:
         self, force_full: bool = False, delta_only: bool = False
     ) -> Optional[GraphSnapshot]:
         """Bring the snapshot to the current watermark (caller holds the
-        lock): delta overlay when possible, full rebuild otherwise (or
-        always, for an overlay compaction pass). With ``delta_only``,
-        returns None instead of rebuilding (the serving path's
-        never-stall contract — snapshot_serving falls back to stale)."""
+        lock): delta overlay when possible; an overlay past the edge
+        budget (or a quiet one, via ``force_full``) folds into the base
+        layout by segment (keto_tpu/graph/compaction.py); a full rebuild
+        is the fallback for shapes compaction can't express. With
+        ``delta_only``, returns None instead of rebuilding (the serving
+        path's never-stall contract — snapshot_serving falls back to
+        stale; oversized overlays still apply and compact off-path)."""
         snap = self._snapshot
         wm = self._store.watermark()
+        if snap is None and self._cache_dir is not None and not delta_only:
+            snap = self._load_cache_locked(wm)
         if snap is not None and snap.snapshot_id == wm and not (
             force_full and snap.has_overlay
         ):
@@ -775,8 +798,19 @@ class TpuCheckEngine:
             n.id for n in self._nm().namespaces() if n.name == ""
         )
         new = None
-        if snap is not None and not force_full:
+        if snap is not None:
             new = self._try_delta(snap, wild_ns_ids)
+            if new is not None:
+                self.maintenance.incr("delta_applies")
+                n_ov = self._overlay_edge_count(new)
+                self.maintenance.set_gauge("overlay_edges", n_ov)
+                over = force_full or n_ov > self._max_overlay_edges
+                if over and new.has_overlay and not delta_only:
+                    compacted = self._compact_locked(new)
+                    if compacted is not None:
+                        new = compacted
+                    elif force_full or n_ov > self._max_overlay_edges:
+                        new = None  # fold requires a real re-layout
         if new is None:
             if delta_only:
                 return None
@@ -790,6 +824,10 @@ class TpuCheckEngine:
             )
             self._upload_buckets(new)
             self._last_full_build_s = time.monotonic() - t0
+            self.maintenance.incr("full_rebuilds")
+            self.maintenance.observe_ms(
+                "full_rebuild", self._last_full_build_s * 1e3
+            )
         self._apply_ell_patch(new)
         self._upload_overlay(new)
         self._snapshot = new
@@ -798,7 +836,23 @@ class TpuCheckEngine:
                 self._overlay_born = time.monotonic()
         else:
             self._overlay_born = None
+            self.maintenance.set_gauge("overlay_edges", 0)
+            self._kick_cache_save(new)
         return new
+
+    def _overlay_edge_count(self, snap: GraphSnapshot) -> int:
+        """Overlay occupancy: pending delta edges + tombstones (the number
+        the budget gauges)."""
+        n = 0
+        if snap.ov_ell is not None:
+            n += int(snap.ov_ell.shape[0])
+        if snap.ov_removed is not None:
+            n += int(snap.ov_removed.size)
+        if snap.ov_out:
+            n += sum(int(np.asarray(v).size) for v in snap.ov_out.values())
+        if snap.ov_sink_in:
+            n += sum(int(np.asarray(v).size) for v in snap.ov_sink_in.values())
+        return n
 
     def _try_delta(
         self, base: GraphSnapshot, wild_ns_ids
@@ -806,8 +860,9 @@ class TpuCheckEngine:
         """Apply a watermark advance as an overlay (no re-intern, no
         relayout; inserts extend the overlay, deletes tombstone —
         keto_tpu/graph/overlay.py). None when the store can't produce a
-        delta (log overflow, no support) or the delta needs a class
-        change."""
+        delta (log overflow, no support), the delta needs a class change,
+        or the overlay would exceed the hard cap (budget overflows below
+        the cap now COMPACT instead of rebuilding — _refresh_locked)."""
         from keto_tpu.graph.overlay import apply_delta, rows_as_ops
 
         changes_since = getattr(self._store, "changes_since", None)
@@ -828,9 +883,120 @@ class TpuCheckEngine:
         n_ov = len(ops) + (base.ov_ell.shape[0] if base.ov_ell is not None else 0)
         if base.ov_removed is not None:
             n_ov += int(base.ov_removed.size)
-        if n_ov > self._max_overlay_edges:
+        # hard cap: past this, per-delta overlay merge costs outgrow even
+        # a rebuild; the budget itself is a compaction trigger, not a
+        # bail. The 64k floor keeps small-budget configs from rebuilding
+        # on bursts compaction absorbs in milliseconds.
+        if n_ov > max(4 * self._max_overlay_edges, 65536):
             return None
         return apply_delta(base, ops, new_wm, wild_ns_ids)
+
+    def _compact_locked(self, snap: GraphSnapshot) -> Optional[GraphSnapshot]:
+        """Fold ``snap``'s overlay into its base layout (caller holds the
+        lock). Only the touched buckets re-upload; everything else —
+        device arrays, interner, kernel geometries — is reused. None when
+        the overlay's shape needs the full-rebuild fallback."""
+        from keto_tpu.graph.compaction import compact_snapshot
+
+        t0 = time.monotonic()
+        # flush pending device-bucket patches first: compaction reuses
+        # untouched device buckets, which is only sound when they agree
+        # with the host arrays modulo the tombstones it re-uploads (an
+        # unapplied restore patch would otherwise leave a stale sentinel)
+        self._apply_ell_patch(snap)
+        got = compact_snapshot(snap)
+        if got is None:
+            return None
+        new = got.snapshot
+        if got.touched_buckets or new.device_buckets is None:
+            if new.device_buckets is None:
+                self._upload_buckets(new)
+            else:
+                bufs = list(new.device_buckets)
+                for bi in got.touched_buckets:
+                    bufs[bi] = self._put_bucket(new.buckets[bi].nbrs, new.num_int)
+                new.device_buckets = tuple(bufs)
+        ms = (time.monotonic() - t0) * 1e3
+        self.maintenance.incr("compactions")
+        self.maintenance.observe_ms("compaction", ms)
+        _log.info(
+            "overlay compacted in %.1f ms (%d buckets re-uploaded)",
+            ms, len(got.touched_buckets),
+        )
+        return new
+
+    # -- snapshot cache ------------------------------------------------------
+
+    def _load_cache_locked(self, store_wm: int) -> Optional[GraphSnapshot]:
+        """Cold start: reload the newest usable cached snapshot
+        (keto_tpu/graph/snapcache.py) and install it; the caller then
+        catches up to the store watermark through the ordinary delta
+        path. None when no cache fits (wrong watermark range, wildcard
+        config drift, unreadable)."""
+        from keto_tpu.graph import snapcache
+
+        t0 = time.monotonic()
+        snap = snapcache.load_latest(self._cache_dir, max_watermark=store_wm)
+        if snap is None:
+            return None
+        wild_now = frozenset(
+            n.id for n in self._nm().namespaces() if n.name == ""
+        )
+        if snap.wild_ns_ids != wild_now:
+            return None  # namespace config changed — expansion differs
+        self._upload_buckets(snap)
+        self._snapshot = snap
+        ms = (time.monotonic() - t0) * 1e3
+        self.maintenance.incr("cache_loads")
+        self.maintenance.observe_ms("cache_reload", ms)
+        _log.info(
+            "snapshot cache reloaded (watermark %d) in %.1f ms",
+            snap.snapshot_id, ms,
+        )
+        return snap
+
+    def _kick_cache_save(self, snap: GraphSnapshot) -> None:
+        """Persist an overlay-free snapshot in the background (at most one
+        save in flight; failures log and never affect serving)."""
+        if self._cache_dir is None or snap.has_overlay:
+            return
+        t = self._cache_save
+        if t is not None and t.is_alive():
+            return
+
+        def run():
+            from keto_tpu.graph import snapcache
+
+            t0 = time.monotonic()
+            try:
+                path = snapcache.save_snapshot(snap, self._cache_dir)
+            except Exception:
+                _log.warning("snapshot cache save failed", exc_info=True)
+                return
+            if path is not None:
+                self.maintenance.incr("cache_saves")
+                self.maintenance.observe_ms(
+                    "cache_save", (time.monotonic() - t0) * 1e3
+                )
+
+        t = threading.Thread(target=run, name="keto-tpu-snapshot-save", daemon=True)
+        self._cache_save = t
+        t.start()
+
+    def save_snapshot_cache(self) -> Optional[str]:
+        """Synchronously persist the current snapshot (bench/operator
+        seam); returns the cache path or None when not cacheable."""
+        if self._cache_dir is None:
+            return None
+        snap = self.snapshot()
+        from keto_tpu.graph import snapcache
+
+        t0 = time.monotonic()
+        path = snapcache.save_snapshot(snap, self._cache_dir)
+        if path is not None:
+            self.maintenance.incr("cache_saves")
+            self.maintenance.observe_ms("cache_save", (time.monotonic() - t0) * 1e3)
+        return path
 
     def _apply_ell_patch(self, snap: GraphSnapshot) -> None:
         """Apply a delta's pending device-bucket patches (tombstoned /
@@ -857,26 +1023,27 @@ class TpuCheckEngine:
             bufs[bi] = out
         snap.device_buckets = tuple(bufs)
 
-    def _upload_buckets(self, snap: GraphSnapshot) -> None:
+    def _put_bucket(self, nbrs: np.ndarray, num_int: int):
+        """Place one bucket matrix on device. On a mesh, rows pad up to a
+        multiple of the graph axis with sentinel rows (gathered from the
+        all-zero bitmap row, discarded by the _pull valid-row slice) and
+        shard over it — replicating instead (the old fallback for
+        non-divisible buckets) made SPMD materialize cross-shard gathers
+        via select+all-reduce with an "Involuntary full rematerialization"
+        on every BFS step."""
         if self._mesh is None:
-            snap.device_buckets = tuple(jax.device_put(b.nbrs) for b in snap.buckets)
-            return
-        # every bucket row-shards over the graph axis — rows pad up to a
-        # multiple of the axis size with sentinel rows (gathered from the
-        # all-zero bitmap row, discarded by the _pull valid-row slice).
-        # Replicating instead (the old fallback for non-divisible buckets)
-        # made SPMD materialize cross-shard gathers via select+all-reduce
-        # with an "Involuntary full rematerialization" on every BFS step.
+            return jax.device_put(np.ascontiguousarray(nbrs))
         g = self._mesh.shape.get("graph", 1)
-        dev = []
-        for b in snap.buckets:
-            nbrs = b.nbrs
-            rem = (-nbrs.shape[0]) % g
-            if rem:
-                pad = np.full((rem, nbrs.shape[1]), snap.num_int, np.int32)
-                nbrs = np.concatenate([nbrs, pad], axis=0)
-            dev.append(jax.device_put(nbrs, self._bucket_sharding))
-        snap.device_buckets = tuple(dev)
+        rem = (-nbrs.shape[0]) % g
+        if rem:
+            pad = np.full((rem, nbrs.shape[1]), num_int, np.int32)
+            nbrs = np.concatenate([nbrs, pad], axis=0)
+        return jax.device_put(np.ascontiguousarray(nbrs), self._bucket_sharding)
+
+    def _upload_buckets(self, snap: GraphSnapshot) -> None:
+        snap.device_buckets = tuple(
+            self._put_bucket(b.nbrs, snap.num_int) for b in snap.buckets
+        )
 
     def _upload_overlay(self, snap: GraphSnapshot) -> None:
         """Group overlay-ELL edges by destination into a [K, C] gather
@@ -1015,12 +1182,18 @@ class TpuCheckEngine:
         multi: dict = {}
         if special:
             self._resolve_specials(snap, tuples, special, sd, tg, multi)
-        if snap.ov_set_ids or snap.ov_leaf_ids:
-            # nodes created since the base build are invisible to the
-            # resident C++ tables — re-resolve the queries whose start or
-            # target missed through the overlay-aware host path, in ONE
-            # bulk call (tg == -1 includes every guaranteed deny, so
-            # deny-heavy workloads would otherwise loop per query)
+        if (
+            snap.ov_set_ids
+            or snap.ov_leaf_ids
+            or getattr(snap.interned, "has_ext", False)
+        ):
+            # nodes created since the base build — overlay nodes, or
+            # compaction-folded extension nodes (interner.ExtendedInterned)
+            # — are invisible to the resident C++ tables: re-resolve the
+            # queries whose start or target missed through the
+            # extension-aware host path, in ONE bulk call (tg == -1
+            # includes every guaranteed deny, so deny-heavy workloads
+            # would otherwise loop per query)
             done = set(special) | set(dead)
             miss = [
                 int(i)

@@ -60,6 +60,16 @@ CONFIG_SCHEMA = {
                     "default": 40.0,
                     "description": "Streaming check pipeline: per-slice service-time target in milliseconds. The engine's adaptive controller narrows/widens the per-slice query cap along the compiled width ladder toward this target — lower values trade batch throughput for per-slice serving latency. Ignored on multi-controller meshes (slice geometry must be identical on every host).",
                 },
+                "overlay_edge_budget": {
+                    "type": "integer",
+                    "default": 4096,
+                    "description": "Delta-overlay edge budget: past this many pending overlay edges + tombstones, the engine folds the overlay into the base layout by segment (overlay compaction — seconds, ids stable) instead of serving an ever-growing overlay; only overlays past 4x the budget (or shapes compaction cannot fold) fall back to a full rebuild. Overlay occupancy against this budget is exposed via the engine's maintenance counters.",
+                },
+                "snapshot_cache_dir": {
+                    "type": "string",
+                    "default": "",
+                    "description": "Directory for the persistent snapshot cache. When set, every full snapshot build is serialized here (versioned, keyed by watermark) and cold start mmap-reloads the newest cache at or below the store watermark, then catches up through the delta path — minutes of ingest+build become seconds. Empty disables caching.",
+                },
             },
         },
         "namespaces": {

@@ -69,6 +69,7 @@ class Daemon:
         # way the reference resolves config during registry Init
         # (reference registry_default.go:240-261) — not on first request
         self.registry.namespace_manager()
+        self._warm_snapshot()
         read_host, read_port = cfg.read_api_address()
         write_host, write_port = cfg.write_api_address()
         self._roles[READ] = self._start_role(READ, read_host, read_port)
@@ -78,6 +79,28 @@ class Daemon:
                 threading.Event().wait()
             except KeyboardInterrupt:
                 self.shutdown()
+
+    def _warm_snapshot(self) -> None:
+        """Kick the first snapshot build/reload off the request path: with
+        a snapshot cache configured (serve.snapshot_cache_dir) the engine
+        mmap-reloads in seconds and catches up from the cached watermark
+        through the delta path; without one this merely moves the first
+        request's build cost to boot. Failures log and defer to the
+        ordinary first-request path."""
+        engine = self.registry.permission_engine()
+        if not hasattr(engine, "snapshot"):
+            return
+
+        def run():
+            try:
+                engine.snapshot()
+            except Exception:
+                self.registry.logger().warning(
+                    "boot snapshot warm failed; first request will build",
+                    exc_info=True,
+                )
+
+        threading.Thread(target=run, name="keto-tpu-snapshot-warm", daemon=True).start()
 
     @property
     def read_port(self) -> int:

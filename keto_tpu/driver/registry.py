@@ -112,6 +112,13 @@ class Registry:
                     stream_slice_target_ms=float(
                         self._config.get("serve.stream_slice_target_ms", 40.0)
                     ),
+                    overlay_edge_budget=int(
+                        self._config.get("serve.overlay_edge_budget", 4096)
+                    ),
+                    snapshot_cache_dir=(
+                        str(self._config.get("serve.snapshot_cache_dir", "") or "")
+                        or None
+                    ),
                 )
             return CheckEngine(store)
 

@@ -109,6 +109,13 @@ class InternedGraph:
     def rel_code(self, s: str) -> int:
         return self.rel_codes.get(s, -1)
 
+    def num_obj_codes(self) -> int:
+        """Code-table size (ExtendedInterned assigns fresh codes above)."""
+        return len(self.obj_codes)
+
+    def num_rel_codes(self) -> int:
+        return len(self.rel_codes)
+
     # -- reverse lookups (expand-tree reconstruction) ------------------------
 
     def set_key_of(self, raw_id: int):
@@ -130,6 +137,180 @@ class InternedGraph:
                 inv[i] = s
             self.__dict__["_leaf_by_id"] = inv
         return inv[idx]
+
+
+class ExtendedInterned:
+    """Copy-on-write interner view: an immutable base interner plus small
+    append-only extension tables for nodes added by overlay compaction
+    (keto_tpu/graph/compaction.py).
+
+    The base is NEVER mutated — snapshots sharing it (in-flight batches on
+    the pre-compaction snapshot) stay consistent; the extension is tiny
+    (one entry per overlay node folded in). Raw-id numbering matches a
+    grown interner: ext set keys take raw ids [base.num_sets,
+    num_sets) in fold order, which shifts every leaf's unified raw id by
+    the ext set count — the compaction layer rebuilds ``raw2dev``
+    accordingly. New field codes are assigned above the base code-table
+    sizes, so they can never collide with (or shadow) base codes in the
+    snapshot's pattern indexes. Ext keys are always literal: apply_delta
+    rejects new wildcard-bearing keys, so ``key_wild`` extends with False.
+
+    Nesting flattens: extending an ExtendedInterned copies its (small)
+    ext tables onto the same base rather than stacking wrappers.
+    """
+
+    #: engines consult this to re-resolve native-path misses through the
+    #: host path (ext nodes are invisible to the resident base tables)
+    has_ext = True
+
+    def __init__(self, base, new_set_keys, new_leaves):
+        if isinstance(base, ExtendedInterned):
+            self._base = base._base
+            self._ext_set_keys = list(base._ext_set_keys)
+            self._ext_leaves = list(base._ext_leaves)
+            self._ext_obj_codes = dict(base._ext_obj_codes)
+            self._ext_rel_codes = dict(base._ext_rel_codes)
+        else:
+            self._base = base
+            self._ext_set_keys = []
+            self._ext_leaves = []
+            self._ext_obj_codes = {}
+            self._ext_rel_codes = {}
+        b = self._base
+        self._base_num_sets = b.num_sets
+        self._base_num_leaves = b.num_leaves
+        # base code-table sizes: the floor for fresh ext codes. None (a
+        # stale native .so without the size exports) is the caller's
+        # problem — compaction checks before constructing.
+        self._obj_floor = b.num_obj_codes()
+        self._rel_floor = b.num_rel_codes()
+        if self._obj_floor is None or self._rel_floor is None:
+            raise ValueError("base interner does not expose code-table sizes")
+        for key in new_set_keys:
+            self._ext_set_keys.append(
+                (int(key[0]), str(key[1]), str(key[2]))
+            )
+        self._ext_leaves.extend(str(s) for s in new_leaves)
+        self._ext_set_ids = {
+            k: self._base_num_sets + i for i, k in enumerate(self._ext_set_keys)
+        }
+        self._ext_leaf_ids = {
+            s: self._base_num_leaves + i for i, s in enumerate(self._ext_leaves)
+        }
+        # intern ext key field codes (reusing base codes where the string
+        # already exists) and build the concatenated key arrays
+        ext_obj = np.empty(len(self._ext_set_keys), np.int64)
+        ext_rel = np.empty(len(self._ext_set_keys), np.int64)
+        ext_ns = np.empty(len(self._ext_set_keys), np.int64)
+        self._ext_obj_strs = {c: s for s, c in self._ext_obj_codes.items()}
+        self._ext_rel_strs = {c: s for s, c in self._ext_rel_codes.items()}
+        for i, (ns, obj, rel) in enumerate(self._ext_set_keys):
+            ext_ns[i] = ns
+            ext_obj[i] = self._intern_field(obj, self._ext_obj_codes,
+                                            self._ext_obj_strs, b.obj_code,
+                                            self._obj_floor)
+            ext_rel[i] = self._intern_field(rel, self._ext_rel_codes,
+                                            self._ext_rel_strs, b.rel_code,
+                                            self._rel_floor)
+        self.key_ns = np.concatenate([np.asarray(b.key_ns, np.int64), ext_ns])
+        self.key_obj = np.concatenate([np.asarray(b.key_obj, np.int64), ext_obj])
+        self.key_rel = np.concatenate([np.asarray(b.key_rel, np.int64), ext_rel])
+        self.key_wild = np.concatenate(
+            [np.asarray(b.key_wild, bool), np.zeros(len(self._ext_set_keys), bool)]
+        )
+
+    @staticmethod
+    def _intern_field(s, ext_codes, ext_strs, base_lookup, floor):
+        c = base_lookup(s)
+        if c >= 0:
+            return c
+        c = ext_codes.get(s)
+        if c is None:
+            c = floor + len(ext_codes)
+            ext_codes[s] = c
+            ext_strs[c] = s
+        return c
+
+    @property
+    def num_sets(self) -> int:
+        return self._base_num_sets + len(self._ext_set_keys)
+
+    @property
+    def num_leaves(self) -> int:
+        return self._base_num_leaves + len(self._ext_leaves)
+
+    @property
+    def num_nodes(self) -> int:
+        return self.num_sets + self.num_leaves
+
+    @property
+    def n_ext_sets(self) -> int:
+        return len(self._ext_set_keys)
+
+    @property
+    def n_ext(self) -> int:
+        return len(self._ext_set_keys) + len(self._ext_leaves)
+
+    def num_obj_codes(self) -> int:
+        return self._obj_floor + len(self._ext_obj_codes)
+
+    def num_rel_codes(self) -> int:
+        return self._rel_floor + len(self._ext_rel_codes)
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve_set(self, ns_id: int, obj: str, rel: str) -> int:
+        raw = self._base.resolve_set(ns_id, obj, rel)
+        if raw >= 0:
+            return raw
+        return self._ext_set_ids.get((ns_id, obj, rel), -1)
+
+    def resolve_leaf(self, subject_id: str) -> int:
+        raw = self._base.resolve_leaf(subject_id)
+        if raw >= 0:
+            return raw
+        return self._ext_leaf_ids.get(subject_id, -1)
+
+    def obj_code(self, s: str) -> int:
+        c = self._base.obj_code(s)
+        if c >= 0:
+            return c
+        return self._ext_obj_codes.get(s, -1)
+
+    def rel_code(self, s: str) -> int:
+        c = self._base.rel_code(s)
+        if c >= 0:
+            return c
+        return self._ext_rel_codes.get(s, -1)
+
+    def resolve_queries(self, buf: bytes, n: int):
+        """Bulk literal resolution through the base's native tables, with
+        leaf raw ids re-offset for the grown set count. Ext-only keys come
+        back -1; the engine re-resolves those misses through the host path
+        (``has_ext``). None when the base has no native bulk entry point."""
+        base_rq = getattr(self._base, "resolve_queries", None)
+        if base_rq is None:
+            return None
+        got = base_rq(buf, n)
+        if got is None:
+            return None
+        start, sub = got
+        k = len(self._ext_set_keys)
+        if k:
+            sub = np.where(sub >= self._base_num_sets, sub + k, sub)
+        return start, sub
+
+    # -- reverse lookups -----------------------------------------------------
+
+    def set_key_of(self, raw_id: int):
+        if raw_id < self._base_num_sets:
+            return self._base.set_key_of(raw_id)
+        return self._ext_set_keys[raw_id - self._base_num_sets]
+
+    def leaf_str(self, idx: int) -> str:
+        if idx < self._base_num_leaves:
+            return self._base.leaf_str(idx)
+        return self._ext_leaves[idx - self._base_num_leaves]
 
 
 def intern_rows(rows: Iterable, wild_ns_ids: FrozenSet[int] = frozenset()) -> InternedGraph:

@@ -73,6 +73,10 @@ def load_library() -> Optional[ctypes.CDLL]:
         for fn in ("graph_num_sets", "graph_num_leaves", "graph_num_edges"):
             getattr(lib, fn).restype = c
             getattr(lib, fn).argtypes = [p]
+        for fn in ("graph_num_obj_codes", "graph_num_rel_codes"):
+            if hasattr(lib, fn):
+                getattr(lib, fn).restype = c
+                getattr(lib, fn).argtypes = [p]
         lib.graph_edges.argtypes = [p, ctypes.POINTER(c), ctypes.POINTER(c)]
         lib.graph_release_edges.argtypes = [p]
         lib.graph_keys.argtypes = [
@@ -165,6 +169,19 @@ class NativeInterned:
     @property
     def num_nodes(self) -> int:
         return self.num_sets + self.num_leaves
+
+    def num_obj_codes(self) -> Optional[int]:
+        """Size of the object-string code table, or None when the loaded
+        .so predates the export (compaction then falls back to a full
+        rebuild rather than guessing a safe code range)."""
+        if not hasattr(self._lib, "graph_num_obj_codes"):
+            return None
+        return int(self._lib.graph_num_obj_codes(self._handle))
+
+    def num_rel_codes(self) -> Optional[int]:
+        if not hasattr(self._lib, "graph_num_rel_codes"):
+            return None
+        return int(self._lib.graph_num_rel_codes(self._handle))
 
     def __del__(self):
         lib = getattr(self, "_lib", None)

@@ -89,6 +89,11 @@ def apply_delta(
     needs a full rebuild."""
     if wild_ns_ids != base.wild_ns_ids:
         return None  # namespace config changed — wildcard expansion differs
+    if base.n_nodes == 0:
+        # an empty base has no device layout to overlay onto, and the
+        # engines' empty-graph early-outs would deny every query while
+        # the overlay pends — the first real build is trivially cheap
+        return None
 
     # net effect per tuple key: the last op wins (deletes remove ALL rows
     # of a key, so edge presence after the delta is decided by whether the

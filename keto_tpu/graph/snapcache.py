@@ -1,0 +1,392 @@
+"""Persistent snapshot cache: versioned on-disk layout, mmap reload.
+
+Cold start previously meant re-ingesting every tuple and rebuilding the
+device layout — minutes at 50M tuples — before the first check could be
+answered. This module serializes a built ``GraphSnapshot`` (CSR arrays,
+bucket matrices, interner tables, pattern-key columns) into a directory of
+raw ``.npy``/blob files keyed by ``(format_version, watermark)`` and
+reloads it with ``numpy`` memory-mapping: the arrays page in lazily, so a
+50M-tuple snapshot serves its first query in seconds. The engine then
+catches up from the cached watermark through the ordinary delta path
+(keto_tpu/graph/overlay.py) — the watermark doubles as the snaptoken, so
+the cache key IS the consistency token (docs/concepts/snaptokens.md).
+
+Layout (one directory per cached snapshot, written to a temp dir and
+renamed into place — a crashed save can never leave a half-readable
+cache):
+
+    <cache_dir>/v<FORMAT>-w<watermark>/
+        meta.json            scalars, bucket geometry, wild_ns_ids
+        raw2dev.npy fwd_indptr.npy fwd_indices.npy
+        sink_indptr.npy sink_indices.npy bucket_<i>.npy ...
+        key_ns.npy key_obj.npy key_rel.npy key_wild.npy
+        set_order.npy set_nsobj.npy set_rel.npy     (sorted set-key index)
+        {obj,rel,leaf}_blob.bin {obj,rel,leaf}_off.npy
+        {obj,rel,leaf}_hash.npy {obj,rel,leaf}_hord.npy
+
+The interner reloads as a ``CachedInterned``: string→code resolution runs
+as a crc32 probe into the sorted hash column (verified against the blob —
+collisions are handled, not assumed away), set-key resolution as two
+binary searches over the lexsorted ``(ns<<32|obj_code, rel_code)``
+columns. No dict is ever materialized, which is what keeps reload
+O(mmap) instead of O(rows). The native bulk-resolution entry point is
+absent on a cached interner; the check engine detects that and resolves
+through its host path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import zlib
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from keto_tpu.graph.snapshot import Bucket, GraphSnapshot
+
+#: bump when the on-disk layout or the snapshot's array semantics change —
+#: the version is part of the directory key, so old caches are simply
+#: never matched (and pruned as newer saves land)
+FORMAT_VERSION = 1
+
+#: caches kept per directory (newest watermarks win)
+KEEP = 2
+
+
+def _string_table(strings: list) -> Optional[tuple]:
+    """(utf-8 blob, offsets int64[n+1], sorted crc32 hashes uint32[n],
+    argsort order int64[n]) for a code-indexed string column."""
+    encoded = [s.encode() for s in strings]
+    n = len(encoded)
+    off = np.zeros(n + 1, np.int64)
+    if n:
+        off[1:] = np.cumsum([len(b) for b in encoded])
+    blob = b"".join(encoded)
+    hashes = np.fromiter((zlib.crc32(b) for b in encoded), np.uint32, n)
+    order = np.argsort(hashes, kind="stable")
+    return blob, off, hashes[order], order.astype(np.int64)
+
+
+def _obj_strings(interned, n: int) -> list:
+    """Code-indexed object-string column for any interner flavor."""
+    from keto_tpu.graph.interner import ExtendedInterned, InternedGraph
+
+    if isinstance(interned, InternedGraph):
+        out = [""] * n
+        for s, c in interned.obj_codes.items():
+            out[c] = s
+        return out
+    if isinstance(interned, ExtendedInterned):
+        base = _obj_strings(interned._base, interned._obj_floor)
+        return base + [
+            interned._ext_obj_strs[c]
+            for c in range(interned._obj_floor, n)
+        ]
+    if isinstance(interned, CachedInterned):
+        return [interned._obj_str(c) for c in range(n)]
+    return [interned._str_at("graph_obj_str", c) for c in range(n)]
+
+
+def _rel_strings(interned, n: int) -> list:
+    from keto_tpu.graph.interner import ExtendedInterned, InternedGraph
+
+    if isinstance(interned, InternedGraph):
+        out = [""] * n
+        for s, c in interned.rel_codes.items():
+            out[c] = s
+        return out
+    if isinstance(interned, ExtendedInterned):
+        base = _rel_strings(interned._base, interned._rel_floor)
+        return base + [
+            interned._ext_rel_strs[c]
+            for c in range(interned._rel_floor, n)
+        ]
+    if isinstance(interned, CachedInterned):
+        return [interned._rel_str(c) for c in range(n)]
+    return [interned._str_at("graph_rel_str", c) for c in range(n)]
+
+
+class CachedInterned:
+    """InternedGraph-compatible resolution over the mmapped cache arrays.
+
+    Implements the same interface the snapshot and engines consume
+    (resolve_set/resolve_leaf/obj_code/rel_code, key arrays, reverse
+    lookups) without materializing any dict — the whole point of the
+    cache is an O(mmap) cold start. Lacks the native bulk
+    ``resolve_queries`` entry point on purpose; the engine's host
+    resolution path covers it.
+    """
+
+    def __init__(self, d: Path, meta: dict):
+        self.num_sets = int(meta["num_sets"])
+        self.num_leaves = int(meta["num_leaves"])
+        self._n_obj = int(meta["n_obj"])
+        self._n_rel = int(meta["n_rel"])
+        mm = lambda name: np.load(d / name, mmap_mode="r")  # noqa: E731
+        self.key_ns = mm("key_ns.npy")
+        self.key_obj = mm("key_obj.npy")
+        self.key_rel = mm("key_rel.npy")
+        self.key_wild = np.asarray(mm("key_wild.npy")).astype(bool)
+        self._set_order = mm("set_order.npy")
+        self._set_nsobj = mm("set_nsobj.npy")
+        self._set_rel = mm("set_rel.npy")
+        self._tables = {}
+        for kind in ("obj", "rel", "leaf"):
+            blob = np.memmap(d / f"{kind}_blob.bin", dtype=np.uint8, mode="r") \
+                if (d / f"{kind}_blob.bin").stat().st_size else np.zeros(0, np.uint8)
+            self._tables[kind] = (
+                blob,
+                mm(f"{kind}_off.npy"),
+                mm(f"{kind}_hash.npy"),
+                mm(f"{kind}_hord.npy"),
+            )
+
+    @property
+    def num_nodes(self) -> int:
+        return self.num_sets + self.num_leaves
+
+    def num_obj_codes(self) -> int:
+        return self._n_obj
+
+    def num_rel_codes(self) -> int:
+        return self._n_rel
+
+    # -- string tables -------------------------------------------------------
+
+    def _str_of(self, kind: str, idx: int) -> str:
+        blob, off, _, _ = self._tables[kind]
+        return bytes(blob[int(off[idx]) : int(off[idx + 1])]).decode()
+
+    def _code_of(self, kind: str, s: str) -> int:
+        blob, off, hashes, order = self._tables[kind]
+        b = s.encode()
+        h = np.uint32(zlib.crc32(b))
+        lo = int(np.searchsorted(hashes, h, "left"))
+        hi = int(np.searchsorted(hashes, h, "right"))
+        for k in range(lo, hi):
+            i = int(order[k])
+            if bytes(blob[int(off[i]) : int(off[i + 1])]) == b:
+                return i
+        return -1
+
+    def _obj_str(self, code: int) -> str:
+        return self._str_of("obj", code)
+
+    def _rel_str(self, code: int) -> str:
+        return self._str_of("rel", code)
+
+    # -- resolution ----------------------------------------------------------
+
+    def obj_code(self, s: str) -> int:
+        return self._code_of("obj", s)
+
+    def rel_code(self, s: str) -> int:
+        return self._code_of("rel", s)
+
+    def resolve_set(self, ns_id: int, obj: str, rel: str) -> int:
+        oc = self.obj_code(obj)
+        if oc < 0:
+            return -1
+        rc = self.rel_code(rel)
+        if rc < 0:
+            return -1
+        key = (int(ns_id) << 32) | oc
+        lo = int(np.searchsorted(self._set_nsobj, key, "left"))
+        hi = int(np.searchsorted(self._set_nsobj, key, "right"))
+        seg = self._set_rel[lo:hi]
+        j = int(np.searchsorted(seg, rc, "left"))
+        if j < seg.shape[0] and int(seg[j]) == rc:
+            return int(self._set_order[lo + j])
+        return -1
+
+    def resolve_leaf(self, subject_id: str) -> int:
+        return self._code_of("leaf", subject_id)
+
+    # -- reverse lookups -----------------------------------------------------
+
+    def set_key_of(self, raw_id: int):
+        return (
+            int(self.key_ns[raw_id]),
+            self._str_of("obj", int(self.key_obj[raw_id])),
+            self._str_of("rel", int(self.key_rel[raw_id])),
+        )
+
+    def leaf_str(self, idx: int) -> str:
+        return self._str_of("leaf", idx)
+
+
+def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
+    """Serialize ``snap`` under ``cache_dir``; returns the cache path, or
+    None when the snapshot isn't cacheable (pending overlay, an interner
+    without code-table sizes, or key codes outside the packed-index
+    range). Atomic: written to a temp dir and renamed into place."""
+    if snap.has_overlay:
+        return None
+    interned = snap.interned
+    n_obj = getattr(interned, "num_obj_codes", lambda: None)()
+    n_rel = getattr(interned, "num_rel_codes", lambda: None)()
+    if n_obj is None or n_rel is None:
+        return None
+    key_ns = np.asarray(interned.key_ns, np.int64)
+    key_obj = np.asarray(interned.key_obj, np.int64)
+    key_rel = np.asarray(interned.key_rel, np.int64)
+    if key_ns.size and (
+        int(key_ns.min()) < 0
+        or int(key_ns.max()) >= 1 << 31
+        or int(key_obj.max()) >= 1 << 32
+    ):
+        return None  # outside the (ns<<32|obj) packed-index range
+
+    base = Path(cache_dir)
+    tag = f"v{FORMAT_VERSION}-w{snap.snapshot_id}"
+    final = base / tag
+    if final.exists():
+        return str(final)
+    base.mkdir(parents=True, exist_ok=True)
+    tmp = base / f".tmp-{tag}-{os.getpid()}-{threading.get_ident()}"
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    tmp.mkdir(parents=True)
+    try:
+        sv = lambda name, arr: np.save(tmp / name, np.ascontiguousarray(arr))  # noqa: E731
+        sv("raw2dev", snap.raw2dev)
+        sv("fwd_indptr", snap.fwd_indptr)
+        sv("fwd_indices", snap.fwd_indices)
+        sv("sink_indptr", snap.sink_indptr)
+        sv("sink_indices", snap.sink_indices)
+        for i, b in enumerate(snap.buckets):
+            sv(f"bucket_{i}", b.nbrs)
+        sv("key_ns", key_ns)
+        sv("key_obj", key_obj)
+        sv("key_rel", key_rel)
+        sv("key_wild", np.asarray(interned.key_wild).astype(np.uint8))
+        # lexsorted set-key index: (ns<<32|obj_code) with rel_code minor
+        order = np.lexsort((key_rel, key_obj, key_ns))
+        sv("set_order", order.astype(np.int64))
+        sv("set_nsobj", (key_ns[order] << 32) | key_obj[order])
+        sv("set_rel", key_rel[order])
+        for kind, strings in (
+            ("obj", _obj_strings(interned, n_obj)),
+            ("rel", _rel_strings(interned, n_rel)),
+            ("leaf", [interned.leaf_str(i) for i in range(interned.num_leaves)]),
+        ):
+            blob, off, hashes, order = _string_table(strings)
+            (tmp / f"{kind}_blob.bin").write_bytes(blob)
+            sv(f"{kind}_off", off)
+            sv(f"{kind}_hash", hashes)
+            sv(f"{kind}_hord", order)
+        meta = {
+            "format": FORMAT_VERSION,
+            "watermark": int(snap.snapshot_id),
+            "wild_ns_ids": sorted(int(i) for i in snap.wild_ns_ids),
+            "num_sets": int(interned.num_sets),
+            "num_leaves": int(interned.num_leaves),
+            "num_active": int(snap.num_active),
+            "num_int": int(snap.num_int),
+            "num_live": int(snap.num_live),
+            "n_peeled": int(snap.n_peeled),
+            "buckets": [{"offset": int(b.offset), "n": int(b.n)} for b in snap.buckets],
+            "n_obj": int(n_obj),
+            "n_rel": int(n_rel),
+        }
+        (tmp / "meta.json").write_text(json.dumps(meta))
+        try:
+            os.replace(tmp, final)
+        except OSError:
+            if not final.exists():
+                raise
+            # a concurrent saver landed the same watermark first — theirs
+            # is identical; drop ours
+            shutil.rmtree(tmp, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    _prune(base, keep=KEEP)
+    return str(final)
+
+
+def _prune(base: Path, keep: int) -> None:
+    """Drop all but the ``keep`` newest caches of the CURRENT format (a
+    format bump orphans old dirs — remove those too)."""
+    entries = []
+    for d in base.iterdir():
+        if not d.is_dir() or d.name.startswith(".tmp-"):
+            continue
+        wm = _parse_tag(d.name)
+        if wm is None:
+            shutil.rmtree(d, ignore_errors=True)  # other-format leftovers
+        else:
+            entries.append((wm, d))
+    entries.sort(reverse=True)
+    for _, d in entries[keep:]:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _parse_tag(name: str) -> Optional[int]:
+    prefix = f"v{FORMAT_VERSION}-w"
+    if not name.startswith(prefix):
+        return None
+    try:
+        return int(name[len(prefix):])
+    except ValueError:
+        return None
+
+
+def load_snapshot(path: str) -> GraphSnapshot:
+    """Reload one cached snapshot directory (mmap — arrays page lazily)."""
+    d = Path(path)
+    meta = json.loads((d / "meta.json").read_text())
+    if meta.get("format") != FORMAT_VERSION:
+        raise ValueError(f"cache format {meta.get('format')} != {FORMAT_VERSION}")
+    interned = CachedInterned(d, meta)
+    mm = lambda name: np.load(d / name, mmap_mode="r")  # noqa: E731
+    buckets = [
+        Bucket(offset=int(b["offset"]), n=int(b["n"]), nbrs=mm(f"bucket_{i}.npy"))
+        for i, b in enumerate(meta["buckets"])
+    ]
+    return GraphSnapshot(
+        snapshot_id=int(meta["watermark"]),
+        num_sets=int(meta["num_sets"]),
+        num_leaves=int(meta["num_leaves"]),
+        num_active=int(meta["num_active"]),
+        num_int=int(meta["num_int"]),
+        num_live=int(meta["num_live"]),
+        n_peeled=int(meta["n_peeled"]),
+        buckets=buckets,
+        interned=interned,
+        raw2dev=mm("raw2dev.npy"),
+        wild_ns_ids=frozenset(meta["wild_ns_ids"]),
+        fwd_indptr=mm("fwd_indptr.npy"),
+        fwd_indices=mm("fwd_indices.npy"),
+        sink_indptr=mm("sink_indptr.npy"),
+        sink_indices=mm("sink_indices.npy"),
+    )
+
+
+def load_latest(
+    cache_dir: str, max_watermark: Optional[int] = None
+) -> Optional[GraphSnapshot]:
+    """Newest loadable cache under ``cache_dir`` with watermark ≤
+    ``max_watermark`` (the store's current watermark — a cache AHEAD of
+    the store belongs to other data and must never serve), or None."""
+    base = Path(cache_dir)
+    if not base.is_dir():
+        return None
+    candidates = []
+    for d in base.iterdir():
+        wm = _parse_tag(d.name) if d.is_dir() else None
+        if wm is None:
+            continue
+        if max_watermark is not None and wm > max_watermark:
+            continue
+        candidates.append((wm, d))
+    for _, d in sorted(candidates, reverse=True):
+        try:
+            return load_snapshot(str(d))
+        except Exception:
+            continue  # unreadable/corrupt cache → try the next, else rebuild
+    return None

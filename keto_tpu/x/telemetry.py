@@ -58,6 +58,50 @@ class DurationStats:
         }
 
 
+class MaintenanceStats:
+    """Counters + gauges for incremental snapshot maintenance.
+
+    The TPU check engine records every snapshot-lifecycle event here
+    (keto_tpu/check/tpu_engine.py): delta applies, overlay occupancy
+    against the configured budget, compactions vs full rebuilds and their
+    durations, and snapshot-cache saves/reloads — so operators can see
+    overlay budget pressure BEFORE it forces an expensive rebuild, and
+    bench.py grades the same numbers the engine steers by."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: collections.Counter = collections.Counter()
+        self._gauges: dict[str, float] = {}
+        self._durations: dict[str, dict] = {}
+
+    def incr(self, key: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[key] += by
+
+    def set_gauge(self, key: str, value) -> None:
+        with self._lock:
+            self._gauges[key] = value
+
+    def observe_ms(self, key: str, ms: float) -> None:
+        with self._lock:
+            d = self._durations.setdefault(key, {"count": 0, "total_ms": 0.0, "last_ms": 0.0})
+            d["count"] += 1
+            d["total_ms"] += float(ms)
+            d["last_ms"] = float(ms)
+
+    def snapshot(self) -> dict:
+        """One flat dict: counters, gauges, and per-key duration stats
+        (``<key>_count/_total_ms/_last_ms``)."""
+        with self._lock:
+            out: dict = dict(self._counters)
+            out.update(self._gauges)
+            for key, d in self._durations.items():
+                out[f"{key}_count"] = d["count"]
+                out[f"{key}_total_ms"] = round(d["total_ms"], 3)
+                out[f"{key}_last_ms"] = round(d["last_ms"], 3)
+            return out
+
+
 class Telemetry:
     def __init__(self, enabled: bool = False):
         self.enabled = enabled

@@ -17,6 +17,19 @@
 //    "1" (subject id: f0=id, f1=f2 empty); ns_id is decimal ASCII. Kept for
 //    odd encodings the columnar packer rejects and for resolve_queries.
 //
+// **Parallel ingest.** The columnar entry points chunk the row stream
+// across worker threads (ctypes releases the GIL for the whole call, so
+// the workers own the machine). Each worker interns its chunk into
+// thread-local tables; a serial merge then folds the local tables into
+// the global ones IN CHUNK ORDER. Within a chunk, local ids are assigned
+// in first-occurrence order, so replaying each chunk's locals in
+// local-id order reproduces the exact id assignment a serial pass over
+// the concatenated stream would make — the parallel build is
+// bit-identical to the serial one (tests/test_native_ingest.py asserts
+// equality against the Python interner either way). Thread count:
+// KETO_TPU_INGEST_THREADS, else min(hardware_concurrency, 16); inputs
+// under ~256k rows stay serial (spawn cost dominates).
+//
 // Interning internals: open-addressed flat hash tables (cached hashes,
 // linear probing, deque string arenas with stable addresses for the
 // reverse lookups); a set node key is the integer triple
@@ -29,10 +42,12 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <string>
 #include <string_view>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -117,67 +132,51 @@ struct StrTable {
     }
 };
 
-// Open-addressed (ns, obj_code, rel_code) → set id table probing against
-// the Graph's existing key arrays (no duplicated key storage). Sizing
-// goes through Graph::rebuild_set_slots, which always reinserts the keys
-// living in the arrays — a bare slot reset would orphan them.
-struct TripleTable {
+// Open-addressed (ns, obj_code, rel_code) → set id table. Key fields live
+// in the id-indexed arrays (no duplicated key storage); sizing goes
+// through rebuild(), which always reinserts the keys living in the
+// arrays — a bare slot reset would orphan them. Used for the global
+// graph AND each worker's thread-local shard.
+struct SetTable {
+    std::vector<int64_t> key_ns, key_obj, key_rel;  // per set node
+    std::vector<uint8_t> wild;
     std::vector<int64_t> slots;  // slot → id+1 (0 empty)
     size_t mask = 0;
-};
 
-struct Graph {
-    TripleTable set_slots;
-    StrTable leaf_ids;
-    StrTable obj_codes;
-    StrTable rel_codes;
-    // per set node, aligned with set id
-    std::vector<int64_t> key_ns, key_obj, key_rel;
-    std::vector<uint8_t> wild;
-    // tuples (lhs set id, per-field codes, subject raw kind/idx)
-    std::vector<int64_t> t_lhs, t_ns, t_obj, t_rel, t_sub_idx;
-    std::vector<uint8_t> t_sub_kind;
-    // final edges (raw ids; dst offset by num_sets for leaves)
-    std::vector<int64_t> src, dst;
-    std::vector<int64_t> wild_ns_ids;
+    size_t size() const { return key_ns.size(); }
 
-    size_t num_set_nodes() const { return key_ns.size(); }
-
-    inline uint64_t triple_hash(int64_t ns, int64_t oc, int64_t rc) const {
+    static inline uint64_t triple_hash(int64_t ns, int64_t oc, int64_t rc) {
         return hash_mix(hash_mix((uint64_t)ns, (uint64_t)oc), (uint64_t)rc);
     }
 
-    // size the slot array to ``cap`` and reinsert every existing key
-    void rebuild_set_slots(size_t cap) {
-        set_slots.slots.assign(cap, 0);
-        set_slots.mask = cap - 1;
+    void rebuild(size_t cap) {
+        slots.assign(cap, 0);
+        mask = cap - 1;
         for (size_t id = 0; id < key_ns.size(); ++id) {
-            size_t j = (size_t)triple_hash(key_ns[id], key_obj[id], key_rel[id])
-                       & set_slots.mask;
-            while (set_slots.slots[j]) j = (j + 1) & set_slots.mask;
-            set_slots.slots[j] = (int64_t)id + 1;
+            size_t j = (size_t)triple_hash(key_ns[id], key_obj[id], key_rel[id]) & mask;
+            while (slots[j]) j = (j + 1) & mask;
+            slots[j] = (int64_t)id + 1;
         }
     }
 
-    void reserve_sets(size_t n) {
+    void reserve(size_t n) {
         size_t cap = 16;
         while (cap < n * 2) cap <<= 1;
-        if (cap > set_slots.slots.size()) rebuild_set_slots(cap);
+        if (cap > slots.size()) rebuild(cap);
     }
 
     // find-or-insert; returns id, or with insert=false returns -1 on miss
-    int64_t set_lookup(int64_t ns, int64_t oc, int64_t rc, bool insert,
-                       bool wild_flag) {
-        if (set_slots.slots.empty()) {
+    int64_t lookup(int64_t ns, int64_t oc, int64_t rc, bool insert, bool wild_flag) {
+        if (slots.empty()) {
             if (!insert) return -1;
-            rebuild_set_slots(16);
+            rebuild(16);
         }
-        size_t i = (size_t)triple_hash(ns, oc, rc) & set_slots.mask;
-        while (set_slots.slots[i]) {
-            size_t id = (size_t)set_slots.slots[i] - 1;
+        size_t i = (size_t)triple_hash(ns, oc, rc) & mask;
+        while (slots[i]) {
+            size_t id = (size_t)slots[i] - 1;
             if (key_ns[id] == ns && key_obj[id] == oc && key_rel[id] == rc)
                 return (int64_t)id;
-            i = (i + 1) & set_slots.mask;
+            i = (i + 1) & mask;
         }
         if (!insert) return -1;
         int64_t id = (int64_t)key_ns.size();
@@ -185,16 +184,30 @@ struct Graph {
         key_obj.push_back(oc);
         key_rel.push_back(rc);
         wild.push_back(wild_flag);
-        set_slots.slots[i] = id + 1;
-        if (key_ns.size() * 10 >= set_slots.slots.size() * 7)
-            rebuild_set_slots(set_slots.slots.size() * 2);
+        slots[i] = id + 1;
+        if (key_ns.size() * 10 >= slots.size() * 7) rebuild(slots.size() * 2);
         return id;
     }
 };
 
+struct Graph {
+    SetTable sets;
+    StrTable leaf_ids;
+    StrTable obj_codes;
+    StrTable rel_codes;
+    // tuples (lhs set id, per-field codes, subject raw kind/idx)
+    std::vector<int64_t> t_lhs, t_ns, t_obj, t_rel, t_sub_idx;
+    std::vector<uint8_t> t_sub_kind;
+    // final edges (raw ids; dst offset by num_sets for leaves)
+    std::vector<int64_t> src, dst;
+    std::vector<int64_t> wild_ns_ids;
+
+    size_t num_set_nodes() const { return sets.size(); }
+};
+
 int64_t set_node_coded(Graph& g, int64_t ns, int64_t oc, int64_t rc, bool any_empty,
                        bool ns_wild) {
-    return g.set_lookup(ns, oc, rc, /*insert=*/true, ns_wild || any_empty);
+    return g.sets.lookup(ns, oc, rc, /*insert=*/true, ns_wild || any_empty);
 }
 
 int64_t set_node(Graph& g, int64_t ns, std::string_view obj, std::string_view rel,
@@ -210,11 +223,13 @@ int64_t leaf_node(Graph& g, std::string_view s) {
     return g.leaf_ids.intern(s);
 }
 
-bool is_wild_ns(const Graph& g, int64_t ns) {
-    for (int64_t w : g.wild_ns_ids)
+bool in_wild_ns(const std::vector<int64_t>& wild_ns_ids, int64_t ns) {
+    for (int64_t w : wild_ns_ids)
         if (w == ns) return true;
     return false;
 }
+
+bool is_wild_ns(const Graph& g, int64_t ns) { return in_wild_ns(g.wild_ns_ids, ns); }
 
 inline void add_row(Graph& g, int64_t ns, std::string_view obj, std::string_view rel,
                     bool sub_is_leaf, std::string_view sid, int64_t sns,
@@ -251,7 +266,7 @@ void finish_edges(Graph* g) {
     g->src.reserve(nt);
     g->dst.reserve(nt);
     for (size_t i = 0; i < nt; ++i) {
-        if (!g->wild[(size_t)g->t_lhs[i]]) {
+        if (!g->sets.wild[(size_t)g->t_lhs[i]]) {
             g->src.push_back(g->t_lhs[i]);
             g->dst.push_back(sub_raw(i));
         }
@@ -259,14 +274,14 @@ void finish_edges(Graph* g) {
     const int64_t empty_obj = g->obj_codes.find(std::string_view(""));
     const int64_t empty_rel = g->rel_codes.find(std::string_view(""));
     for (int64_t s = 0; s < num_sets; ++s) {
-        if (!g->wild[(size_t)s]) continue;
-        const bool ns_w = is_wild_ns(*g, g->key_ns[(size_t)s]);
-        const bool obj_w = g->key_obj[(size_t)s] == empty_obj;
-        const bool rel_w = g->key_rel[(size_t)s] == empty_rel;
+        if (!g->sets.wild[(size_t)s]) continue;
+        const bool ns_w = is_wild_ns(*g, g->sets.key_ns[(size_t)s]);
+        const bool obj_w = g->sets.key_obj[(size_t)s] == empty_obj;
+        const bool rel_w = g->sets.key_rel[(size_t)s] == empty_rel;
         for (size_t i = 0; i < nt; ++i) {
-            if (!ns_w && g->t_ns[i] != g->key_ns[(size_t)s]) continue;
-            if (!obj_w && g->t_obj[i] != g->key_obj[(size_t)s]) continue;
-            if (!rel_w && g->t_rel[i] != g->key_rel[(size_t)s]) continue;
+            if (!ns_w && g->t_ns[i] != g->sets.key_ns[(size_t)s]) continue;
+            if (!obj_w && g->t_obj[i] != g->sets.key_obj[(size_t)s]) continue;
+            if (!rel_w && g->t_rel[i] != g->sets.key_rel[(size_t)s]) continue;
             g->src.push_back(s);
             g->dst.push_back(sub_raw(i));
         }
@@ -318,14 +333,14 @@ void reserve_rows(Graph* g, size_t n) {
     g->t_sub_kind.reserve(n);
     // pre-size the intern tables: growth rehashes at 10M inserts cost more
     // than the (transient) bucket-array over-allocation
-    g->reserve_sets(n / 2 + 16);
+    g->sets.reserve(n / 2 + 16);
     g->leaf_ids.reserve(n / 2 + 16);
     g->obj_codes.reserve(n / 2 + 16);
     g->rel_codes.reserve(1024);
-    g->key_ns.reserve(n / 2 + 16);
-    g->key_obj.reserve(n / 2 + 16);
-    g->key_rel.reserve(n / 2 + 16);
-    g->wild.reserve(n / 2 + 16);
+    g->sets.key_ns.reserve(n / 2 + 16);
+    g->sets.key_obj.reserve(n / 2 + 16);
+    g->sets.key_rel.reserve(n / 2 + 16);
+    g->sets.wild.reserve(n / 2 + 16);
 }
 
 // Decode one fixed-width UCS4 (numpy '<U*') cell into utf-8 in ``out``;
@@ -356,6 +371,159 @@ inline std::string_view sv_from_ucs4(const uint32_t* p, int64_t width,
     return std::string_view(out);
 }
 
+// ---------------------------------------------------------------------------
+// Parallel ingest.
+//
+// A worker interns its chunk into a thread-local Shard; the serial merge
+// replays each shard's local ids IN LOCAL-ID ORDER, chunk by chunk, into
+// the global tables. Local-id order IS first-occurrence order within the
+// chunk, so the global assignment equals a serial pass over the whole
+// stream — deterministic and bit-identical to the single-threaded path.
+
+struct Shard {
+    SetTable sets;
+    StrTable leaf_ids, obj_codes, rel_codes;
+    // per-tuple arrays with LOCAL codes/ids (remapped at merge)
+    std::vector<int64_t> t_lhs, t_ns, t_obj, t_rel, t_sub_idx;
+    std::vector<uint8_t> t_sub_kind;
+};
+
+inline void shard_add_row(Shard& s, const std::vector<int64_t>& wild_ns,
+                          int64_t ns, std::string_view obj, std::string_view rel,
+                          bool sub_is_leaf, std::string_view sid, int64_t sns,
+                          std::string_view sso, std::string_view ssr) {
+    int64_t oc = s.obj_codes.intern(obj);
+    int64_t rc = s.rel_codes.intern(rel);
+    int64_t lhs = s.sets.lookup(ns, oc, rc, true,
+                                in_wild_ns(wild_ns, ns) || obj.empty() || rel.empty());
+    s.t_lhs.push_back(lhs);
+    s.t_ns.push_back(ns);
+    s.t_obj.push_back(oc);
+    s.t_rel.push_back(rc);
+    if (sub_is_leaf) {
+        s.t_sub_kind.push_back(1);
+        s.t_sub_idx.push_back(s.leaf_ids.intern(sid));
+    } else {
+        s.t_sub_kind.push_back(0);
+        int64_t soc = s.obj_codes.intern(sso);
+        int64_t src = s.rel_codes.intern(ssr);
+        s.t_sub_idx.push_back(s.sets.lookup(
+            sns, soc, src, true,
+            in_wild_ns(wild_ns, sns) || sso.empty() || ssr.empty()));
+    }
+}
+
+unsigned ingest_threads(int64_t n) {
+    const char* e = std::getenv("KETO_TPU_INGEST_THREADS");
+    if (e && *e) {
+        long v = std::atol(e);
+        if (v >= 1) return (unsigned)v;
+    }
+    if (n < 262144) return 1;  // spawn + merge overhead dominates tiny builds
+    unsigned hc = std::thread::hardware_concurrency();
+    return std::max(1u, std::min(hc ? hc : 1u, 16u));
+}
+
+void merge_shards(Graph* g, std::vector<Shard*>& shards, int64_t n);
+
+// RowFn: void(Shard&, int64_t row_index) — interns one source row into the
+// shard. Builds the graph's per-tuple arrays from n rows, parallel when
+// worthwhile, then emits edges.
+template <typename RowFn>
+void build_tuples(Graph* g, int64_t n, RowFn&& intern_row) {
+    unsigned nt = ingest_threads(n);
+    if (nt <= 1 || n < (int64_t)nt) {
+        reserve_rows(g, (size_t)n);
+        Shard whole;  // serial path reuses the shard logic (one chunk)
+        whole.sets.reserve((size_t)n / 2 + 16);
+        whole.leaf_ids.reserve((size_t)n / 2 + 16);
+        whole.obj_codes.reserve((size_t)n / 2 + 16);
+        whole.rel_codes.reserve(1024);
+        for (int64_t i = 0; i < n; ++i) intern_row(whole, i);
+        std::vector<Shard*> shards{&whole};
+        merge_shards(g, shards, n);
+        finish_edges(g);
+        return;
+    }
+    std::vector<Shard> shards(nt);
+    std::vector<std::thread> workers;
+    workers.reserve(nt);
+    const int64_t chunk = (n + nt - 1) / nt;
+    for (unsigned t = 0; t < nt; ++t) {
+        workers.emplace_back([&, t]() {
+            Shard& s = shards[t];
+            const int64_t i0 = (int64_t)t * chunk;
+            const int64_t i1 = std::min(n, i0 + chunk);
+            if (i0 >= i1) return;
+            const size_t cn = (size_t)(i1 - i0);
+            s.sets.reserve(cn / 2 + 16);
+            s.leaf_ids.reserve(cn / 2 + 16);
+            s.obj_codes.reserve(cn / 2 + 16);
+            s.rel_codes.reserve(256);
+            s.t_lhs.reserve(cn);
+            s.t_sub_idx.reserve(cn);
+            for (int64_t i = i0; i < i1; ++i) intern_row(s, i);
+        });
+    }
+    for (auto& w : workers) w.join();
+    std::vector<Shard*> ptrs;
+    ptrs.reserve(nt);
+    for (auto& s : shards) ptrs.push_back(&s);
+    reserve_rows(g, (size_t)n);
+    merge_shards(g, ptrs, n);
+    finish_edges(g);
+}
+
+// Serial merge: chunk order × local-id order = serial first-occurrence
+// order (see the module comment). The per-tuple remap afterwards is the
+// only O(rows) serial work and is a handful of array lookups per row.
+void merge_shards(Graph* g, std::vector<Shard*>& shards, int64_t n) {
+    g->t_lhs.resize((size_t)n);
+    g->t_ns.resize((size_t)n);
+    g->t_obj.resize((size_t)n);
+    g->t_rel.resize((size_t)n);
+    g->t_sub_idx.resize((size_t)n);
+    g->t_sub_kind.resize((size_t)n);
+    size_t off = 0;
+    std::vector<int64_t> obj_map, rel_map, leaf_map, set_map;
+    for (Shard* s : shards) {
+        obj_map.resize(s->obj_codes.size());
+        for (size_t c = 0; c < s->obj_codes.size(); ++c)
+            obj_map[c] = g->obj_codes.intern(s->obj_codes.arena[c]);
+        rel_map.resize(s->rel_codes.size());
+        for (size_t c = 0; c < s->rel_codes.size(); ++c)
+            rel_map[c] = g->rel_codes.intern(s->rel_codes.arena[c]);
+        leaf_map.resize(s->leaf_ids.size());
+        for (size_t c = 0; c < s->leaf_ids.size(); ++c)
+            leaf_map[c] = g->leaf_ids.intern(s->leaf_ids.arena[c]);
+        set_map.resize(s->sets.size());
+        for (size_t id = 0; id < s->sets.size(); ++id)
+            set_map[id] = g->sets.lookup(
+                s->sets.key_ns[id], obj_map[(size_t)s->sets.key_obj[id]],
+                rel_map[(size_t)s->sets.key_rel[id]], true, s->sets.wild[id]);
+        const size_t cn = s->t_lhs.size();
+        for (size_t i = 0; i < cn; ++i) {
+            g->t_lhs[off + i] = set_map[(size_t)s->t_lhs[i]];
+            g->t_ns[off + i] = s->t_ns[i];
+            g->t_obj[off + i] = obj_map[(size_t)s->t_obj[i]];
+            g->t_rel[off + i] = rel_map[(size_t)s->t_rel[i]];
+            g->t_sub_kind[off + i] = s->t_sub_kind[i];
+            g->t_sub_idx[off + i] = s->t_sub_kind[i]
+                                        ? leaf_map[(size_t)s->t_sub_idx[i]]
+                                        : set_map[(size_t)s->t_sub_idx[i]];
+        }
+        off += cn;
+        // free the shard's per-tuple arrays eagerly (peak-memory control;
+        // the intern tables die with the Shard vector)
+        std::vector<int64_t>().swap(s->t_lhs);
+        std::vector<int64_t>().swap(s->t_ns);
+        std::vector<int64_t>().swap(s->t_obj);
+        std::vector<int64_t>().swap(s->t_rel);
+        std::vector<int64_t>().swap(s->t_sub_idx);
+        std::vector<uint8_t>().swap(s->t_sub_kind);
+    }
+}
+
 }  // namespace
 
 extern "C" {
@@ -374,22 +542,23 @@ Graph* graph_build_ucs4(
     const int64_t* wild_ns_ids, int64_t n_wild_ns) {
     Graph* g = new Graph();
     g->wild_ns_ids.assign(wild_ns_ids, wild_ns_ids + n_wild_ns);
-    reserve_rows(g, (size_t)n);
-    std::string b_obj, b_rel, b_sid, b_sso, b_ssr;
-    for (int64_t i = 0; i < n; ++i) {
+    const std::vector<int64_t>& wild = g->wild_ns_ids;
+    // per-thread decode buffers live in the lambda's captured-by-value
+    // copies — thread_local keeps one set per worker
+    build_tuples(g, n, [&](Shard& s, int64_t i) {
+        thread_local std::string b_obj, b_rel, b_sid, b_sso, b_ssr;
         std::string_view v_obj = sv_from_ucs4(obj + i * obj_w, obj_w, b_obj);
         std::string_view v_rel = sv_from_ucs4(rel + i * rel_w, rel_w, b_rel);
         if (kind[i]) {
-            add_row(*g, ns[i], v_obj, v_rel, true,
-                    sv_from_ucs4(sid + i * sid_w, sid_w, b_sid), 0,
-                    std::string_view(), std::string_view());
+            shard_add_row(s, wild, ns[i], v_obj, v_rel, true,
+                          sv_from_ucs4(sid + i * sid_w, sid_w, b_sid), 0,
+                          std::string_view(), std::string_view());
         } else {
-            add_row(*g, ns[i], v_obj, v_rel, false, std::string_view(), sns[i],
-                    sv_from_ucs4(sso + i * sso_w, sso_w, b_sso),
-                    sv_from_ucs4(ssr + i * ssr_w, ssr_w, b_ssr));
+            shard_add_row(s, wild, ns[i], v_obj, v_rel, false, std::string_view(),
+                          sns[i], sv_from_ucs4(sso + i * sso_w, sso_w, b_sso),
+                          sv_from_ucs4(ssr + i * ssr_w, ssr_w, b_ssr));
         }
-    }
-    finish_edges(g);
+    });
     return g;
 }
 
@@ -406,23 +575,24 @@ Graph* graph_build_columnar(
     const int64_t* wild_ns_ids, int64_t n_wild_ns) {
     Graph* g = new Graph();
     g->wild_ns_ids.assign(wild_ns_ids, wild_ns_ids + n_wild_ns);
-    reserve_rows(g, (size_t)n);
-    for (int64_t i = 0; i < n; ++i) {
-        add_row(*g, ns[i],
-                std::string_view(obj_blob + obj_starts[i], (size_t)obj_lens[i]),
-                std::string_view(rel_blob + rel_starts[i], (size_t)rel_lens[i]),
-                kind[i] != 0,
-                std::string_view(sid_blob + sid_starts[i], (size_t)sid_lens[i]),
-                sns[i],
-                std::string_view(sso_blob + sso_starts[i], (size_t)sso_lens[i]),
-                std::string_view(ssr_blob + ssr_starts[i], (size_t)ssr_lens[i]));
-    }
-    finish_edges(g);
+    const std::vector<int64_t>& wild = g->wild_ns_ids;
+    build_tuples(g, n, [&](Shard& s, int64_t i) {
+        shard_add_row(
+            s, wild, ns[i],
+            std::string_view(obj_blob + obj_starts[i], (size_t)obj_lens[i]),
+            std::string_view(rel_blob + rel_starts[i], (size_t)rel_lens[i]),
+            kind[i] != 0,
+            std::string_view(sid_blob + sid_starts[i], (size_t)sid_lens[i]),
+            sns[i],
+            std::string_view(sso_blob + sso_starts[i], (size_t)sso_lens[i]),
+            std::string_view(ssr_blob + ssr_starts[i], (size_t)ssr_lens[i]));
+    });
     return g;
 }
 
 // Parse the packed row buffer; returns a Graph handle or nullptr on a
-// malformed buffer.
+// malformed buffer. Stays serial: this path survives for odd encodings
+// the columnar packer rejects — never the bulk-rebuild hot path.
 Graph* graph_build(const char* buf, int64_t len, const int64_t* wild_ns_ids,
                    int64_t n_wild_ns) {
     Graph* g = new Graph();
@@ -485,6 +655,11 @@ int64_t graph_num_sets(const Graph* g) { return (int64_t)g->num_set_nodes(); }
 int64_t graph_num_leaves(const Graph* g) { return (int64_t)g->leaf_ids.size(); }
 int64_t graph_num_edges(const Graph* g) { return (int64_t)g->src.size(); }
 
+// Code-table sizes: the compaction layer's ExtendedInterned assigns fresh
+// field codes for new set keys ABOVE these (keto_tpu/graph/interner.py).
+int64_t graph_num_obj_codes(const Graph* g) { return (int64_t)g->obj_codes.size(); }
+int64_t graph_num_rel_codes(const Graph* g) { return (int64_t)g->rel_codes.size(); }
+
 // Copy-out accessors; caller allocates.
 void graph_edges(const Graph* g, int64_t* src, int64_t* dst) {
     std::memcpy(src, g->src.data(), g->src.size() * sizeof(int64_t));
@@ -493,10 +668,10 @@ void graph_edges(const Graph* g, int64_t* src, int64_t* dst) {
 
 void graph_keys(const Graph* g, int64_t* key_ns, int64_t* key_obj, int64_t* key_rel,
                 uint8_t* wild) {
-    std::memcpy(key_ns, g->key_ns.data(), g->key_ns.size() * sizeof(int64_t));
-    std::memcpy(key_obj, g->key_obj.data(), g->key_obj.size() * sizeof(int64_t));
-    std::memcpy(key_rel, g->key_rel.data(), g->key_rel.size() * sizeof(int64_t));
-    std::memcpy(wild, g->wild.data(), g->wild.size());
+    std::memcpy(key_ns, g->sets.key_ns.data(), g->sets.key_ns.size() * sizeof(int64_t));
+    std::memcpy(key_obj, g->sets.key_obj.data(), g->sets.key_obj.size() * sizeof(int64_t));
+    std::memcpy(key_rel, g->sets.key_rel.data(), g->sets.key_rel.size() * sizeof(int64_t));
+    std::memcpy(wild, g->sets.wild.data(), g->sets.wild.size());
 }
 
 // Resolution: -1 = not present.
@@ -506,7 +681,7 @@ int64_t graph_resolve_set(const Graph* g, int64_t ns, const char* obj, int64_t o
     if (oc < 0) return -1;
     int64_t rc = g->rel_codes.find(std::string_view(rel, (size_t)rel_len));
     if (rc < 0) return -1;
-    return const_cast<Graph*>(g)->set_lookup(ns, oc, rc, /*insert=*/false, false);
+    return const_cast<Graph*>(g)->sets.lookup(ns, oc, rc, /*insert=*/false, false);
 }
 
 int64_t graph_resolve_leaf(const Graph* g, const char* s, int64_t len) {
@@ -533,7 +708,7 @@ int64_t graph_resolve_queries(const Graph* g, const char* buf, int64_t len,
         if (oc < 0) return (int64_t)-1;
         int64_t rc = g->rel_codes.find(rel);
         if (rc < 0) return (int64_t)-1;
-        return const_cast<Graph*>(g)->set_lookup(ns, oc, rc, false, false);
+        return const_cast<Graph*>(g)->sets.lookup(ns, oc, rc, false, false);
     };
     while (p < end && i < n) {
         int f = 0;

@@ -1,0 +1,450 @@
+"""Overlay compaction + persistent snapshot cache parity.
+
+Compaction (keto_tpu/graph/compaction.py) folds a delta overlay into the
+base layout without re-interning or re-peeling; the snapshot cache
+(keto_tpu/graph/snapcache.py) round-trips a built snapshot through disk.
+Neither is allowed to change a single decision: the fuzz suites assert
+bit-identical check results and expand-tree equality between
+(base + overlay), (compacted), and (full rebuild) — including tombstoned
+deletes, wildcard-bearing graphs, and sink-class rows.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.check import CheckEngine
+from keto_tpu.check.tpu_engine import TpuCheckEngine
+from keto_tpu.graph.compaction import compact_snapshot
+from keto_tpu.persistence.memory import MemoryPersister
+from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+
+
+def T(ns, obj, rel, sub):
+    return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+
+NSS = [namespace_pkg.Namespace(id=1, name="g"), namespace_pkg.Namespace(id=2, name="d")]
+
+
+def make_store():
+    return MemoryPersister(namespace_pkg.MemoryManager(NSS))
+
+
+def quiet_engine(p, **kw):
+    """An engine that never compacts on its own (huge budget + timer) so
+    tests control exactly when folding happens."""
+    kw.setdefault("compact_after_s", 3600.0)
+    kw.setdefault("overlay_edge_budget", 1 << 20)
+    return TpuCheckEngine(p, p.namespaces, **kw)
+
+
+def decisions(engine, snap, queries):
+    """Decisions of ``queries`` against exactly ``snap`` (installed, so
+    the engine's watermark check is a no-op)."""
+    engine._snapshot = snap
+    return engine.batch_check(queries)
+
+
+def universe_queries(objects, relations, users):
+    """The exhaustive small-universe query set: every LHS key against
+    every subject — bit-identical parity means agreeing on ALL of them."""
+    qs = []
+    for ns in ("g", "d"):
+        for obj in objects:
+            for rel in relations:
+                for u in users:
+                    qs.append(T(ns, obj, rel, SubjectID(u)))
+                for sobj in objects:
+                    qs.append(T(ns, obj, rel, SubjectSet("g", sobj, relations[0])))
+    return qs
+
+
+def expand_trees(engine, nm, keys, depth=6):
+    from keto_tpu.expand.tpu_engine import SnapshotExpandEngine
+
+    exp = SnapshotExpandEngine(engine, nm)
+    return [exp.build_tree(SubjectSet(ns, obj, rel), depth) for ns, obj, rel in keys]
+
+
+def rand_tuple(rng, objects, relations, users):
+    sub = (
+        SubjectID(rng.choice(users))
+        if rng.random() < 0.55
+        else SubjectSet("g", rng.choice(objects), rng.choice(relations))
+    )
+    return T(rng.choice(["g", "d"]), rng.choice(objects), rng.choice(relations), sub)
+
+
+def parity_round(p, engine, queries, exp_keys, nm):
+    """Assert (overlay) == (compacted) == (full rebuild) on decisions,
+    and (compacted) == (full rebuild) on expand trees. Returns True when
+    the round actually exercised compaction."""
+    ov_snap = engine.snapshot()
+    if not ov_snap.has_overlay:
+        return False
+    got_overlay = decisions(engine, ov_snap, queries)
+
+    compacted = engine._compact_locked(ov_snap)
+    if compacted is None:
+        return False  # legitimate full-rebuild fallback shape
+    assert not compacted.has_overlay
+    assert compacted.snapshot_id == ov_snap.snapshot_id
+    got_compacted = decisions(engine, compacted, queries)
+
+    fresh = quiet_engine(p)
+    full_snap = fresh.snapshot()
+    assert not full_snap.has_overlay
+    got_full = fresh.batch_check(queries)
+
+    assert got_compacted == got_overlay, "compaction changed a decision vs overlay"
+    assert got_compacted == got_full, "compaction diverged from a full rebuild"
+
+    # expand parity: compacted CSR must reproduce Manager child order
+    engine._snapshot = compacted
+    t_comp = expand_trees(engine, nm, exp_keys)
+    t_full = expand_trees(fresh, nm, exp_keys)
+    for k, a, b in zip(exp_keys, t_comp, t_full):
+        assert a == b, f"expand tree diverged for {k}:\n{a}\nvs\n{b}"
+    return True
+
+
+def test_compaction_basic_insert_burst():
+    """New leaves on existing sets, brand-new set nodes, multi-hop ELL
+    edges, and sink in-edges all fold in with zero decision drift."""
+    p = make_store()
+    p.write_relation_tuples(
+        T("d", "doc", "view", SubjectSet("g", "team", "member")),
+        T("g", "team", "member", SubjectSet("g", "core", "member")),
+        T("g", "core", "member", SubjectSet("g", "ring", "member")),
+        T("g", "ring", "member", SubjectSet("g", "team", "member")),  # 3-cycle: all active
+        T("g", "core", "member", SubjectID("alice")),
+    )
+    engine = quiet_engine(p)
+    engine.snapshot()
+    p.write_relation_tuples(
+        T("g", "core", "member", SubjectID("bob")),           # sink in-edge
+        T("g", "team", "member", SubjectID("carol")),         # new leaf node
+        T("g", "team", "member", SubjectSet("g", "ring", "member")),  # ELL edge
+        T("g", "team", "member", SubjectSet("g", "new", "member")),   # new sink-class set
+        T("d", "doc2", "view", SubjectSet("g", "core", "member")),    # new static LHS
+    )
+    nm = namespace_pkg.MemoryManager(NSS)
+    objects = ["doc", "doc2", "team", "core", "ring", "new"]
+    relations = ["view", "member"]
+    users = ["alice", "bob", "carol", "ghost"]
+    queries = universe_queries(objects, relations, users)
+    exp_keys = [("d", "doc", "view"), ("d", "doc2", "view"), ("g", "team", "member")]
+    assert parity_round(p, engine, queries, exp_keys, nm)
+
+
+def test_compaction_tombstones_and_restore():
+    """Deletes fold physically out of the CSRs and buckets; a tombstoned
+    edge re-inserted before compaction survives it."""
+    p = make_store()
+    p.write_relation_tuples(
+        T("d", "doc", "view", SubjectSet("g", "a", "m")),
+        T("g", "a", "m", SubjectSet("g", "b", "m")),
+        T("g", "b", "m", SubjectSet("g", "a", "m")),  # cycle keeps a,b active
+        T("g", "a", "m", SubjectID("u1")),
+        T("g", "b", "m", SubjectID("u2")),
+    )
+    engine = quiet_engine(p)
+    engine.snapshot()
+    p.delete_relation_tuples(T("g", "a", "m", SubjectID("u1")))
+    p.delete_relation_tuples(T("g", "a", "m", SubjectSet("g", "b", "m")))
+    p.write_relation_tuples(T("g", "a", "m", SubjectSet("g", "b", "m")))  # restore
+    nm = namespace_pkg.MemoryManager(NSS)
+    objects = ["doc", "a", "b"]
+    relations = ["view", "m"]
+    users = ["u1", "u2"]
+    queries = universe_queries(objects, relations, users)
+    exp_keys = [("d", "doc", "view"), ("g", "a", "m")]
+    assert parity_round(p, engine, queries, exp_keys, nm)
+    # and the tombstone is gone physically: no ov_removed on the fold
+    snap = engine._compact_locked(engine.snapshot())
+    assert snap is None or snap.ov_removed is None
+
+
+def test_inline_compaction_applies_pending_restore_patch():
+    """Tombstone an iterated edge (device slot sentinel-patched), then
+    re-insert it in the same delta that overflows the budget: the inline
+    compaction must flush the pending restore patch before reusing the
+    untouched device bucket, or the edge stays dead on device."""
+    p = make_store()
+    p.write_relation_tuples(
+        T("d", "doc", "view", SubjectSet("g", "a", "m")),
+        T("g", "a", "m", SubjectSet("g", "b", "m")),
+        T("g", "b", "m", SubjectSet("g", "a", "m")),
+        T("g", "b", "m", SubjectID("u2")),
+    )
+    engine = TpuCheckEngine(
+        p, p.namespaces, compact_after_s=3600.0, overlay_edge_budget=2
+    )
+    engine.snapshot()
+    p.delete_relation_tuples(T("g", "a", "m", SubjectSet("g", "b", "m")))
+    s1 = engine.snapshot()
+    assert s1.has_overlay and s1.ov_removed is not None
+    assert not engine.subject_is_allowed(T("d", "doc", "view", SubjectID("u2")))
+    p.write_relation_tuples(
+        T("g", "a", "m", SubjectSet("g", "b", "m")),  # restore the edge
+        T("g", "b", "m", SubjectID("x1")),
+        T("g", "b", "m", SubjectID("x2")),
+        T("g", "b", "m", SubjectID("x3")),  # burst past the budget
+    )
+    s2 = engine.snapshot()
+    assert not s2.has_overlay, "budget overflow should have compacted inline"
+    oracle = CheckEngine(p)
+    for u in ("u2", "x1", "x2", "x3", "ghost"):
+        q = T("d", "doc", "view", SubjectID(u))
+        assert engine.subject_is_allowed(q) == oracle.subject_is_allowed(q), u
+
+
+def test_compaction_wildcard_attach_falls_back():
+    """An overlay edge whose source is a wildcard-bearing set node cannot
+    be folded (child order is global row order) — compaction must refuse,
+    not guess."""
+    p = make_store()
+    p.write_relation_tuples(
+        T("g", "grp", "", SubjectID("seed")),  # wildcard-relation key
+        T("g", "grp", "m", SubjectID("u1")),
+    )
+    engine = quiet_engine(p)
+    base = engine.snapshot()
+    assert base.has_wildcards
+    # this insert matches the wildcard pattern → attach edge from the
+    # wildcard node rides in the overlay
+    p.write_relation_tuples(T("g", "grp", "m", SubjectID("u2")))
+    snap = engine.snapshot()
+    if not snap.has_overlay:
+        pytest.skip("delta path rebuilt; nothing to compact")
+    assert engine._compact_locked(snap) is None
+
+
+def test_compaction_wildcard_untouched_folds():
+    """Wildcard nodes elsewhere in the graph don't block folding deltas
+    that never touch them."""
+    p = make_store()
+    p.write_relation_tuples(
+        T("g", "grp", "", SubjectID("seed")),  # wildcard key in namespace g
+        T("d", "doc", "view", SubjectSet("d", "team", "member")),
+        T("d", "team", "member", SubjectID("u1")),
+    )
+    engine = quiet_engine(p)
+    engine.snapshot()
+    p.write_relation_tuples(T("d", "team", "member", SubjectID("u2")))
+    nm = namespace_pkg.MemoryManager(NSS)
+    queries = universe_queries(["doc", "team", "grp"], ["view", "member", "m"], ["u1", "u2", "seed"])
+    exp_keys = [("d", "doc", "view")]
+    assert parity_round(p, engine, queries, exp_keys, nm)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_compaction_fuzz_parity(seed):
+    """Randomized delta rounds: whenever apply_delta produces an overlay
+    and compaction accepts it, decisions AND expand trees must be
+    bit-identical across overlay / compacted / full rebuild. Repeated
+    rounds compact on top of already-compacted (ExtendedInterned)
+    snapshots."""
+    rng = random.Random(1000 + seed)
+    objects = [f"o{i}" for i in range(6)]
+    relations = ["m", "v"]
+    users = [f"u{i}" for i in range(6)] + ["ghost"]
+    p = make_store()
+    p.write_relation_tuples(
+        *[rand_tuple(rng, objects, relations, users) for _ in range(30)]
+    )
+    engine = quiet_engine(p)
+    oracle = CheckEngine(p)
+    nm = namespace_pkg.MemoryManager(NSS)
+    queries = universe_queries(objects, relations, users)
+    exp_keys = [("g", objects[0], "m"), ("d", objects[1], "v"), ("g", objects[2], "m")]
+    exercised = 0
+    for round_ in range(6):
+        engine.snapshot()  # settle (may rebuild on class transitions)
+        n_ins = rng.randrange(1, 5)
+        n_del = rng.randrange(0, 3)
+        from keto_tpu.relationtuple.model import RelationQuery
+
+        existing, _ = p.get_relation_tuples(RelationQuery())
+        p.write_relation_tuples(
+            *[rand_tuple(rng, objects, relations, users) for _ in range(n_ins)]
+        )
+        if existing and n_del:
+            p.delete_relation_tuples(*rng.sample(existing, min(n_del, len(existing))))
+        if parity_round(p, engine, queries, exp_keys, nm):
+            exercised += 1
+            # keep serving from the compacted snapshot so later rounds
+            # stack deltas on an ExtendedInterned base
+            compacted = engine._compact_locked(engine.snapshot())
+            if compacted is not None:
+                engine._snapshot = compacted
+        # sanity vs the reference oracle on a sample either way
+        sample = rng.sample(queries, 40)
+        got = engine.batch_check(sample)
+        for q, g in zip(sample, got):
+            assert g == oracle.subject_is_allowed(q), f"seed={seed} round={round_}: {q}"
+    assert exercised >= 1, "fuzz never exercised compaction — universe too hostile"
+
+
+def test_engine_write_burst_compacts_without_rebuild():
+    """A write burst past the overlay budget is absorbed by compaction:
+    no full rebuild, no overlay left, decisions match the oracle."""
+    import keto_tpu.check.tpu_engine as mod
+
+    p = make_store()
+    p.write_relation_tuples(
+        T("d", "doc", "view", SubjectSet("g", "team", "member")),
+        T("g", "team", "member", SubjectSet("g", "core", "member")),
+        T("g", "core", "member", SubjectSet("g", "team", "member")),
+        T("g", "core", "member", SubjectID("alice")),
+    )
+    engine = TpuCheckEngine(
+        p, p.namespaces, compact_after_s=3600.0, overlay_edge_budget=8
+    )
+    engine.snapshot()
+
+    def boom(*a, **k):
+        raise AssertionError("full rebuild during a compactable burst")
+
+    orig = mod.build_snapshot
+    mod.build_snapshot = boom
+    try:
+        burst = [T("g", "core", "member", SubjectID(f"b{i}")) for i in range(40)]
+        p.write_relation_tuples(*burst)
+        snap = engine.snapshot()
+        assert not snap.has_overlay, "budget overflow should have compacted"
+        assert snap.snapshot_id == p.watermark()
+        assert engine.maintenance.snapshot().get("compactions", 0) >= 1
+        oracle = CheckEngine(p)
+        qs = [T("d", "doc", "view", SubjectID(f"b{i}")) for i in range(40)]
+        qs += [T("d", "doc", "view", SubjectID("alice")), T("d", "doc", "view", SubjectID("nope"))]
+        got = engine.batch_check(qs)
+        for q, g in zip(qs, got):
+            assert g == oracle.subject_is_allowed(q)
+    finally:
+        mod.build_snapshot = orig
+
+
+def test_snapshot_cache_round_trip(tmp_path):
+    """save → reload → decision parity, then delta catch-up from the
+    cached watermark, then compaction on top of the cached interner."""
+    cache = str(tmp_path / "snapcache")
+    p = make_store()
+    p.write_relation_tuples(
+        T("d", "doc", "view", SubjectSet("g", "team", "member")),
+        T("g", "team", "member", SubjectSet("g", "core", "member")),
+        T("g", "core", "member", SubjectSet("g", "team", "member")),
+        T("g", "core", "member", SubjectID("alice")),
+        T("g", "team", "member", SubjectID("bob")),
+    )
+    a = TpuCheckEngine(p, p.namespaces, snapshot_cache_dir=cache)
+    a.snapshot()
+    assert a.save_snapshot_cache() is not None
+
+    b = TpuCheckEngine(p, p.namespaces, snapshot_cache_dir=cache, compact_after_s=3600.0)
+    import keto_tpu.check.tpu_engine as mod
+
+    orig = mod.build_snapshot
+
+    def boom(*args, **kw):
+        raise AssertionError("cold start rebuilt despite a valid cache")
+
+    mod.build_snapshot = boom
+    try:
+        snap_b = b.snapshot()
+        assert b.maintenance.snapshot().get("cache_loads", 0) == 1
+        assert snap_b.snapshot_id == p.watermark()
+        qs = [
+            T("d", "doc", "view", SubjectID("alice")),
+            T("d", "doc", "view", SubjectID("bob")),
+            T("d", "doc", "view", SubjectID("ghost")),
+            T("g", "team", "member", SubjectSet("g", "core", "member")),
+            T("g", "", "", SubjectID("alice")),  # pattern path over cache
+        ]
+        assert b.batch_check(qs) == a.batch_check(qs)
+
+        # delta catch-up from the cached watermark (still no rebuild)
+        p.write_relation_tuples(T("g", "core", "member", SubjectID("carol")))
+        assert b.subject_is_allowed(T("d", "doc", "view", SubjectID("carol")))
+        # and compaction over the cache-backed interner
+        snap_ov = b.snapshot()
+        if snap_ov.has_overlay:
+            compacted = b._compact_locked(snap_ov)
+            assert compacted is not None
+            assert decisions(b, compacted, qs) == a.batch_check(qs)
+    finally:
+        mod.build_snapshot = orig
+
+    # expand parity across cache reload
+    nm = namespace_pkg.MemoryManager(NSS)
+    b2 = TpuCheckEngine(p, p.namespaces, snapshot_cache_dir=cache)
+    oracle_engine = TpuCheckEngine(p, p.namespaces)
+    keys = [("d", "doc", "view"), ("g", "team", "member")]
+    assert expand_trees(b2, nm, keys) == expand_trees(oracle_engine, nm, keys)
+
+
+def test_cache_ignored_when_store_is_behind(tmp_path):
+    """A cache whose watermark is AHEAD of the store (fresh empty store,
+    stale cache dir) must never serve."""
+    cache = str(tmp_path / "snapcache")
+    p = make_store()
+    p.write_relation_tuples(T("g", "team", "member", SubjectID("alice")))
+    a = TpuCheckEngine(p, p.namespaces, snapshot_cache_dir=cache)
+    a.snapshot()
+    assert a.save_snapshot_cache() is not None
+
+    fresh_store = make_store()  # watermark 0 < cached watermark
+    b = TpuCheckEngine(fresh_store, fresh_store.namespaces, snapshot_cache_dir=cache)
+    snap = b.snapshot()
+    assert snap.n_nodes == 0
+    assert not b.subject_is_allowed(T("g", "team", "member", SubjectID("alice")))
+
+
+def test_cache_prunes_old_versions(tmp_path):
+    from keto_tpu.graph import snapcache
+    from keto_tpu.graph.snapshot import build_snapshot
+
+    cache = tmp_path / "snapcache"
+    p = make_store()
+    for i in range(4):
+        p.write_relation_tuples(T("g", "team", "member", SubjectID(f"u{i}")))
+        rows, wm = p.snapshot_rows()
+        assert snapcache.save_snapshot(build_snapshot(rows, wm), str(cache))
+    kept = sorted(d.name for d in cache.iterdir() if not d.name.startswith(".tmp-"))
+    assert len(kept) == snapcache.KEEP
+    assert f"v{snapcache.FORMAT_VERSION}-w4" in kept
+
+
+def test_parallel_ingest_reaches_same_snapshot(monkeypatch):
+    """The parallel native interner must produce the exact same snapshot
+    arrays as the serial one (determinism is what makes compaction and
+    lockstep possible at all)."""
+    from keto_tpu.graph.native import load_library
+    from keto_tpu.graph.snapshot import build_snapshot
+
+    if load_library() is None:
+        pytest.skip("native library not built")
+    rng = random.Random(7)
+    rows = []
+    p = make_store()
+    objects = [f"o{i}" for i in range(40)]
+    users = [f"u{i}" for i in range(200)]
+    for _ in range(3000):
+        rows.append(rand_tuple(rng, objects, ["m", "v"], users))
+    p.write_relation_tuples(*rows)
+    stored, wm = p.snapshot_rows()
+
+    monkeypatch.setenv("KETO_TPU_INGEST_THREADS", "1")
+    serial = build_snapshot(stored, wm)
+    monkeypatch.setenv("KETO_TPU_INGEST_THREADS", "5")
+    parallel = build_snapshot(stored, wm)
+    np.testing.assert_array_equal(serial.raw2dev, parallel.raw2dev)
+    np.testing.assert_array_equal(serial.fwd_indptr, parallel.fwd_indptr)
+    np.testing.assert_array_equal(serial.fwd_indices, parallel.fwd_indices)
+    np.testing.assert_array_equal(serial.sink_indices, parallel.sink_indices)
+    assert len(serial.buckets) == len(parallel.buckets)
+    for a, b in zip(serial.buckets, parallel.buckets):
+        np.testing.assert_array_equal(a.nbrs, b.nbrs)

@@ -39,7 +39,12 @@ def fuzz_rows(seed, n):
 
 @pytest.mark.parametrize("seed", range(4))
 @pytest.mark.parametrize("wild_ns", [frozenset(), frozenset({7})])
-def test_exact_equivalence(seed, wild_ns):
+@pytest.mark.parametrize("threads", ["1", "3"])
+def test_exact_equivalence(seed, wild_ns, threads, monkeypatch):
+    # threads > 1 forces the chunked parallel interner even at this tiny
+    # row count — its merge must reproduce the serial id assignment
+    # exactly (first-occurrence order across the concatenated stream)
+    monkeypatch.setenv("KETO_TPU_INGEST_THREADS", threads)
     rows = fuzz_rows(seed, 300)
     py = intern_rows(rows, wild_ns)
     nat = native_intern_rows(rows, wild_ns)
