@@ -59,7 +59,10 @@ from jax import lax
 from keto_tpu import namespace as namespace_pkg
 from keto_tpu.graph.snapshot import WILDCARD, GraphSnapshot, build_snapshot
 from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
-from keto_tpu.x.errors import ErrNamespaceUnknown
+from keto_tpu.x import faults
+from keto_tpu.x.errors import ErrNamespaceUnknown, KetoError
+from keto_tpu.x.retry import retry_call
+from keto_tpu.x.supervise import SupervisedTask
 from keto_tpu.x.telemetry import DurationStats, MaintenanceStats
 
 _log = logging.getLogger("keto_tpu.check")
@@ -583,6 +586,9 @@ class TpuCheckEngine:
         stream_slice_target_ms: float = 40.0,
         overlay_edge_budget: int = 4096,
         snapshot_cache_dir: Optional[str] = None,
+        degraded_probe_s: float = 5.0,
+        device_error_threshold: int = 3,
+        refresh_retry_max_wait_s: float = 2.0,
     ):
         if it_cap < 1:
             raise ValueError("it_cap must be >= 1 (the answer pull needs one step)")
@@ -645,7 +651,6 @@ class TpuCheckEngine:
         # persistent snapshot cache (keto_tpu/graph/snapcache.py): reload
         # on cold start, save in the background after every full build
         self._cache_dir = snapshot_cache_dir or None
-        self._cache_save: Optional[threading.Thread] = None
         #: maintenance counters operators + bench read (overlay occupancy,
         #: compaction/rebuild counts and durations, cache save/reload)
         self.maintenance = MaintenanceStats()
@@ -653,7 +658,35 @@ class TpuCheckEngine:
         self.maintenance.set_gauge("overlay_edges", 0)
         self._peel_seed_cap = peel_seed_cap
         self._overlay_born: Optional[float] = None
-        self._bg_rebuild: Optional[threading.Thread] = None
+        # supervised maintenance (x/supervise.py): refresh and cache-save
+        # run under crash-containing workers with jittered backoff and
+        # crash counters instead of ad-hoc threads that die silently;
+        # persistence reads inside a pass retry through x/retry.py for up
+        # to refresh_retry_max_wait_s before the pass counts as failed
+        self._refresh_retry_max_wait_s = refresh_retry_max_wait_s
+        self._refresh_force_full = False
+        self._refresh_task = SupervisedTask(
+            "refresh", self._refresh_pass, stats=self.maintenance
+        )
+        self._cache_pending: Optional[GraphSnapshot] = None
+        self._cache_task = SupervisedTask(
+            "cache-save", self._cache_save_pass, stats=self.maintenance,
+            base_backoff_s=0.1, max_backoff_s=5.0,
+        )
+        # degraded mode: repeated device-path failures flip checks to the
+        # CPU reference engine (bit-identical decisions, reference
+        # throughput); the device path is re-probed every
+        # degraded_probe_s and recovery is automatic on success
+        self._degraded_probe_s = degraded_probe_s
+        self._device_error_threshold = device_error_threshold
+        self._consec_device_errors = 0
+        self._degraded = False
+        self._probe_after = 0.0
+        self._fallback_lock = threading.Lock()
+        self._fallback_engine_obj = None
+        # staleness clock for the health state machine: monotonic instant
+        # the serving snapshot was last known current with the store
+        self._behind_since: Optional[float] = None
         # serving-mode policy: when the last full rebuild cost more than
         # this, the serving path never rebuilds inline — it serves the
         # current snapshot and catches up in the background (deltas still
@@ -716,7 +749,21 @@ class TpuCheckEngine:
         """
         snap = self._snapshot
         if snap is None or self._last_full_build_s <= self._sync_rebuild_budget_s:
-            return self.snapshot()
+            try:
+                return self.snapshot()
+            except Exception:
+                if self._snapshot is None:
+                    raise  # nothing to serve stale from — STARTING territory
+                # refresh is broken but the read plane holds: serve the
+                # last snapshot, count the failure, retry in the
+                # supervised background worker (the health state machine
+                # flips NOT_SERVING once staleness crosses its budget)
+                self.maintenance.incr("refresh_failures")
+                _log.warning(
+                    "inline refresh failed; serving stale snapshot", exc_info=True
+                )
+                self._kick_background_refresh()
+                return self._snapshot
         wm = self._store.watermark()
         if snap.snapshot_id >= wm:
             # current — return it directly (NOT via snapshot(): a write
@@ -726,7 +773,20 @@ class TpuCheckEngine:
             return snap
         if self._lock.acquire(blocking=False):
             try:
-                got = self._refresh_locked(delta_only=True)
+                try:
+                    got = self._refresh_locked(delta_only=True)
+                except Exception:
+                    # the serving path NEVER stalls or fails on refresh
+                    # trouble: count it, serve the current snapshot
+                    # (bounded staleness — the health state machine turns
+                    # budget overruns into NOT_SERVING), and let the
+                    # supervised background worker retry with backoff
+                    self.maintenance.incr("refresh_failures")
+                    _log.warning(
+                        "inline delta refresh failed; serving stale snapshot",
+                        exc_info=True,
+                    )
+                    got = None
                 if got is not None:
                     if self._overlay_edge_count(got) > self._max_overlay_edges:
                         # serve fresh NOW; fold the oversized overlay into
@@ -747,6 +807,180 @@ class TpuCheckEngine:
             return self.snapshot_serving()
         return self.snapshot()
 
+    def _read_store(self, fn, *args):
+        """A persistence read on the refresh path: transient failures
+        retry through the shared jittered-backoff policy (x/retry.py) for
+        up to ``refresh_retry_max_wait_s`` before the maintenance pass is
+        declared failed. ``refresh-read`` is the fault-injection seam
+        (x/faults.py) the resilience suite arms to kill refresh."""
+
+        def attempt():
+            faults.check("refresh-read")
+            return fn(*args)
+
+        return retry_call(
+            attempt,
+            max_wait_s=self._refresh_retry_max_wait_s,
+            base_s=0.05,
+            max_s=0.5,
+            on_retry=lambda e, d: self.maintenance.incr("refresh_read_retries"),
+        )
+
+    # -- health (keto_tpu/driver/health.py reads this surface) ---------------
+
+    def staleness_s(self) -> float:
+        """Seconds the serving snapshot has been behind the store
+        watermark (0.0 while current, or before the first build — a cold
+        engine builds inline on first check, it is not stale). Observing
+        a gap also kicks the supervised catch-up, so a health poll is
+        itself a self-healing probe."""
+        snap = self._snapshot
+        if snap is None:
+            return 0.0
+        try:
+            wm = self._store.watermark()
+        except Exception:
+            wm = None  # store unreadable: keep (or start) the behind clock
+        now = time.monotonic()
+        if wm is not None and snap.snapshot_id >= wm:
+            self._behind_since = None
+            return 0.0
+        if self._behind_since is None:
+            self._behind_since = now
+        self._kick_background_refresh()
+        return now - self._behind_since
+
+    def health(self) -> dict:
+        """Live inputs for the health state machine
+        (keto_tpu/driver/health.py): snapshot presence and staleness vs
+        the store watermark, maintenance-thread liveness and crash
+        counters, and the degraded-mode flag."""
+        rt = self._refresh_task
+        return {
+            "has_snapshot": self._snapshot is not None,
+            "staleness_s": self.staleness_s(),
+            "maintenance_alive": rt.alive() and self._cache_task.alive(),
+            "refresh_failures": rt.crashes,
+            "refresh_consecutive_failures": rt.consecutive_failures,
+            "refresh_last_error": rt.last_error,
+            "degraded": self._degraded,
+            "consecutive_device_errors": self._consec_device_errors,
+        }
+
+    def close(self) -> None:
+        """Stop the supervised maintenance workers (daemon threads — this
+        is shutdown hygiene, not a liveness requirement)."""
+        self._refresh_task.stop()
+        self._cache_task.stop()
+
+    # -- degraded mode (CPU fallback) ----------------------------------------
+
+    def _should_fallback(self) -> bool:
+        """Route checks to the CPU reference engine? True while degraded,
+        except once per ``degraded_probe_s`` — then one batch tries the
+        device path again and recovery is automatic on success."""
+        if not self._degraded or self._multiprocess:
+            return False
+        return time.monotonic() < self._probe_after
+
+    def _note_device_error(self, exc: BaseException) -> None:
+        self.maintenance.incr("device_errors")
+        self._consec_device_errors += 1
+        self._probe_after = time.monotonic() + self._degraded_probe_s
+        if (
+            not self._degraded
+            and self._consec_device_errors >= self._device_error_threshold
+        ):
+            self._degraded = True
+            self.maintenance.set_gauge("degraded", 1)
+            _log.error(
+                "device check path failed %d times in a row (%s); entering "
+                "DEGRADED mode — checks served by the CPU reference engine "
+                "until the device path heals",
+                self._consec_device_errors, exc,
+            )
+        else:
+            _log.warning(
+                "device check failed (%s); serving this batch from the CPU "
+                "reference engine", exc,
+            )
+
+    def _note_device_ok(self) -> None:
+        if self._consec_device_errors or self._degraded:
+            if self._degraded:
+                _log.warning("device check path healthy; leaving DEGRADED mode")
+                self.maintenance.set_gauge("degraded", 0)
+            self._degraded = False
+            self._consec_device_errors = 0
+
+    def _fallback(self):
+        with self._fallback_lock:
+            if self._fallback_engine_obj is None:
+                from keto_tpu.check.engine import CheckEngine
+
+                self._fallback_engine_obj = CheckEngine(self._store)
+            return self._fallback_engine_obj
+
+    def _fallback_check(self, tuples) -> tuple[list[bool], Optional[int]]:
+        """Answer on the CPU reference engine (keto_tpu/check/engine.py)
+        — the differential-testing oracle the device path is fuzz-tested
+        against, so decisions are bit-identical by construction. It reads
+        the live store (read-your-writes fresh); the returned snaptoken is
+        the store watermark when readable."""
+        eng = self._fallback()
+        out = [eng.subject_is_allowed(t) for t in tuples]
+        self.maintenance.incr("fallback_checks", by=len(out))
+        try:
+            token = self._store.watermark()
+        except Exception:
+            token = None
+        return out, token
+
+    def _fallback_stream(self, tuples_iter, *, ordered: bool, chunk: int = 1024):
+        """Streaming surface of the CPU fallback — same yield contract as
+        ``_stream`` (bool arrays in order, or ``(offset, array)`` pairs
+        with ``ordered=False``). Returns ``(generator, token)``."""
+        try:
+            token = self._store.watermark()
+        except Exception:
+            token = None
+        eng = self._fallback()
+
+        def gen():
+            it = iter(tuples_iter)
+            off = 0
+            while True:
+                batch = list(itertools.islice(it, chunk))
+                if not batch:
+                    return
+                out = np.fromiter(
+                    (eng.subject_is_allowed(t) for t in batch), dtype=bool,
+                    count=len(batch),
+                )
+                self.maintenance.incr("fallback_checks", by=len(batch))
+                yield (off, out) if not ordered else out
+                off += len(batch)
+
+        return gen(), token
+
+    def _guard_stream(self, inner):
+        """Device-error accounting around a streaming generator: a failed
+        stream counts toward degraded mode — the caller (CheckBatcher)
+        retries its unresolved futures through ``batch_check_with_token``,
+        which then routes to the CPU fallback — and a completed stream
+        marks the device path healthy."""
+
+        def gen():
+            try:
+                yield from inner
+            except Exception as e:
+                if not self._multiprocess and not isinstance(e, KetoError):
+                    self._note_device_error(e)
+                raise
+            self._note_device_ok()
+
+        return gen()
+
     def _maybe_kick_compaction(self, snap: GraphSnapshot) -> None:
         """Fold an overlay that has been quiet for compact_after_s into a
         fresh base layout, off the serving path (one policy, shared by
@@ -759,21 +993,27 @@ class TpuCheckEngine:
             self._kick_background_refresh(force_full=True)
 
     def _kick_background_refresh(self, force_full: bool = False) -> None:
-        """Start (at most one) background thread bringing the snapshot up
+        """Schedule a supervised background pass bringing the snapshot up
         to the store's watermark — or, with ``force_full``, compacting a
         pending overlay into a fresh base layout — so readers never pay
-        the rebuild."""
-        t = self._bg_rebuild
-        if t is not None and t.is_alive():
-            return
+        the rebuild. Crashes are counted, logged, and retried with
+        jittered backoff (x/supervise.py) instead of silently killing the
+        maintenance thread."""
+        if force_full:
+            self._refresh_force_full = True
+        self._refresh_task.kick()
 
-        def run():
+    def _refresh_pass(self) -> None:
+        """One supervised refresh pass (the SupervisedTask target)."""
+        force_full, self._refresh_force_full = self._refresh_force_full, False
+        try:
             with self._lock:
                 self._refresh_locked(force_full=force_full)
-
-        t = threading.Thread(target=run, name="keto-tpu-snapshot-refresh", daemon=True)
-        self._bg_rebuild = t
-        t.start()
+        except Exception:
+            if force_full:
+                # the failed pass still owes a compaction — retry as one
+                self._refresh_force_full = True
+            raise
 
     def _refresh_locked(
         self, force_full: bool = False, delta_only: bool = False
@@ -793,6 +1033,7 @@ class TpuCheckEngine:
         if snap is not None and snap.snapshot_id == wm and not (
             force_full and snap.has_overlay
         ):
+            self._behind_since = None
             return snap
         wild_ns_ids = frozenset(
             n.id for n in self._nm().namespaces() if n.name == ""
@@ -806,7 +1047,18 @@ class TpuCheckEngine:
                 self.maintenance.set_gauge("overlay_edges", n_ov)
                 over = force_full or n_ov > self._max_overlay_edges
                 if over and new.has_overlay and not delta_only:
-                    compacted = self._compact_locked(new)
+                    try:
+                        compacted = self._compact_locked(new)
+                    except Exception:
+                        # a broken compaction must not kill the refresh:
+                        # count it, log it, and let the full rebuild
+                        # below re-establish a clean base layout
+                        self.maintenance.incr("compaction_failures")
+                        _log.warning(
+                            "overlay compaction failed; falling back to a full rebuild",
+                            exc_info=True,
+                        )
+                        compacted = None
                     if compacted is not None:
                         new = compacted
                     elif force_full or n_ov > self._max_overlay_edges:
@@ -815,7 +1067,7 @@ class TpuCheckEngine:
             if delta_only:
                 return None
             t0 = time.monotonic()
-            rows, wm = self._store.snapshot_rows()
+            rows, wm = self._read_store(self._store.snapshot_rows)
             cols_fn = getattr(self._store, "snapshot_columns", None)
             new = build_snapshot(
                 rows, wm, wild_ns_ids,
@@ -831,6 +1083,10 @@ class TpuCheckEngine:
         self._apply_ell_patch(new)
         self._upload_overlay(new)
         self._snapshot = new
+        # freshness clock: reaching the watermark this pass read counts as
+        # current even if the store moved again meanwhile (the next pass
+        # is kicked by whoever observes the new gap)
+        self._behind_since = None
         if new.has_overlay:
             if self._overlay_born is None:
                 self._overlay_born = time.monotonic()
@@ -867,7 +1123,7 @@ class TpuCheckEngine:
 
         changes_since = getattr(self._store, "changes_since", None)
         if changes_since is not None:
-            got = changes_since(base.snapshot_id)
+            got = self._read_store(changes_since, base.snapshot_id)
             if got is None:
                 return None
             ops, new_wm = got
@@ -875,7 +1131,7 @@ class TpuCheckEngine:
             rows_since = getattr(self._store, "rows_since", None)
             if rows_since is None:
                 return None
-            got = rows_since(base.snapshot_id)
+            got = self._read_store(rows_since, base.snapshot_id)
             if got is None:
                 return None
             rows, new_wm = got
@@ -898,6 +1154,7 @@ class TpuCheckEngine:
         the overlay's shape needs the full-rebuild fallback."""
         from keto_tpu.graph.compaction import compact_snapshot
 
+        faults.check("compaction")
         t0 = time.monotonic()
         # flush pending device-bucket patches first: compaction reuses
         # untouched device buckets, which is only sound when they agree
@@ -936,7 +1193,16 @@ class TpuCheckEngine:
         from keto_tpu.graph import snapcache
 
         t0 = time.monotonic()
-        snap = snapcache.load_latest(self._cache_dir, max_watermark=store_wm)
+        # transient read failures (NFS blips, a save racing the reload)
+        # retry through the shared backoff before cold start falls back
+        # to the full ingest+build path
+        snap = retry_call(
+            lambda: snapcache.load_latest(self._cache_dir, max_watermark=store_wm),
+            max_wait_s=2.0,
+            base_s=0.05,
+            max_s=0.5,
+            on_retry=lambda e, d: self.maintenance.incr("cache_reload_retries"),
+        )
         if snap is None:
             return None
         wild_now = frozenset(
@@ -956,32 +1222,32 @@ class TpuCheckEngine:
         return snap
 
     def _kick_cache_save(self, snap: GraphSnapshot) -> None:
-        """Persist an overlay-free snapshot in the background (at most one
-        save in flight; failures log and never affect serving)."""
+        """Persist an overlay-free snapshot via the supervised cache-save
+        worker. Failures are no longer a silent drop: the supervisor logs
+        them, counts ``cache_save_failures`` into ``maintenance``, and
+        retries with jittered backoff; kicks coalesce so only the newest
+        pending snapshot is saved. Serving is never affected."""
         if self._cache_dir is None or snap.has_overlay:
             return
-        t = self._cache_save
-        if t is not None and t.is_alive():
+        self._cache_pending = snap
+        self._cache_task.kick()
+
+    def _cache_save_pass(self) -> None:
+        """One supervised cache-save pass (the SupervisedTask target)."""
+        snap = self._cache_pending
+        if snap is None:
             return
+        from keto_tpu.graph import snapcache
 
-        def run():
-            from keto_tpu.graph import snapcache
-
-            t0 = time.monotonic()
-            try:
-                path = snapcache.save_snapshot(snap, self._cache_dir)
-            except Exception:
-                _log.warning("snapshot cache save failed", exc_info=True)
-                return
-            if path is not None:
-                self.maintenance.incr("cache_saves")
-                self.maintenance.observe_ms(
-                    "cache_save", (time.monotonic() - t0) * 1e3
-                )
-
-        t = threading.Thread(target=run, name="keto-tpu-snapshot-save", daemon=True)
-        self._cache_save = t
-        t.start()
+        faults.check("cache-save")
+        t0 = time.monotonic()
+        path = snapcache.save_snapshot(snap, self._cache_dir)
+        if path is not None:
+            self.maintenance.incr("cache_saves")
+            self.maintenance.observe_ms(
+                "cache_save", (time.monotonic() - t0) * 1e3
+            )
+        self._cache_pending = None
 
     def save_snapshot_cache(self) -> Optional[str]:
         """Synchronously persist the current snapshot (bench/operator
@@ -1400,7 +1666,17 @@ class TpuCheckEngine:
         mode: str = "latest",
     ) -> tuple[list[bool], int]:
         """``batch_check`` plus the id of the snapshot that produced the
-        decisions — the snaptoken the API returns to callers."""
+        decisions — the snaptoken the API returns to callers.
+
+        Degraded mode: when the device path has failed repeatedly, checks
+        transparently fall back to the CPU reference engine (bit-identical
+        decisions, reference throughput) and the health state machine
+        reports DEGRADED; the device path is re-probed periodically and
+        recovery is automatic. Multi-controller meshes never fall back —
+        hosts diverging on the execution path is a lockstep violation, so
+        device failures there fail loudly instead."""
+        if self._should_fallback():
+            return self._fallback_check(tuples)
         snap = self._snapshot_for(at_least, mode)
         if self._lockstep_verify:
             from keto_tpu.parallel.lockstep import verify_lockstep
@@ -1411,7 +1687,14 @@ class TpuCheckEngine:
             verify_lockstep(snap.snapshot_id, tuples)
         if snap.n_nodes == 0 or snap.n_edges == 0 or not tuples:
             return [False] * len(tuples), snap.snapshot_id
-        out, max_iters = self._run_exact(snap, tuples)
+        try:
+            out, max_iters = self._run_exact(snap, tuples)
+        except Exception as e:
+            if self._multiprocess or isinstance(e, KetoError):
+                raise
+            self._note_device_error(e)
+            return self._fallback_check(tuples)
+        self._note_device_ok()
         self._after_batch(max_iters)
         return out.tolist(), snap.snapshot_id
 
@@ -1513,12 +1796,18 @@ class TpuCheckEngine:
     ):
         """``batch_check_stream`` plus the deciding snapshot's id, resolved
         eagerly so serving callers can attach the snaptoken to responses
-        they assemble as slices land. Returns ``(generator, token)``."""
+        they assemble as slices land. Returns ``(generator, token)``.
+
+        In degraded mode the stream is served by the CPU reference engine
+        with the same yield contract (see ``batch_check_with_token`` for
+        the fallback semantics)."""
+        if self._should_fallback():
+            return self._fallback_stream(tuples_iter, ordered=ordered)
         snap = self._snapshot_for(at_least, mode)
         gen = self._stream(
             snap, tuples_iter, depth=depth, slice_cap=slice_cap, ordered=ordered
         )
-        return gen, snap.snapshot_id
+        return self._guard_stream(gen), snap.snapshot_id
 
     @staticmethod
     def _slice_ready(dev) -> bool:
@@ -1818,6 +2107,7 @@ class TpuCheckEngine:
         force_W: Optional[int] = None,
         it_cap: Optional[int] = None,
     ):
+        faults.check("device-exec")
         packed, host_ans = pack_chunk(snap, sd, tg, multi, i0, i1, force_W)
         if packed is None:
             # no query in the chunk reaches the device: host_ans is the

@@ -70,6 +70,21 @@ CONFIG_SCHEMA = {
                     "default": "",
                     "description": "Directory for the persistent snapshot cache. When set, every full snapshot build is serialized here (versioned, keyed by watermark) and cold start mmap-reloads the newest cache at or below the store watermark, then catches up through the delta path — minutes of ingest+build become seconds. Empty disables caching.",
                 },
+                "staleness_budget_s": {
+                    "type": "number",
+                    "default": 60.0,
+                    "description": "Health state machine: how far (seconds) the serving snapshot may fall behind the store watermark before readiness flips to NOT_SERVING (REST /health/ready 503, grpc.health.v1 NOT_SERVING). Serving keeps answering from the last snapshot throughout — the budget bounds the staleness external consumers will tolerate, not availability. Recovery is automatic once the supervised refresh catches up.",
+                },
+                "degraded_probe_s": {
+                    "type": "number",
+                    "default": 5.0,
+                    "description": "Degraded (CPU fallback) mode: how often the engine re-probes the failing device path with a live batch. While degraded, checks are served by the CPU reference engine with bit-identical decisions and health reports DEGRADED; a successful probe restores the device path automatically.",
+                },
+                "shed_on_full": {
+                    "type": "boolean",
+                    "default": True,
+                    "description": "Load shedding: answer 429 / RESOURCE_EXHAUSTED immediately when the check queue is at capacity, instead of blocking callers into their own timeouts. Expired request deadlines (gRPC deadline, X-Request-Timeout-Ms) always shed with 504 / DEADLINE_EXCEEDED before packing.",
+                },
             },
         },
         "namespaces": {

@@ -20,13 +20,19 @@ whole-batch latency, when the device splits a large batch.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Optional, Sequence
 
 from keto_tpu.relationtuple.model import RelationTuple
+from keto_tpu.x import faults
+from keto_tpu.x.errors import ErrDeadlineExceeded, ErrTooManyRequests, KetoError
+
+_log = logging.getLogger("keto_tpu.batch")
 
 
 class CheckBatcher:
@@ -36,17 +42,28 @@ class CheckBatcher:
         batch_size: int = 4096,
         window_ms: float = 1.0,
         max_pending: Optional[int] = None,
+        shed_on_full: bool = False,
     ):
         """``engine`` needs ``batch_check(list[RelationTuple]) -> list[bool]``.
 
         ``max_pending`` bounds the queue (default 8×batch_size): when the
         device can't keep up, callers block in ``check`` up to their own
         timeout instead of growing an unbounded backlog — backpressure
-        propagates to the accepting sockets rather than to memory."""
+        propagates to the accepting sockets rather than to memory. With
+        ``shed_on_full`` (what the registry configures for serving
+        processes), a full queue instead *sheds immediately* with
+        ``ErrTooManyRequests`` (REST 429 / gRPC RESOURCE_EXHAUSTED) — the
+        client learns it should back off *now*, seconds ahead of the
+        future timeout it would otherwise burn."""
         self._engine = engine
         self._batch_size = batch_size
         self._window_s = window_ms / 1e3
         self._queue: queue.Queue = queue.Queue(maxsize=max_pending or 8 * batch_size)
+        self._shed_on_full = shed_on_full
+        #: requests refused at the door (queue full)
+        self.shed_count = 0
+        #: requests dropped at dispatch because their deadline had passed
+        self.deadline_drop_count = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -86,13 +103,14 @@ class CheckBatcher:
         *,
         at_least: Optional[int] = None,
         latest: bool = False,
+        deadline: Optional[float] = None,
     ) -> bool:
         """Blocking single check, transparently batched with concurrent
         callers. Default consistency is the serving mode (bounded
         staleness, never stalled by a rebuild); ``at_least`` pins a
         caller's snaptoken, ``latest`` forces read-your-writes."""
         return self.check_with_token(
-            tuple_, timeout, at_least=at_least, latest=latest
+            tuple_, timeout, at_least=at_least, latest=latest, deadline=deadline
         )[0]
 
     def check_with_token(
@@ -102,21 +120,51 @@ class CheckBatcher:
         *,
         at_least: Optional[int] = None,
         latest: bool = False,
+        deadline: Optional[float] = None,
     ) -> tuple[bool, Optional[int]]:
         """``check`` plus the id of the snapshot that decided it (None when
         the engine has no snapshot concept — e.g. the recursive oracle,
-        which reads the store directly and is always fresh)."""
+        which reads the store directly and is always fresh).
+
+        ``deadline`` is the request's *absolute* ``time.monotonic()``
+        deadline (REST/gRPC propagate theirs): it rides with the queued
+        request so the collector sheds it *before packing* if it expires
+        waiting, and the caller gets ``ErrDeadlineExceeded`` (504 /
+        DEADLINE_EXCEEDED) instead of an answer nobody is waiting for.
+        ``timeout`` remains the relative cap; the earlier of the two
+        wins."""
         if self._stop.is_set():
             raise RuntimeError("check batcher stopped")
-        deadline = None if timeout is None else time.monotonic() + timeout
+        if timeout is not None:
+            t_deadline = time.monotonic() + timeout
+            deadline = t_deadline if deadline is None else min(deadline, t_deadline)
+        if deadline is not None and time.monotonic() >= deadline:
+            raise ErrDeadlineExceeded("deadline expired before the check was queued")
         fut: Future = Future()
-        try:
-            # a full queue blocks the caller — the backpressure seam
-            # between accepts and the device — against the SAME deadline
-            # the result wait uses, so the total never exceeds ``timeout``
-            self._queue.put((tuple_, fut, at_least, latest), timeout=timeout)
-        except queue.Full:
-            raise TimeoutError("check queue full (device backlogged)") from None
+        item = (tuple_, fut, at_least, latest, deadline)
+        if self._shed_on_full:
+            # serving mode: a full queue answers 429 NOW — the device is
+            # backlogged and queueing deeper only converts the client's
+            # timeout budget into server memory
+            try:
+                self._queue.put_nowait(item)
+            except queue.Full:
+                self.shed_count += 1
+                raise ErrTooManyRequests(
+                    "check queue full (device backlogged); retry with backoff"
+                ) from None
+        else:
+            try:
+                # a full queue blocks the caller — the backpressure seam
+                # between accepts and the device — against the SAME
+                # deadline the result wait uses, so the total never
+                # exceeds ``timeout``
+                block = None
+                if deadline is not None:
+                    block = max(0.0, deadline - time.monotonic())
+                self._queue.put(item, timeout=block)
+            except queue.Full:
+                raise TimeoutError("check queue full (device backlogged)") from None
         if self._stop.is_set() and not fut.done():
             # raced with stop()'s drain: nobody will serve the queue
             # anymore — unless the collector's final batch got there first
@@ -124,8 +172,15 @@ class CheckBatcher:
                 fut.set_exception(RuntimeError("check batcher stopped"))
             except InvalidStateError:
                 pass  # the collector resolved it; return that result
-        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-        return fut.result(timeout=remaining)
+        remaining = None
+        if deadline is not None:
+            remaining = max(0.0, deadline - time.monotonic())
+        try:
+            return fut.result(timeout=remaining)
+        except FutureTimeout:
+            raise ErrDeadlineExceeded(
+                "deadline expired waiting for the check result"
+            ) from None
 
     def check_batch(self, tuples: Sequence[RelationTuple]) -> list[bool]:
         """Pre-batched requests skip the queue entirely."""
@@ -154,20 +209,43 @@ class CheckBatcher:
             return self._engine.batch_check(tuples), None
         return [self._engine.subject_is_allowed(t) for t in tuples], None
 
-    def _dispatch_stream(self, batch, tuples, at_leasts, latests) -> None:
+    def _expire(self, fut: Future) -> None:
+        self.deadline_drop_count += 1
+        if not fut.done():
+            fut.set_exception(
+                ErrDeadlineExceeded("deadline expired before dispatch")
+            )
+
+    def _dispatch_stream(self, batch, at_leasts, latests) -> None:
         """Streaming dispatch for engines with the ready-order stream API:
         each caller's future resolves the moment ITS slice lands (the
         ``ordered=False`` fast path — re-association is by query offset),
         so early-finishing slices of a large coalesced batch don't wait
         behind stragglers. Mid-stream failures propagate to the caller
-        (``_loop`` fails every still-unresolved future)."""
+        (``_loop`` retries unresolved futures once, then fails them).
+
+        Deadlines are enforced at PACK time: the tuple iterator the
+        stream slices from skips requests whose deadline has passed —
+        they get ``ErrDeadlineExceeded`` and never occupy a device slice
+        (an expired request in a slice would displace a live one)."""
+        emitted: list = []  # stream offset -> batch item, built at pull time
+
+        def live_tuples():
+            for item in batch:
+                dl = item[4]
+                if dl is not None and time.monotonic() >= dl:
+                    self._expire(item[1])
+                    continue
+                emitted.append(item)
+                yield item[0]
+
         gen, token = self._engine.batch_check_stream_with_token(
-            iter(tuples), ordered=False,
+            live_tuples(), ordered=False,
             **self._consistency_kw(at_leasts, latests),
         )
         for off, out in gen:
             for j, allowed in enumerate(out.tolist()):
-                fut = batch[off + j][1]
+                fut = emitted[off + j][1]
                 if not fut.done():
                     fut.set_result((bool(allowed), token))
 
@@ -200,19 +278,61 @@ class CheckBatcher:
                     break
                 batch.append(nxt)
 
-            tuples = [t for t, _, _, _ in batch]
-            at_leasts = [a for _, _, a, _ in batch]
-            latests = [l for _, _, _, l in batch]
-            try:
-                if hasattr(self._engine, "batch_check_stream_with_token"):
-                    self._dispatch_stream(batch, tuples, at_leasts, latests)
-                    continue
-                results, token = self._dispatch(tuples, at_leasts, latests)
-            except Exception as e:  # engine failure → every caller sees it
-                for _, fut, _, _ in batch:
-                    if not fut.done():
-                        fut.set_exception(e)
+            # shed expired requests before any engine work: they never
+            # occupy a slice, and their callers hear 504 immediately
+            now = time.monotonic()
+            live = []
+            for item in batch:
+                if item[4] is not None and now >= item[4]:
+                    self._expire(item[1])
+                else:
+                    live.append(item)
+            batch = live
+            if not batch:
                 continue
-            for (_, fut, _, _), allowed in zip(batch, results):
+            at_leasts = [a for _, _, a, _, _ in batch]
+            latests = [l for _, _, _, l, _ in batch]
+            try:
+                faults.check("check-dispatch")
+                if hasattr(self._engine, "batch_check_stream_with_token"):
+                    self._dispatch_stream(batch, at_leasts, latests)
+                    continue
+                tuples = [t for t, _, _, _, _ in batch]
+                results, token = self._dispatch(tuples, at_leasts, latests)
+            except Exception as e:
+                self._fail_or_retry(batch, e)
+                continue
+            for (_, fut, _, _, _), allowed in zip(batch, results):
                 if not fut.done():
                     fut.set_result((allowed, token))
+
+    def _fail_or_retry(self, batch, exc: Exception) -> None:
+        """A failed dispatch retries its unresolved requests ONCE through
+        the engine's plain batch path — a device fault mid-stream flips
+        the engine into its CPU degraded mode, so the retry lands on the
+        fallback and callers never see the fault. Client errors
+        (KetoError) and a failed retry propagate to every waiting
+        future."""
+        pending = [item for item in batch if not item[1].done()]
+        if pending and not isinstance(exc, KetoError):
+            _log.warning(
+                "batch dispatch failed (%s: %s); retrying %d unresolved "
+                "checks on the engine's recovery path",
+                type(exc).__name__, exc, len(pending),
+            )
+            try:
+                results, token = self._dispatch(
+                    [t for t, _, _, _, _ in pending],
+                    [a for _, _, a, _, _ in pending],
+                    [l for _, _, _, l, _ in pending],
+                )
+            except Exception as e2:
+                exc = e2
+            else:
+                for (_, fut, _, _, _), allowed in zip(pending, results):
+                    if not fut.done():
+                        fut.set_result((bool(allowed), token))
+                return
+        for item in batch:
+            if not item[1].done():
+                item[1].set_exception(exc)

@@ -69,6 +69,10 @@ class Daemon:
         # way the reference resolves config during registry Init
         # (reference registry_default.go:240-261) — not on first request
         self.registry.namespace_manager()
+        # prime the health state machine before accepting traffic so the
+        # very first /health/ready or grpc.health.v1 Watch reads a live
+        # state instead of constructing the monitor mid-request
+        self.registry.health_monitor()
         self._warm_snapshot()
         read_host, read_port = cfg.read_api_address()
         write_host, write_port = cfg.write_api_address()
@@ -95,6 +99,9 @@ class Daemon:
             try:
                 engine.snapshot()
             except Exception:
+                stats = getattr(engine, "maintenance", None)
+                if stats is not None:
+                    stats.incr("warm_failures")
                 self.registry.logger().warning(
                     "boot snapshot warm failed; first request will build",
                     exc_info=True,

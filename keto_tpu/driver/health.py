@@ -1,0 +1,195 @@
+"""Health / readiness state machine for the serving core.
+
+The reference answers ``/health/ready`` and ``grpc.health.v1`` statically
+(reference internal/driver/registry_default.go:97-111) — fine for a
+stateless SQL frontend, wrong for this architecture: the TPU engine's
+correctness depends on *background maintenance* (snapshot refresh,
+compaction, cache saves) and a *device path* that can fail independently
+of the process being up. A dead refresh thread here used to mean serving
+permissions frozen at some past watermark forever, with every health
+surface still green.
+
+This module derives one externally visible state from the engine's live
+health inputs (``TpuCheckEngine.health()``):
+
+::
+
+                      first snapshot          device path failing
+        STARTING ───────────────────▶ SERVING ◀─────────────────▶ DEGRADED
+                                        ▲  │ staleness > budget,          │
+                                        │  │ or maintenance dead          │
+                         refresh caught │  ▼                              │
+                         up / thread ok └─ NOT_SERVING ◀──────────────────┘
+                                                         (degraded AND stale)
+
+- **STARTING** — no snapshot yet and nothing has failed. *Ready*: a cold
+  engine builds its snapshot inline on first check, so refusing traffic
+  would only delay the build.
+- **SERVING** — snapshot within the staleness budget, maintenance alive.
+- **DEGRADED** — the device path is failing and checks are served by the
+  CPU reference engine (bit-identical decisions, reference throughput).
+  Still *ready* — answers remain correct.
+- **NOT_SERVING** — answers can no longer be trusted fresh: the snapshot
+  is further behind the store than ``serve.staleness_budget_s`` allows,
+  or the maintenance supervisor thread itself died. REST ``/health/ready``
+  returns 503 + reason, gRPC health returns ``NOT_SERVING`` (and
+  streaming ``Watch`` emits the transition).
+
+The state is *derived on read* (staleness is a function of wall time, so
+an event-push design would need a timer wheel to notice "nothing
+happened for too long"); ``watch()`` polls cheaply and yields only
+transitions. ``set_override`` is the operator drain seam.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import threading
+import time
+from typing import Optional
+
+_log = logging.getLogger("keto_tpu.health")
+
+
+class HealthState(enum.Enum):
+    STARTING = "starting"
+    SERVING = "serving"
+    DEGRADED = "degraded"
+    NOT_SERVING = "not_serving"
+
+
+#: states in which the server should accept traffic
+READY_STATES = (HealthState.STARTING, HealthState.SERVING, HealthState.DEGRADED)
+
+
+class HealthMonitor:
+    """Derives the serving state from an engine's ``health()`` inputs.
+
+    ``engine`` may be any check engine: one without a ``health()`` method
+    (the recursive oracle — no snapshot, no staleness concept) is always
+    SERVING. Transitions are logged, counted into the engine's
+    MaintenanceStats when present (``health_transitions`` counter +
+    ``health_state`` gauge), and broadcast to ``watch()`` streams."""
+
+    def __init__(
+        self,
+        engine=None,
+        *,
+        staleness_budget_s: float = 60.0,
+    ):
+        self._engine = engine
+        self._budget = float(staleness_budget_s)
+        self._lock = threading.Lock()
+        self._last_state: Optional[HealthState] = None
+        self._last_reason = ""
+        self._override: Optional[tuple[HealthState, str]] = None
+        self._transitions = 0
+
+    @property
+    def staleness_budget_s(self) -> float:
+        return self._budget
+
+    # -- the state machine ---------------------------------------------------
+
+    def status(self) -> tuple[HealthState, str]:
+        """Current ``(state, reason)``; reason is "" while SERVING."""
+        state, reason = self._compute()
+        with self._lock:
+            if state != self._last_state:
+                if self._last_state is not None:
+                    _log.warning(
+                        "health transition %s -> %s%s",
+                        self._last_state.value, state.value,
+                        f" ({reason})" if reason else "",
+                    )
+                self._transitions += 1
+                self._last_state = state
+                self._record(state)
+            self._last_reason = reason
+        return state, reason
+
+    def ready(self) -> bool:
+        return self.status()[0] in READY_STATES
+
+    def set_override(self, state: Optional[HealthState], reason: str = "") -> None:
+        """Operator seam: pin the reported state (drain before maintenance,
+        fault rehearsal); ``None`` returns control to the derived state."""
+        with self._lock:
+            self._override = None if state is None else (state, reason)
+
+    def _compute(self) -> tuple[HealthState, str]:
+        with self._lock:
+            if self._override is not None:
+                return self._override
+        eng = self._engine
+        if eng is None or not hasattr(eng, "health"):
+            return HealthState.SERVING, ""
+        try:
+            h = eng.health()
+        except Exception as e:  # a broken health probe is itself a failure
+            return HealthState.NOT_SERVING, f"health probe failed: {e}"
+        if not h.get("maintenance_alive", True):
+            return (
+                HealthState.NOT_SERVING,
+                "snapshot maintenance thread died: " + (h.get("refresh_last_error") or "unknown"),
+            )
+        staleness = float(h.get("staleness_s", 0.0))
+        if staleness > self._budget:
+            reason = (
+                f"snapshot {staleness:.1f}s behind the store "
+                f"(budget {self._budget:.1f}s)"
+            )
+            err = h.get("refresh_last_error")
+            if err:
+                reason += f"; last refresh error: {err}"
+            return HealthState.NOT_SERVING, reason
+        if not h.get("has_snapshot", True):
+            return HealthState.STARTING, "first snapshot not built yet"
+        if h.get("degraded"):
+            return (
+                HealthState.DEGRADED,
+                "device path failing; serving bit-identical decisions "
+                "from the CPU fallback engine",
+            )
+        return HealthState.SERVING, ""
+
+    def _record(self, state: HealthState) -> None:
+        stats = getattr(self._engine, "maintenance", None)
+        if stats is not None:
+            stats.incr("health_transitions")
+            stats.set_gauge("health_state", state.value)
+
+    # -- streaming (gRPC Watch) ----------------------------------------------
+
+    def watch(self, poll_s: float = 0.2, should_stop=None):
+        """Yield ``(state, reason)`` — the current state immediately, then
+        one entry per transition. ``should_stop()`` (e.g. a gRPC
+        context-active probe, negated) ends the stream."""
+        last: Optional[HealthState] = None
+        while should_stop is None or not should_stop():
+            state, reason = self.status()
+            if state != last:
+                yield state, reason
+                last = state
+            time.sleep(poll_s)
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Operator view: state, reason, budget, transition count, and the
+        engine's raw health inputs."""
+        state, reason = self.status()
+        out = {
+            "state": state.value,
+            "reason": reason,
+            "staleness_budget_s": self._budget,
+            "transitions": self._transitions,
+        }
+        eng = self._engine
+        if eng is not None and hasattr(eng, "health"):
+            try:
+                out["engine"] = eng.health()
+            except Exception as e:
+                out["engine"] = {"error": str(e)}
+        return out

@@ -119,6 +119,9 @@ class Registry:
                         str(self._config.get("serve.snapshot_cache_dir", "") or "")
                         or None
                     ),
+                    degraded_probe_s=float(
+                        self._config.get("serve.degraded_probe_s", 5.0)
+                    ),
                 )
             return CheckEngine(store)
 
@@ -152,11 +155,31 @@ class Registry:
                 self.permission_engine(),
                 batch_size=int(self._config.get("engine.batch_size", 4096)),
                 window_ms=float(self._config.get("engine.batch_window_ms", 1.0)),
+                # serving processes shed on a full queue (429 /
+                # RESOURCE_EXHAUSTED) instead of letting callers block
+                # into their own timeouts — backpressure with an answer
+                shed_on_full=bool(self._config.get("serve.shed_on_full", True)),
             )
             b.start()
             return b
 
         return self._memo("check_batcher", build)
+
+    def health_monitor(self):
+        """The serving health state machine (keto_tpu/driver/health.py):
+        REST ``/health/ready``, gRPC ``grpc.health.v1``, and operator
+        introspection all read the same derived state."""
+        from keto_tpu.driver.health import HealthMonitor
+
+        return self._memo(
+            "health_monitor",
+            lambda: HealthMonitor(
+                self.permission_engine(),
+                staleness_budget_s=float(
+                    self._config.get("serve.staleness_budget_s", 60.0)
+                ),
+            ),
+        )
 
     # -- observability -------------------------------------------------------
 
@@ -191,6 +214,9 @@ class Registry:
         batcher = self._singletons.get("check_batcher")
         if batcher:
             batcher.stop()
+        engine = self._singletons.get("permission_engine")
+        if engine is not None and hasattr(engine, "close"):
+            engine.close()
         tracer = self._singletons.get("tracer")
         if tracer is not None:
             tracer.close()

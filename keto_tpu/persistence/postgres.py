@@ -59,26 +59,23 @@ def _normalize_dsn(dsn: str) -> str:
 
 def connect_postgres(dsn: str, max_wait_s: float = 300.0):
     """Open an autocommit DBAPI connection with whichever postgres driver
-    the host has (psycopg v3 → psycopg2 → pg8000), dialing with
-    exponential backoff up to ``max_wait_s`` — the reference retries its
-    database dial for up to five minutes the same way (reference
+    the host has (psycopg v3 → psycopg2 → pg8000), dialing through the
+    shared jittered-backoff policy (keto_tpu/x/retry.py) up to
+    ``max_wait_s`` — the reference retries its database dial for up to
+    five minutes the same way (reference
     internal/driver/pop_connection.go:38-63; servers routinely boot
     before their database accepts connections). A missing DRIVER fails
     immediately (retrying cannot install one)."""
-    import time
+    from keto_tpu.x.retry import retry_call
 
-    deadline = time.monotonic() + max_wait_s
-    delay = 0.2
-    while True:
-        try:
-            return _connect_postgres_once(dsn)
-        except RuntimeError:
-            raise  # no driver — not retryable
-        except Exception:
-            if time.monotonic() + delay > deadline:
-                raise
-            time.sleep(delay)
-            delay = min(delay * 2, 10.0)
+    return retry_call(
+        lambda: _connect_postgres_once(dsn),
+        max_wait_s=max_wait_s,
+        base_s=0.2,
+        max_s=10.0,
+        # RuntimeError = no driver installed — not retryable
+        retryable=lambda e: not isinstance(e, RuntimeError),
+    )
 
 
 def _connect_postgres_once(dsn: str):

@@ -170,7 +170,8 @@ class AsyncRestServer:
                 parts = urlsplit(target)
                 query = parse_qs(parts.query, keep_blank_values=True)
                 status, payload, extra = await asyncio.get_running_loop().run_in_executor(
-                    self._pool, self.app.handle, method, parts.path, query, body
+                    self._pool, self.app.handle, method, parts.path, query, body,
+                    headers,
                 )
                 close = (
                     version == "HTTP/1.0"

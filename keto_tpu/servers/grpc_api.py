@@ -18,6 +18,7 @@ internal/check/handler.go:162).
 from __future__ import annotations
 
 import threading
+import time
 from concurrent import futures
 from typing import Optional
 
@@ -95,8 +96,15 @@ class CheckService:
                 raise ErrBadRequest(
                     f"malformed snaptoken {request.snaptoken!r}"
                 ) from None
+        # the client's gRPC deadline rides into the batcher: a request
+        # that expires queued is shed with DEADLINE_EXCEEDED *before* it
+        # occupies a device slice; a full queue is RESOURCE_EXHAUSTED
+        deadline = None
+        remaining = context.time_remaining()
+        if remaining is not None:
+            deadline = time.monotonic() + max(0.0, remaining)
         allowed, token = self.registry.check_batcher().check_with_token(
-            tuple_, at_least=at_least, latest=request.latest
+            tuple_, at_least=at_least, latest=request.latest, deadline=deadline
         )
         return check_service_pb2.CheckResponse(
             allowed=allowed, snaptoken="" if token is None else str(token)
@@ -265,13 +273,42 @@ class VersionService:
 
 
 class HealthService:
-    """grpc.health.v1.Health (reference registry_default.go:105-111)."""
+    """grpc.health.v1.Health, driven by the health state machine
+    (keto_tpu/driver/health.py) instead of the reference's static SERVING
+    (registry_default.go:105-111). STARTING/SERVING/DEGRADED map to
+    SERVING (traffic should flow — degraded answers are bit-identical,
+    just slower); NOT_SERVING means the snapshot is beyond its staleness
+    budget or maintenance died. ``Watch`` streams every transition, so
+    load balancers drop the backend the moment it goes stale and re-add
+    it when maintenance catches up."""
+
+    def __init__(self, registry=None):
+        self.registry = registry
+
+    def _grpc_status(self):
+        if self.registry is None:
+            return health_pb2.HealthCheckResponse.SERVING
+        from keto_tpu.driver.health import READY_STATES
+
+        state, _ = self.registry.health_monitor().status()
+        if state in READY_STATES:
+            return health_pb2.HealthCheckResponse.SERVING
+        return health_pb2.HealthCheckResponse.NOT_SERVING
 
     def Check(self, request, context):
-        return health_pb2.HealthCheckResponse(status=health_pb2.HealthCheckResponse.SERVING)
+        return health_pb2.HealthCheckResponse(status=self._grpc_status())
 
     def Watch(self, request, context):
-        yield health_pb2.HealthCheckResponse(status=health_pb2.HealthCheckResponse.SERVING)
+        yield health_pb2.HealthCheckResponse(status=self._grpc_status())
+        if self.registry is None:
+            return
+        last = self._grpc_status()
+        while context.is_active():
+            cur = self._grpc_status()
+            if cur != last:
+                yield health_pb2.HealthCheckResponse(status=cur)
+                last = cur
+            time.sleep(0.2)
 
     def register(self, server):
         server.add_generic_rpc_handlers(
@@ -306,6 +343,6 @@ def build_grpc_server(registry, role: str, address: str = "127.0.0.1:0"):
     else:
         WriteService(registry).register(server)
     VersionService(registry).register(server)
-    HealthService().register(server)
+    HealthService(registry).register(server)
     port = server.add_insecure_port(address)
     return server, port

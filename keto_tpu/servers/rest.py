@@ -18,8 +18,19 @@ Endpoint behavior is a 1:1 mapping of the reference REST surface:
   (transact_server.go:173-187); ``PATCH`` applies
   ``[{"action": "insert"|"delete", "relation_tuple": {...}}]``
   atomically → 204 (transact_server.go:217-242).
-- ``GET /health/alive``, ``GET /health/ready`` → ``{"status": "ok"}``
-  (reference registry_default.go:97-103); ``GET /version``.
+- ``GET /health/alive`` → ``{"status": "ok"}`` (process liveness, the
+  reference's static answer, registry_default.go:97-103);
+  ``GET /health/ready`` is *real* readiness: the health state machine
+  (keto_tpu/driver/health.py) answers 200 ``{"status": "ok"}`` /
+  ``{"status": "degraded", ...}`` when traffic should flow and **503 +
+  JSON reason** when the snapshot is beyond its staleness budget or
+  maintenance died; ``GET /version``.
+
+Deadline propagation: an ``X-Request-Timeout-Ms`` header (or
+``timeout_ms`` query parameter) on ``/check`` rides into the batcher as
+an absolute deadline — expired requests shed with **504** before they
+occupy a device slice, and a full check queue sheds with **429**
+(keto_tpu/driver/batch.py).
 
 Errors render the herodot-style envelope from keto_tpu/x/errors.py.
 """
@@ -28,6 +39,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
 from urllib.parse import parse_qs, urlsplit
@@ -54,29 +66,47 @@ class RestApp:
 
     # -- dispatch ------------------------------------------------------------
 
-    def handle(self, method: str, path: str, query: dict[str, list[str]], body: bytes):
-        """Returns (status, payload-dict | None, headers-dict)."""
+    def handle(
+        self,
+        method: str,
+        path: str,
+        query: dict[str, list[str]],
+        body: bytes,
+        headers: Optional[dict[str, str]] = None,
+    ):
+        """Returns (status, payload-dict | None, headers-dict).
+        ``headers`` are the request headers, lowercase-keyed (deadline
+        propagation); absent for callers that don't carry them."""
         # request span + usage counter (health endpoints excluded), matching
         # the reference's middleware placement (registry_default.go:288-300)
         if not path.startswith("/health/"):
             self.registry.telemetry().record(f"{self.role} {method} {path}")
             with self.registry.tracer().span(f"http.{method} {path}", role=self.role):
-                return self._route(method, path, query, body)
-        return self._route(method, path, query, body)
+                return self._route(method, path, query, body, headers)
+        return self._route(method, path, query, body, headers)
 
-    def _route(self, method: str, path: str, query: dict[str, list[str]], body: bytes):
+    def _route(
+        self,
+        method: str,
+        path: str,
+        query: dict[str, list[str]],
+        body: bytes,
+        headers: Optional[dict[str, str]] = None,
+    ):
         try:
             route = (method, path)
-            if path in ("/health/alive", "/health/ready"):
+            if path == "/health/alive":
                 return 200, {"status": "ok"}, {}
+            if path == "/health/ready":
+                return self._health_ready()
             if path == "/version":
                 return 200, {"version": self.registry.version()}, {}
 
             if self.role == READ:
                 if route == ("GET", "/check"):
-                    return self._get_check(query)
+                    return self._get_check(query, headers)
                 if route == ("POST", "/check"):
-                    return self._post_check(body, query)
+                    return self._post_check(body, query, headers)
                 if route == ("GET", "/expand"):
                     return self._get_expand(query)
                 if route == ("GET", "/relation-tuples"):
@@ -98,9 +128,49 @@ class RestApp:
             err = KetoError(str(e) or "internal server error")
             return 500, err.to_json(), {}
 
+    # -- health --------------------------------------------------------------
+
+    def _health_ready(self):
+        """Readiness from the health state machine: ready states answer
+        200 (with the state surfaced so probes can alert on ``degraded``);
+        NOT_SERVING answers 503 with the machine's reason — a k8s
+        readiness probe pulls the pod from rotation while the snapshot is
+        beyond its staleness budget, and puts it back when maintenance
+        catches up."""
+        from keto_tpu.driver.health import READY_STATES, HealthState
+
+        state, reason = self.registry.health_monitor().status()
+        if state not in READY_STATES:
+            body = {"status": "unavailable", "reason": reason or state.value}
+            return 503, body, {}
+        if state is HealthState.SERVING:
+            return 200, {"status": "ok"}, {}
+        body = {"status": state.value}
+        if reason:
+            body["reason"] = reason
+        return 200, body, {}
+
     # -- read ----------------------------------------------------------------
 
-    def _check(self, tuple_: RelationTuple, query):
+    @staticmethod
+    def _deadline_from(query, headers) -> Optional[float]:
+        """Request deadline as absolute ``time.monotonic()`` seconds, from
+        ``X-Request-Timeout-Ms`` / ``?timeout_ms=`` (whichever is
+        present; malformed values are a 400, not a silent default)."""
+        raw = (query.get("timeout_ms") or [""])[0]
+        if not raw and headers:
+            raw = headers.get("x-request-timeout-ms", "")
+        if not raw:
+            return None
+        try:
+            ms = float(raw)
+        except ValueError:
+            raise ErrBadRequest(f"invalid timeout_ms {raw!r}") from None
+        if ms <= 0:
+            raise ErrBadRequest(f"timeout_ms must be > 0, got {raw!r}")
+        return time.monotonic() + ms / 1e3
+
+    def _check(self, tuple_: RelationTuple, query, headers=None):
         # per-request consistency (the REST face of the gRPC
         # snaptoken/latest fields): ?snaptoken=<token from a write or a
         # previous check> serves at-least-that-fresh; ?latest=true forces
@@ -114,24 +184,25 @@ class RestApp:
                 raise ErrBadRequest(f"malformed snaptoken {raw_token!r}") from None
         latest = (query.get("latest") or [""])[0].lower() in ("1", "true")
         allowed, token = self.registry.check_batcher().check_with_token(
-            tuple_, at_least=at_least, latest=latest
+            tuple_, at_least=at_least, latest=latest,
+            deadline=self._deadline_from(query, headers),
         )
-        headers = {} if token is None else {"X-Keto-Snaptoken": str(token)}
-        return (200 if allowed else 403), {"allowed": allowed}, headers
+        resp_headers = {} if token is None else {"X-Keto-Snaptoken": str(token)}
+        return (200 if allowed else 403), {"allowed": allowed}, resp_headers
 
-    def _get_check(self, query):
+    def _get_check(self, query, headers=None):
         try:
             tuple_ = RelationTuple.from_url_query(query)
         except ErrNilSubject:
             raise ErrBadRequest("Subject has to be specified.") from None
-        return self._check(tuple_, query)
+        return self._check(tuple_, query, headers)
 
-    def _post_check(self, body: bytes, query):
+    def _post_check(self, body: bytes, query, headers=None):
         try:
             obj = json.loads(body or b"{}")
         except json.JSONDecodeError as e:
             raise ErrBadRequest(f"Unable to decode JSON payload: {e}") from None
-        return self._check(RelationTuple.from_json(obj), query)
+        return self._check(RelationTuple.from_json(obj), query, headers)
 
     def _get_expand(self, query):
         # the reference parses max-depth unconditionally — absent/invalid
@@ -225,7 +296,10 @@ def _make_handler(app: RestApp):
             query = parse_qs(parts.query, keep_blank_values=True)
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
-            status, payload, headers = app.handle(method, parts.path, query, body)
+            req_headers = {k.lower(): v for k, v in self.headers.items()}
+            status, payload, headers = app.handle(
+                method, parts.path, query, body, req_headers
+            )
             data = b"" if payload is None else json.dumps(payload).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")

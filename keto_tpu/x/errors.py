@@ -61,6 +61,41 @@ class ErrInternalServerError(KetoError):
     grpc_code = 13  # INTERNAL
 
 
+class ErrDeadlineExceeded(KetoError, TimeoutError):
+    """A request's deadline expired before (or while) it was served —
+    REST 504 / gRPC DEADLINE_EXCEEDED. Subclasses TimeoutError so callers
+    treating the batcher as a plain future API keep working."""
+
+    status_code = 504
+    grpc_code = 4  # DEADLINE_EXCEEDED
+
+    def __init__(self, message: str = "request deadline exceeded", **kw):
+        super().__init__(message, **kw)
+
+
+class ErrTooManyRequests(KetoError):
+    """Load shed: the check queue is at capacity and the server refuses
+    new work instead of growing an unbounded backlog — REST 429 / gRPC
+    RESOURCE_EXHAUSTED."""
+
+    status_code = 429
+    grpc_code = 8  # RESOURCE_EXHAUSTED
+
+    def __init__(self, message: str = "server overloaded, retry later", **kw):
+        super().__init__(message, **kw)
+
+
+class ErrServiceUnavailable(KetoError):
+    """The serving core is not ready (snapshot beyond its staleness
+    budget, maintenance dead) — REST 503 / gRPC UNAVAILABLE."""
+
+    status_code = 503
+    grpc_code = 14  # UNAVAILABLE
+
+    def __init__(self, message: str = "service not ready", **kw):
+        super().__init__(message, **kw)
+
+
 class ErrMalformedInput(ErrBadRequest):
     """Reference internal/relationtuple/definitions.go:123."""
 

@@ -1,0 +1,153 @@
+"""Fault-injection harness: named injection points, off by default.
+
+The fault-tolerant serving core (supervised maintenance, health state
+machine, CPU degraded mode) is only trustworthy if its failure paths are
+*testable*: this module gives the maintenance and device paths named
+injection points that raise or delay when armed, and cost one module-bool
+read when not. The canonical points:
+
+- ``refresh-read``  — persistence reads during snapshot refresh
+- ``device-exec``   — device dispatch of a check slice
+- ``cache-save``    — background snapshot-cache serialization
+- ``compaction``    — overlay compaction
+- ``check-dispatch``— the check batcher's collector, before dispatch
+
+Arming is programmatic (``inject`` / the ``injected`` context manager,
+used by tests/test_faults.py) or environmental: ``KETO_TPU_FAULTS`` is a
+comma list of ``point:raise``, ``point:raise:<count>``, or
+``point:delay=<seconds>`` specs parsed at import (and re-parseable via
+``load_env`` for tests). The hot-path contract: sites guard with the
+module-level ``ACTIVE`` flag, so an unarmed build pays a single attribute
+load per instrumented call — and every instrumented site is per-batch or
+per-maintenance-pass, never per-query.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Optional
+
+#: canonical point names (informational — arbitrary names are accepted,
+#: so tests can instrument new seams without editing this module)
+POINTS = (
+    "refresh-read",
+    "device-exec",
+    "cache-save",
+    "compaction",
+    "check-dispatch",
+)
+
+#: fast gate: False ⇔ no fault armed anywhere. Instrumented sites read
+#: this once per call and skip the locked dict entirely when clear.
+ACTIVE = False
+
+_lock = threading.Lock()
+_faults: dict[str, "_Fault"] = {}
+_hits: dict[str, int] = {}
+
+
+class FaultInjected(RuntimeError):
+    """Raised at an armed injection point."""
+
+
+class _Fault:
+    __slots__ = ("exc", "delay_s", "remaining")
+
+    def __init__(self, exc, delay_s: float, remaining: Optional[int]):
+        self.exc = exc
+        self.delay_s = delay_s
+        self.remaining = remaining  # None = until cleared
+
+
+def inject(
+    point: str,
+    *,
+    exc=FaultInjected,
+    delay_s: float = 0.0,
+    count: Optional[int] = None,
+) -> None:
+    """Arm ``point``: the next ``count`` passes (None = every pass until
+    ``clear``) sleep ``delay_s`` then raise ``exc(point)`` (pass
+    ``exc=None`` for a delay-only fault)."""
+    global ACTIVE
+    with _lock:
+        _faults[point] = _Fault(exc, delay_s, count)
+        ACTIVE = True
+
+
+def clear(point: Optional[str] = None) -> None:
+    """Disarm one point, or every point when ``point`` is None."""
+    global ACTIVE
+    with _lock:
+        if point is None:
+            _faults.clear()
+        else:
+            _faults.pop(point, None)
+        ACTIVE = bool(_faults)
+
+
+def hits(point: str) -> int:
+    """How many times ``point`` fired while armed (survives ``clear``)."""
+    with _lock:
+        return _hits.get(point, 0)
+
+
+def reset_hits() -> None:
+    with _lock:
+        _hits.clear()
+
+
+@contextlib.contextmanager
+def injected(point: str, **kw):
+    """``inject(point, **kw)`` for the duration of the block."""
+    inject(point, **kw)
+    try:
+        yield
+    finally:
+        clear(point)
+
+
+def check(point: str) -> None:
+    """The instrumented-site call: no-op unless ``point`` is armed."""
+    if not ACTIVE:
+        return
+    with _lock:
+        f = _faults.get(point)
+        if f is None:
+            return
+        if f.remaining is not None:
+            if f.remaining <= 0:
+                return
+            f.remaining -= 1
+        _hits[point] = _hits.get(point, 0) + 1
+        exc, delay_s = f.exc, f.delay_s
+    if delay_s:
+        time.sleep(delay_s)
+    if exc is not None:
+        raise exc(point)
+
+
+def load_env(spec: Optional[str] = None) -> None:
+    """Parse a ``KETO_TPU_FAULTS`` spec (default: the live env var) into
+    armed faults. Unknown/malformed entries are ignored — a typo'd env
+    var must never take a serving process down."""
+    spec = os.environ.get("KETO_TPU_FAULTS", "") if spec is None else spec
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry or ":" not in entry:
+            continue
+        point, _, action = entry.partition(":")
+        kind, _, arg = action.partition(":")
+        try:
+            if kind == "raise":
+                inject(point, count=int(arg) if arg else None)
+            elif kind.startswith("delay="):
+                inject(point, exc=None, delay_s=float(kind[6:]))
+        except ValueError:
+            continue
+
+
+load_env()

@@ -1,0 +1,139 @@
+"""Supervised maintenance workers: crash containment for background threads.
+
+The engine's snapshot refresh, compaction, and cache-save used to run on
+ad-hoc one-shot threads — an exception killed the thread silently and the
+process served an ever-staler snapshot with no counter, no log line, and
+no retry. A ``SupervisedTask`` is the replacement: one persistent daemon
+thread per maintenance concern that
+
+- waits for ``kick()`` (event-driven, no polling while idle),
+- runs its target with crashes **contained**: the exception is logged,
+  counted into a MaintenanceStats-shaped sink (``<name>_failures``), and
+  the pass is retried with jittered exponential backoff
+  (keto_tpu/x/retry.Backoff) until it succeeds or the task is stopped,
+- exposes the liveness/crash surface the health state machine reads
+  (keto_tpu/driver/health.py): ``alive()``, ``crashes``, ``last_error``,
+  ``consecutive_failures``.
+
+Targets take no arguments: callers keep their pending-work state (e.g.
+"next refresh must be a full compaction") in their own fields and merge it
+under their own locks, so a kick during a running pass coalesces into
+exactly one follow-up pass.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from keto_tpu.x.retry import Backoff
+
+_log = logging.getLogger("keto_tpu.supervise")
+
+
+class SupervisedTask:
+    def __init__(
+        self,
+        name: str,
+        target: Callable[[], None],
+        *,
+        stats=None,
+        base_backoff_s: float = 0.05,
+        max_backoff_s: float = 2.0,
+    ):
+        """``stats`` is anything with ``incr(key)`` (x/telemetry
+        MaintenanceStats); failures count under ``<name>_failures`` with
+        ``name``'s dashes normalized to underscores."""
+        self.name = name
+        self._target = target
+        self._stats = stats
+        self._counter_key = name.replace("-", "_") + "_failures"
+        self._kick = threading.Event()
+        self._stop = threading.Event()
+        self._backoff = Backoff(base_s=base_backoff_s, max_s=max_backoff_s)
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._retry_at: Optional[float] = None
+        self.crashes = 0
+        self.consecutive_failures = 0
+        self.last_error: Optional[str] = None
+        self.last_success_t: Optional[float] = None
+        self.heartbeat_t: Optional[float] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name=f"keto-tpu-{self.name}", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._kick.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+
+    def kick(self) -> None:
+        """Request one maintenance pass (starts the worker on first use);
+        kicks during a running pass coalesce into one follow-up pass."""
+        self.start()
+        self._kick.set()
+
+    # -- introspection (the health monitor's read surface) -------------------
+
+    def started(self) -> bool:
+        return self._thread is not None
+
+    def alive(self) -> bool:
+        """True when the worker can still make progress: running, or never
+        needed yet. False means the supervisor thread itself died — the
+        one state backoff cannot recover from."""
+        t = self._thread
+        return True if t is None else t.is_alive()
+
+    # -- worker --------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            timeout = None
+            if self._retry_at is not None:
+                timeout = max(0.0, self._retry_at - time.monotonic())
+            kicked = self._kick.wait(timeout=timeout)
+            if self._stop.is_set():
+                return
+            if not kicked and (
+                self._retry_at is None or time.monotonic() < self._retry_at
+            ):
+                continue
+            # clear BEFORE running: a kick that lands mid-pass schedules
+            # exactly one more pass instead of being lost
+            self._kick.clear()
+            self._retry_at = None
+            self.heartbeat_t = time.monotonic()
+            try:
+                self._target()
+            except Exception as e:
+                self.crashes += 1
+                self.consecutive_failures += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+                if self._stats is not None:
+                    self._stats.incr(self._counter_key)
+                delay = self._backoff.next()
+                self._retry_at = time.monotonic() + delay
+                _log.warning(
+                    "%s maintenance pass failed (crash #%d, retry in %.2fs)",
+                    self.name, self.crashes, delay, exc_info=True,
+                )
+            else:
+                self.consecutive_failures = 0
+                self.last_error = None
+                self.last_success_t = time.monotonic()
+                self._backoff.reset()

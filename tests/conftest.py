@@ -12,6 +12,16 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# CI hang diagnosis: with KETO_TEST_HANG_DUMP_S set, every thread's stack
+# dumps to stderr that many seconds in (repeating), so a wedged supervisor
+# or a deadlocked refresh shows up in the job log instead of as a silent
+# runner-level timeout kill.
+_hang_dump_s = os.environ.get("KETO_TEST_HANG_DUMP_S")
+if _hang_dump_s:
+    import faulthandler
+
+    faulthandler.dump_traceback_later(float(_hang_dump_s), repeat=True)
+
 import jax
 
 # force CPU even when the ambient environment pins JAX_PLATFORMS / a

@@ -1,0 +1,510 @@
+"""Fault-tolerant serving core, proven under injected faults.
+
+Every scenario here drives a REAL failure path through the named
+injection points in keto_tpu/x/faults.py:
+
+- killing the refresh path keeps checks answering from the last
+  snapshot, flips health SERVING → NOT_SERVING once the staleness budget
+  is exceeded (REST 503 + reason, gRPC NOT_SERVING, Watch transition),
+  and recovers automatically when the fault clears;
+- a failing device path falls back to the CPU reference engine with
+  bit-identical decisions on a randomized corpus, enters DEGRADED mode,
+  and heals on the next successful probe;
+- expired deadlines shed with 504/DEADLINE_EXCEEDED without ever
+  occupying a device slice; a full check queue sheds with 429;
+- cache-save and compaction faults are counted, logged, retried — never
+  silent, never fatal to serving.
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import grpc
+import pytest
+from grpchealth.v1 import health_pb2
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.check.engine import CheckEngine
+from keto_tpu.check.tpu_engine import TpuCheckEngine
+from keto_tpu.config.provider import Config
+from keto_tpu.driver.batch import CheckBatcher
+from keto_tpu.driver.daemon import Daemon
+from keto_tpu.driver.health import HealthMonitor, HealthState
+from keto_tpu.driver.registry import Registry
+from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+from keto_tpu.x import faults
+from keto_tpu.x.errors import ErrDeadlineExceeded, ErrTooManyRequests
+
+
+def T(ns, obj, rel, sub):
+    return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset_hits()
+    yield
+    faults.clear()
+
+
+def wait_for(cond, timeout=10.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- supervised refresh + health state machine -------------------------------
+
+
+def test_refresh_fault_serves_stale_then_health_flips_and_recovers(make_persister):
+    p = make_persister([("docs", 0), ("groups", 1)])
+    p.write_relation_tuples(T("docs", "readme", "view", SubjectID("alice")))
+    engine = TpuCheckEngine(
+        p, p.namespaces, refresh_retry_max_wait_s=0.1, degraded_probe_s=0.1
+    )
+    monitor = HealthMonitor(engine, staleness_budget_s=1.0)
+    try:
+        assert engine.batch_check([T("docs", "readme", "view", SubjectID("alice"))]) == [True]
+        assert monitor.status()[0] is HealthState.SERVING
+
+        faults.inject("refresh-read")
+        p.write_relation_tuples(T("docs", "readme", "view", SubjectID("bob")))
+
+        # the engine keeps answering from the last snapshot (serving mode
+        # never stalls and never fails on refresh trouble)
+        assert engine.batch_check(
+            [
+                T("docs", "readme", "view", SubjectID("alice")),
+                T("docs", "readme", "view", SubjectID("bob")),
+            ],
+            mode="serving",
+        ) == [True, False]
+
+        # staleness crosses the budget -> NOT_SERVING, with the refresh
+        # crash surfaced in the reason
+        wait_for(
+            lambda: monitor.status()[0] is HealthState.NOT_SERVING,
+            timeout=6.0, msg="NOT_SERVING within the staleness budget",
+        )
+        state, reason = monitor.status()
+        assert "behind" in reason
+        stats = engine.maintenance.snapshot()
+        assert stats.get("refresh_failures", 0) >= 1
+        assert faults.hits("refresh-read") >= 1
+
+        # serving continued throughout
+        assert engine.batch_check(
+            [T("docs", "readme", "view", SubjectID("alice"))], mode="serving"
+        ) == [True]
+
+        # fault clears -> the supervised worker's backoff retry catches
+        # up and health transitions back without outside help
+        faults.clear("refresh-read")
+        wait_for(
+            lambda: monitor.status()[0] is HealthState.SERVING,
+            timeout=10.0, msg="SERVING after the fault cleared",
+        )
+        wait_for(
+            lambda: engine.batch_check(
+                [T("docs", "readme", "view", SubjectID("bob"))], mode="serving"
+            ) == [True],
+            timeout=10.0, msg="refreshed snapshot serving the new write",
+        )
+    finally:
+        engine.close()
+
+
+def test_refresh_fault_flips_rest_and_grpc_health_end_to_end():
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "files"}],
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "serve.staleness_budget_s": 1.0,
+        }
+    )
+    d = Daemon(Registry(cfg))
+    d.serve_all(block=False)
+    channel = grpc.insecure_channel(f"127.0.0.1:{d.read_port}")
+    health_check = channel.unary_unary(
+        "/grpc.health.v1.Health/Check",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=health_pb2.HealthCheckResponse.FromString,
+    )
+    watch_statuses: list[int] = []
+    watch_call = channel.unary_stream(
+        "/grpc.health.v1.Health/Watch",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=health_pb2.HealthCheckResponse.FromString,
+    )(health_pb2.HealthCheckRequest())
+
+    def drain_watch():
+        try:
+            for resp in watch_call:
+                watch_statuses.append(resp.status)
+        except grpc.RpcError:
+            pass  # stream cancelled at teardown
+
+    watcher = threading.Thread(target=drain_watch, daemon=True)
+    watcher.start()
+
+    def ready():
+        req = urllib.request.Request(f"http://127.0.0.1:{d.read_port}/health/ready")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def put(obj, sub):
+        body = json.dumps(
+            {"namespace": "files", "object": obj, "relation": "view", "subject_id": sub}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{d.write_port}/relation-tuples", data=body, method="PUT"
+        )
+        urllib.request.urlopen(req, timeout=5).read()
+
+    def check(sub):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{d.read_port}/check?namespace=files&object=f&relation=view&subject_id={sub}",
+                timeout=10,
+            ) as resp:
+                return resp.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    try:
+        put("f", "alice")
+        assert check("alice") == 200  # builds the first snapshot
+        wait_for(lambda: ready() == (200, {"status": "ok"}), msg="ready at boot")
+        assert health_check(health_pb2.HealthCheckRequest()).status == (
+            health_pb2.HealthCheckResponse.SERVING
+        )
+
+        faults.inject("refresh-read")
+        put("f", "bob")  # watermark moves; refresh can no longer follow
+
+        # the read plane keeps serving from the stale snapshot
+        assert check("alice") == 200
+        wait_for(lambda: ready()[0] == 503, timeout=8.0, msg="/health/ready -> 503")
+        status, body = ready()
+        assert body["status"] == "unavailable" and "behind" in body["reason"]
+        assert health_check(health_pb2.HealthCheckRequest()).status == (
+            health_pb2.HealthCheckResponse.NOT_SERVING
+        )
+
+        faults.clear("refresh-read")
+        wait_for(lambda: ready()[0] == 200, timeout=10.0, msg="ready again")
+        assert health_check(health_pb2.HealthCheckRequest()).status == (
+            health_pb2.HealthCheckResponse.SERVING
+        )
+        wait_for(lambda: check("bob") == 200, timeout=10.0, msg="new write served")
+
+        # the Watch stream saw the full round trip
+        wait_for(
+            lambda: watch_statuses[:3] == [
+                health_pb2.HealthCheckResponse.SERVING,
+                health_pb2.HealthCheckResponse.NOT_SERVING,
+                health_pb2.HealthCheckResponse.SERVING,
+            ],
+            timeout=5.0, msg="Watch transitions SERVING -> NOT_SERVING -> SERVING",
+        )
+    finally:
+        watch_call.cancel()
+        watcher.join(timeout=5)
+        channel.close()
+        d.shutdown()
+
+
+# -- degraded mode: CPU fallback bit-parity ----------------------------------
+
+
+def _random_store_and_queries(make_persister, seed, n_tuples=80, n_queries=96):
+    rng = random.Random(seed)
+    namespaces = [("ns0", 0), ("ns1", 1), ("", 3)]
+    p = make_persister(namespaces)
+    ns_names = [n for n, _ in namespaces]
+    objects = [f"o{i}" for i in range(6)]
+    relations = ["r0", "r1", ""]
+    users = [f"u{i}" for i in range(5)]
+
+    def rand_set():
+        return SubjectSet(rng.choice(ns_names), rng.choice(objects), rng.choice(relations))
+
+    tuples = []
+    for _ in range(rng.randrange(n_tuples // 2, n_tuples)):
+        sub = SubjectID(rng.choice(users)) if rng.random() < 0.4 else rand_set()
+        tuples.append(T(rng.choice(ns_names), rng.choice(objects), rng.choice(relations), sub))
+    p.write_relation_tuples(*tuples)
+
+    queries = []
+    for _ in range(n_queries):
+        sub = SubjectID(rng.choice(users + ["ghost"])) if rng.random() < 0.5 else rand_set()
+        queries.append(
+            T(rng.choice(ns_names + ["nope"]), rng.choice(objects), rng.choice(relations), sub)
+        )
+    return p, queries
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_device_fault_cpu_fallback_bit_identical(make_persister, seed):
+    p, queries = _random_store_and_queries(make_persister, seed)
+    engine = TpuCheckEngine(p, p.namespaces, degraded_probe_s=0.2)
+    try:
+        baseline, base_token = engine.batch_check_with_token(queries, mode="latest")
+        oracle = CheckEngine(p)
+        assert baseline == [oracle.subject_is_allowed(q) for q in queries]
+
+        faults.inject("device-exec")
+        # first failing batch falls back inline (transparent to callers)
+        got, token = engine.batch_check_with_token(queries, mode="latest")
+        assert got == baseline, f"CPU fallback diverged from device decisions (seed={seed})"
+        assert token == p.watermark()
+        # repeated failures cross the threshold into DEGRADED mode
+        for _ in range(3):
+            assert engine.batch_check(queries) == baseline
+        assert engine.health()["degraded"] is True
+        assert engine.maintenance.snapshot()["device_errors"] >= 3
+        # degraded-mode dispatch goes straight to the fallback (the armed
+        # fault no longer fires because the device path isn't tried)
+        hits_before = faults.hits("device-exec")
+        assert engine.batch_check(queries) == baseline
+        assert faults.hits("device-exec") == hits_before
+
+        # fault clears -> the periodic probe re-runs the device path and
+        # recovery is automatic
+        faults.clear("device-exec")
+        time.sleep(0.25)  # past degraded_probe_s
+        assert engine.batch_check(queries) == baseline
+        assert engine.health()["degraded"] is False
+    finally:
+        engine.close()
+
+
+def test_device_fault_stream_path_recovers_through_batcher(make_persister):
+    p, queries = _random_store_and_queries(make_persister, seed=7)
+    engine = TpuCheckEngine(p, p.namespaces, degraded_probe_s=0.2)
+    baseline = engine.batch_check(queries)
+    b = CheckBatcher(engine, batch_size=32, window_ms=2.0)
+    b.start()
+    try:
+        faults.inject("device-exec")
+        # the streaming dispatch fails mid-flight; the batcher retries the
+        # unresolved futures through the engine's recovery path, which
+        # lands on the CPU fallback — callers never see the fault
+        got = [b.check(q, timeout=30.0) for q in queries[:16]]
+        assert got == baseline[:16]
+        faults.clear("device-exec")
+    finally:
+        b.stop()
+        engine.close()
+
+
+# -- deadline propagation + load shedding ------------------------------------
+
+
+class _RecordingEngine:
+    def __init__(self):
+        self.seen = []
+
+    def batch_check_with_token(self, tuples, **kw):
+        self.seen.extend(tuples)
+        return [False] * len(tuples), 1
+
+
+def test_expired_deadline_sheds_before_dispatch():
+    eng = _RecordingEngine()
+    b = CheckBatcher(eng, batch_size=8, window_ms=60.0)
+    b.start()
+    q = T("ns", "o", "r", SubjectID("u"))
+    try:
+        # expires while the collector's coalescing window is open -> shed
+        # at dispatch, never reaches the engine
+        with pytest.raises(ErrDeadlineExceeded):
+            b.check(q, timeout=None, deadline=time.monotonic() + 0.01)
+        # the caller hears 504 the moment its deadline passes; the
+        # collector drops the request at dispatch shortly after
+        wait_for(lambda: b.deadline_drop_count == 1, msg="dispatch-time drop")
+        assert eng.seen == []
+        # an already-expired deadline is refused before it is even queued
+        with pytest.raises(ErrDeadlineExceeded):
+            b.check(q, deadline=time.monotonic() - 1.0)
+        # live requests still flow
+        assert b.check(q, timeout=5.0) is False
+        assert len(eng.seen) == 1
+    finally:
+        b.stop()
+
+
+def test_queue_full_sheds_429():
+    release = threading.Event()
+    entered = threading.Event()
+
+    class BlockedEngine:
+        def batch_check(self, tuples):
+            entered.set()
+            release.wait(10)
+            return [False] * len(tuples)
+
+    b = CheckBatcher(
+        BlockedEngine(), batch_size=1, window_ms=0.0, max_pending=1, shed_on_full=True
+    )
+    b.start()
+    q = T("ns", "o", "r", SubjectID("u"))
+    def quiet_check():
+        try:
+            b.check(q, timeout=10)
+        except Exception:
+            pass  # stop() fails leftovers at teardown — irrelevant here
+
+    try:
+        first = threading.Thread(target=quiet_check, daemon=True)
+        first.start()
+        assert entered.wait(5)  # collector is inside the engine
+        # one slot in the queue, then the door closes with 429
+        filler = threading.Thread(target=quiet_check, daemon=True)
+        filler.start()
+        wait_for(lambda: b._queue.full(), timeout=5.0, msg="queue full")
+        with pytest.raises(ErrTooManyRequests):
+            b.check(q, timeout=10)
+        assert b.shed_count == 1
+    finally:
+        release.set()
+        b.stop()
+
+
+def test_rest_deadline_and_grpc_deadline_codes():
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "files"}],
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+        }
+    )
+    d = Daemon(Registry(cfg))
+    d.serve_all(block=False)
+    try:
+        url = (
+            f"http://127.0.0.1:{d.read_port}/check?namespace=files&object=f"
+            f"&relation=view&subject_id=alice"
+        )
+        # warm once so the 504 below is a deadline shed, not a slow build
+        try:
+            urllib.request.urlopen(url, timeout=10)
+        except urllib.error.HTTPError:
+            pass
+        req = urllib.request.Request(url, headers={"X-Request-Timeout-Ms": "0.001"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 504
+        assert json.loads(e.value.read())["error"]["code"] == 504
+
+        from ory.keto.acl.v1alpha1 import acl_pb2, check_service_pb2
+
+        channel = grpc.insecure_channel(f"127.0.0.1:{d.read_port}")
+        stub = channel.unary_unary(
+            "/ory.keto.acl.v1alpha1.CheckService/Check",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=check_service_pb2.CheckResponse.FromString,
+        )
+        with pytest.raises(grpc.RpcError) as rpc_e:
+            stub(
+                check_service_pb2.CheckRequest(
+                    namespace="files", object="f", relation="view",
+                    subject=acl_pb2.Subject(id="alice"),
+                ),
+                timeout=0.0005,
+            )
+        assert rpc_e.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        channel.close()
+    finally:
+        d.shutdown()
+
+
+# -- maintenance faults: counted, retried, never fatal -----------------------
+
+
+def test_cache_save_fault_is_counted_and_retried(make_persister, tmp_path):
+    p = make_persister([("docs", 0)])
+    p.write_relation_tuples(T("docs", "readme", "view", SubjectID("alice")))
+    faults.inject("cache-save")
+    engine = TpuCheckEngine(p, p.namespaces, snapshot_cache_dir=str(tmp_path))
+    try:
+        assert engine.batch_check([T("docs", "readme", "view", SubjectID("alice"))]) == [True]
+        wait_for(
+            lambda: engine.maintenance.snapshot().get("cache_save_failures", 0) >= 1,
+            timeout=8.0, msg="cache_save_failures counted",
+        )
+        # serving is unaffected by the failing cache path
+        assert engine.batch_check([T("docs", "readme", "view", SubjectID("alice"))]) == [True]
+        assert not list(tmp_path.iterdir())
+
+        faults.clear("cache-save")
+        # the supervised worker's backoff retry eventually lands the save
+        wait_for(
+            lambda: list(tmp_path.iterdir()),
+            timeout=10.0, msg="snapshot cache written after the fault cleared",
+        )
+    finally:
+        engine.close()
+
+
+def test_compaction_fault_falls_back_to_rebuild(make_persister):
+    p = make_persister([("docs", 0)])
+    p.write_relation_tuples(T("docs", "readme", "view", SubjectID("alice")))
+    engine = TpuCheckEngine(p, p.namespaces, overlay_edge_budget=2)
+    try:
+        assert engine.batch_check([T("docs", "readme", "view", SubjectID("alice"))]) == [True]
+        faults.inject("compaction")
+        # push the overlay past its budget: compaction is attempted,
+        # raises, and the refresh falls back to a full rebuild instead of
+        # dying — decisions stay correct
+        p.write_relation_tuples(
+            *[T("docs", f"doc{i}", "view", SubjectID("bob")) for i in range(8)]
+        )
+        assert engine.batch_check(
+            [
+                T("docs", "doc3", "view", SubjectID("bob")),
+                T("docs", "readme", "view", SubjectID("alice")),
+                T("docs", "doc3", "view", SubjectID("alice")),
+            ]
+        ) == [True, True, False]
+        stats = engine.maintenance.snapshot()
+        assert stats.get("compaction_failures", 0) >= 1
+        assert stats.get("full_rebuilds", 0) >= 2
+    finally:
+        engine.close()
+
+
+# -- harness plumbing --------------------------------------------------------
+
+
+def test_env_trigger_parsing():
+    faults.load_env("refresh-read:raise:2, device-exec:delay=0.01 ,bogus,oops:wat,:")
+    with pytest.raises(faults.FaultInjected):
+        faults.check("refresh-read")
+    with pytest.raises(faults.FaultInjected):
+        faults.check("refresh-read")
+    faults.check("refresh-read")  # count exhausted
+    t0 = time.monotonic()
+    faults.check("device-exec")  # delay-only: no raise
+    assert time.monotonic() - t0 >= 0.01
+    faults.check("bogus")  # malformed entries were ignored
+
+
+def test_inactive_harness_is_free():
+    faults.clear()
+    assert faults.ACTIVE is False
+    faults.check("refresh-read")  # no-op, no raise

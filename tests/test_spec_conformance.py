@@ -185,6 +185,74 @@ def test_health_and_version_conform(daemon):
     _validate("/version", "GET", status, body)
 
 
+def test_health_ready_not_ready_conforms(daemon):
+    """The 503 not-ready response (operator drain via the health
+    monitor's override seam) validates against the spec, and readiness
+    returns once the override lifts."""
+    from keto_tpu.driver.health import HealthState
+
+    monitor = daemon.registry.health_monitor()
+    monitor.set_override(HealthState.NOT_SERVING, "drained for the conformance suite")
+    try:
+        status, body = _request(daemon.read_port, "GET", "/health/ready")
+        assert status == 503
+        _validate("/health/ready", "GET", status, body)
+        assert body["reason"]
+    finally:
+        monitor.set_override(None)
+    status, body = _request(daemon.read_port, "GET", "/health/ready")
+    assert status == 200
+    _validate("/health/ready", "GET", status, body)
+
+
+def test_check_shed_responses_conform(daemon):
+    """The 429 (queue full) and 504 (deadline expired) shed responses
+    validate against the spec's genericError envelope — raised through
+    the real error taxonomy, forced deterministically at the batcher
+    seam."""
+    from keto_tpu.x.errors import ErrDeadlineExceeded, ErrTooManyRequests
+
+    batcher = daemon.registry.check_batcher()
+    orig = batcher.check_with_token
+    query = {
+        "namespace": "files", "object": "readme", "relation": "view",
+        "subject_id": "deb",
+    }
+
+    def raiser(exc):
+        def fn(*a, **k):
+            raise exc
+
+        return fn
+
+    try:
+        batcher.check_with_token = raiser(ErrTooManyRequests())
+        status, body = _request(daemon.read_port, "GET", "/check", query=query)
+        assert status == 429
+        _validate("/check", "GET", status, body)
+
+        batcher.check_with_token = raiser(ErrDeadlineExceeded())
+        status, body = _request(daemon.read_port, "GET", "/check", query=query)
+        assert status == 504
+        _validate("/check", "GET", status, body)
+    finally:
+        batcher.check_with_token = orig
+    status, body = _request(daemon.read_port, "GET", "/check", query=query)
+    assert status == 200
+
+
+def test_expired_deadline_conforms_end_to_end(daemon):
+    """A real (not patched) sub-millisecond deadline expires in the
+    batcher queue and surfaces as the declared 504."""
+    query = {
+        "namespace": "files", "object": "readme", "relation": "view",
+        "subject_id": "deb", "timeout_ms": "0.001",
+    }
+    status, body = _request(daemon.read_port, "GET", "/check", query=query)
+    assert status == 504
+    _validate("/check", "GET", status, body)
+
+
 def test_spec_definitions_are_valid_schemas():
     """Every definition must itself be a valid draft-4 schema (catches
     spec edits that silently disable validation)."""
