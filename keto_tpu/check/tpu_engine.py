@@ -1145,6 +1145,7 @@ class TpuCheckEngine:
         # on bursts compaction absorbs in milliseconds.
         if n_ov > max(4 * self._max_overlay_edges, 65536):
             return None
+        faults.check("overlay-apply")
         return apply_delta(base, ops, new_wm, wild_ns_ids)
 
     def _compact_locked(self, snap: GraphSnapshot) -> Optional[GraphSnapshot]:
@@ -1197,7 +1198,9 @@ class TpuCheckEngine:
         # retry through the shared backoff before cold start falls back
         # to the full ingest+build path
         snap = retry_call(
-            lambda: snapcache.load_latest(self._cache_dir, max_watermark=store_wm),
+            lambda: snapcache.load_latest(
+                self._cache_dir, max_watermark=store_wm, stats=self.maintenance
+            ),
             max_wait_s=2.0,
             base_s=0.05,
             max_s=0.5,

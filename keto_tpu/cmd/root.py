@@ -63,7 +63,12 @@ def serve(config):
     cfg = Config(config_file=config)
     profiling.attach(cfg.get("profiling", ""))  # reference main.go:25-28
     registry = Registry(cfg)
-    Daemon(registry).serve_all(block=True)
+    daemon = Daemon(registry)
+    # SIGTERM/SIGINT → drain in-flight requests (serve.drain_timeout_s)
+    # behind a NOT_SERVING readiness flip, then exit — rolling restarts
+    # drop zero accepted requests
+    daemon.install_signal_handlers()
+    daemon.serve_all(block=True)
 
 
 # -- check / expand ----------------------------------------------------------

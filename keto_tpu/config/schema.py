@@ -85,6 +85,16 @@ CONFIG_SCHEMA = {
                     "default": True,
                     "description": "Load shedding: answer 429 / RESOURCE_EXHAUSTED immediately when the check queue is at capacity, instead of blocking callers into their own timeouts. Expired request deadlines (gRPC deadline, X-Request-Timeout-Ms) always shed with 504 / DEADLINE_EXCEEDED before packing.",
                 },
+                "idempotency_ttl_s": {
+                    "type": "number",
+                    "default": 86400.0,
+                    "description": "Idempotent writes: how long (seconds) an X-Idempotency-Key / x-idempotency-key binding dedups retries of the same transaction. Within the TTL a retried key re-applies nothing and replays the original snaptoken (X-Keto-Idempotent-Replay: true); past it the key is garbage-collected from the durable dedup table and a resend applies as a fresh write. Size it to your clients' worst-case retry horizon.",
+                },
+                "drain_timeout_s": {
+                    "type": "number",
+                    "default": 5.0,
+                    "description": "Graceful shutdown: after SIGTERM/SIGINT the daemon pins readiness to NOT_SERVING (new traffic routes away) and waits up to this many seconds for in-flight checks to resolve before tearing the servers down — the zero-dropped-requests half of a rolling restart.",
+                },
             },
         },
         "namespaces": {

@@ -66,6 +66,12 @@ class CheckBatcher:
         self.deadline_drop_count = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # in-flight accounting for graceful drain: accepted requests whose
+        # futures have not resolved yet (queued OR dispatched)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -165,6 +171,10 @@ class CheckBatcher:
                 self._queue.put(item, timeout=block)
             except queue.Full:
                 raise TimeoutError("check queue full (device backlogged)") from None
+        with self._inflight_lock:
+            self._inflight += 1
+            self._idle.clear()
+        fut.add_done_callback(self._note_done)
         if self._stop.is_set() and not fut.done():
             # raced with stop()'s drain: nobody will serve the queue
             # anymore — unless the collector's final batch got there first
@@ -185,6 +195,27 @@ class CheckBatcher:
     def check_batch(self, tuples: Sequence[RelationTuple]) -> list[bool]:
         """Pre-batched requests skip the queue entirely."""
         return self._engine.batch_check(list(tuples))
+
+    # -- graceful drain ------------------------------------------------------
+
+    def _note_done(self, _fut) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._idle.set()
+
+    @property
+    def inflight(self) -> int:
+        """Accepted check requests whose futures have not resolved yet."""
+        with self._inflight_lock:
+            return self._inflight
+
+    def drain(self, timeout_s: float) -> bool:
+        """Wait until every in-flight request has been answered (the
+        SIGTERM drain seam: new traffic is already shed by the health
+        override before this runs). True when the batcher went idle
+        within ``timeout_s``."""
+        return self._idle.wait(timeout=max(0.0, timeout_s))
 
     @staticmethod
     def _consistency_kw(at_leasts, latests) -> dict:

@@ -6,11 +6,19 @@ check/expand/list over both protocols, the write API (default :4467) serves
 tuple mutations, and each public port is a sniffing mux in front of loopback
 REST and gRPC backends (keto_tpu/servers/mux.py). Graceful shutdown stops
 the muxes first, then drains the backends.
+
+Rolling-restart contract: SIGTERM/SIGINT (install_signal_handlers) pins
+the health state to NOT_SERVING — load balancers and readiness probes
+stop routing new traffic — then waits up to ``serve.drain_timeout_s`` for
+every in-flight check to resolve before tearing the stacks down, so a
+rolling restart drops zero accepted requests.
 """
 
 from __future__ import annotations
 
+import signal
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -48,6 +56,9 @@ class Daemon:
     def __init__(self, registry):
         self.registry = registry
         self._roles: dict[str, _RoleServers] = {}
+        # set by a shutdown signal (or shutdown_soon()); serve_all's
+        # blocking loop waits on it and then drains
+        self._stop_requested = threading.Event()
 
     def _start_role(self, role: str, host: str, port: int) -> _RoleServers:
         rest = make_rest_server(self.registry, role, host="127.0.0.1", port=0)
@@ -80,9 +91,68 @@ class Daemon:
         self._roles[WRITE] = self._start_role(WRITE, write_host, write_port)
         if block:
             try:
-                threading.Event().wait()
+                self._stop_requested.wait()
             except KeyboardInterrupt:
-                self.shutdown()
+                pass
+            self.drain_and_shutdown()
+
+    # -- graceful shutdown ---------------------------------------------------
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → drain-then-shutdown (the k8s preStop /
+        rolling-restart path). Only callable from the main thread (a
+        CPython constraint on signal.signal); elsewhere it is a no-op so
+        embedded daemons can call it unconditionally."""
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, self._on_signal)
+
+    def _on_signal(self, signum, frame) -> None:
+        # the handler itself must stay tiny and async-signal-safe-ish:
+        # flag the event; serve_all's blocking loop (or whoever owns the
+        # daemon) performs the actual drain
+        self._stop_requested.set()
+
+    def shutdown_soon(self) -> None:
+        """Programmatic equivalent of a shutdown signal."""
+        self._stop_requested.set()
+
+    def drain_and_shutdown(self) -> None:
+        """Stop taking NEW traffic (health pinned NOT_SERVING so probes
+        and load balancers route away), wait up to
+        ``serve.drain_timeout_s`` for in-flight checks to resolve, then
+        tear the stacks down. In-flight requests accepted before the
+        signal complete normally — the zero-dropped-requests half of the
+        rolling-restart contract."""
+        if not self._roles:
+            return
+        self._stop_requested.set()
+        drain_s = float(self.registry.config().get("serve.drain_timeout_s", 5.0))
+        try:
+            from keto_tpu.driver.health import HealthState
+
+            self.registry.health_monitor().set_override(
+                HealthState.NOT_SERVING, "draining: shutdown requested"
+            )
+        except Exception:
+            pass  # health never blocks shutdown
+        deadline = time.monotonic() + drain_s
+        batcher = self.registry.peek("check_batcher")
+        if batcher is not None and hasattr(batcher, "drain"):
+            if not batcher.drain(drain_s):
+                self.registry.logger().warning(
+                    "drain timed out after %.1fs with %d checks in flight",
+                    drain_s, getattr(batcher, "inflight", -1),
+                )
+        # the batcher resolving a future is not the response reaching the
+        # wire: wait for the REST backends to flush every accepted
+        # exchange before connections are torn down
+        for role in self._roles.values():
+            drain = getattr(role.rest, "drain", None)
+            if drain is not None:
+                drain(max(0.5, deadline - time.monotonic()))
+        self.shutdown()
 
     def _warm_snapshot(self) -> None:
         """Kick the first snapshot build/reload off the request path: with

@@ -47,6 +47,11 @@ class Registry:
         # manager through the callable below on every use
         pass
 
+    def peek(self, key: str):
+        """An already-built singleton, or None — shutdown paths use this
+        to avoid constructing a dependency just to tear it down."""
+        return self._singletons.get(key)
+
     # -- leaf dependencies ---------------------------------------------------
 
     def config(self) -> Config:
@@ -72,20 +77,28 @@ class Registry:
         def build():
             dsn = self._config.dsn
             if dsn == "memory":
-                return MemoryPersister(self.namespaces_source(), network_id=self._network_id)
-            if dsn.startswith("sqlite://"):
+                store = MemoryPersister(
+                    self.namespaces_source(), network_id=self._network_id
+                )
+            elif dsn.startswith("sqlite://"):
                 from keto_tpu.persistence.sqlite import SQLitePersister
 
-                return SQLitePersister(
+                store = SQLitePersister(
                     dsn, self.namespaces_source(), network_id=self._network_id
                 )
-            if dsn.startswith(("postgres://", "postgresql://", "cockroach://")):
+            elif dsn.startswith(("postgres://", "postgresql://", "cockroach://")):
                 from keto_tpu.persistence.postgres import PostgresPersister
 
-                return PostgresPersister(
+                store = PostgresPersister(
                     dsn, self.namespaces_source(), network_id=self._network_id
                 )
-            raise ValueError(f"unsupported dsn {dsn!r}")
+            else:
+                raise ValueError(f"unsupported dsn {dsn!r}")
+            # idempotency keys dedup write retries for this long before GC
+            store.idempotency_ttl_s = float(
+                self._config.get("serve.idempotency_ttl_s", 86400.0)
+            )
+            return store
 
         return self._memo("manager", build)
 

@@ -50,11 +50,55 @@ from keto_tpu.graph.snapshot import Bucket, GraphSnapshot
 
 #: bump when the on-disk layout or the snapshot's array semantics change —
 #: the version is part of the directory key, so old caches are simply
-#: never matched (and pruned as newer saves land)
-FORMAT_VERSION = 1
+#: never matched (and pruned as newer saves land). v2: per-segment
+#: checksums in meta.json + fsync-before-rename durability.
+FORMAT_VERSION = 2
 
 #: caches kept per directory (newest watermarks win)
 KEEP = 2
+
+#: quarantined (corrupt) caches kept for forensics; older ones drop
+QUARANTINE_KEEP = 2
+
+
+class CacheCorrupt(ValueError):
+    """A cached snapshot failed its integrity verification (size or
+    checksum mismatch, torn meta.json). The loader quarantines the
+    directory and rebuilds — a corrupt cache must never serve wrong
+    decisions, and must never crash the server either."""
+
+
+def _fsync_file(path: Path) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: Path) -> None:
+    """Flush directory metadata (the rename itself) to disk; best-effort
+    on filesystems that refuse O_RDONLY fsync on directories."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _file_crc(path: Path, chunk: int = 1 << 22) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                return crc
+            crc = zlib.crc32(buf, crc)
 
 
 def _string_table(strings: list) -> Optional[tuple]:
@@ -279,6 +323,19 @@ def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
             sv(f"{kind}_off", off)
             sv(f"{kind}_hash", hashes)
             sv(f"{kind}_hord", order)
+        # per-segment integrity manifest: size + crc32 of every data file,
+        # read back from disk so the checksum covers what actually landed.
+        # The loader verifies before serving — a torn write (crash or
+        # power loss mid-save that somehow survived the atomic-rename
+        # protocol, bit rot, a truncating copy) is DETECTED and the cache
+        # quarantined instead of silently yielding wrong decisions.
+        segments = {}
+        for f in sorted(tmp.iterdir()):
+            _fsync_file(f)  # durable before the rename publishes them
+            segments[f.name] = {
+                "size": f.stat().st_size,
+                "crc32": _file_crc(f),
+            }
         meta = {
             "format": FORMAT_VERSION,
             "watermark": int(snap.snapshot_id),
@@ -292,8 +349,11 @@ def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
             "buckets": [{"offset": int(b.offset), "n": int(b.n)} for b in snap.buckets],
             "n_obj": int(n_obj),
             "n_rel": int(n_rel),
+            "segments": segments,
         }
         (tmp / "meta.json").write_text(json.dumps(meta))
+        _fsync_file(tmp / "meta.json")
+        _fsync_dir(tmp)
         try:
             os.replace(tmp, final)
         except OSError:
@@ -302,6 +362,10 @@ def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
             # a concurrent saver landed the same watermark first — theirs
             # is identical; drop ours
             shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            # the rename is only durable once the parent directory is —
+            # an acknowledged cache must survive the machine dying now
+            _fsync_dir(base)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
@@ -311,10 +375,12 @@ def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
 
 def _prune(base: Path, keep: int) -> None:
     """Drop all but the ``keep`` newest caches of the CURRENT format (a
-    format bump orphans old dirs — remove those too)."""
+    format bump orphans old dirs — remove those too). Dot-prefixed
+    entries (in-flight ``.tmp-`` saves, ``.quarantine-`` forensics) are
+    managed by their own lifecycles and skipped here."""
     entries = []
     for d in base.iterdir():
-        if not d.is_dir() or d.name.startswith(".tmp-"):
+        if not d.is_dir() or d.name.startswith("."):
             continue
         wm = _parse_tag(d.name)
         if wm is None:
@@ -324,6 +390,55 @@ def _prune(base: Path, keep: int) -> None:
     entries.sort(reverse=True)
     for _, d in entries[keep:]:
         shutil.rmtree(d, ignore_errors=True)
+
+
+def _quarantine(d: Path, stats=None) -> None:
+    """Move a corrupt/unreadable cache aside instead of deleting it (an
+    operator can post-mortem the torn segment) and never serve from it
+    again. Bounded: only the newest QUARANTINE_KEEP quarantines are
+    kept."""
+    base = d.parent
+    target = base / f".quarantine-{d.name}-{os.getpid()}"
+    try:
+        if target.exists():
+            shutil.rmtree(target, ignore_errors=True)
+        os.replace(d, target)
+    except OSError:
+        shutil.rmtree(d, ignore_errors=True)  # rename refused — just drop it
+    if stats is not None:
+        stats.incr("cache_quarantined")
+    quarantines = sorted(
+        (q for q in base.iterdir() if q.name.startswith(".quarantine-")),
+        key=lambda q: q.stat().st_mtime,
+        reverse=True,
+    )
+    for q in quarantines[QUARANTINE_KEEP:]:
+        shutil.rmtree(q, ignore_errors=True)
+
+
+def _verify_segments(d: Path, meta: dict) -> None:
+    """Integrity gate: every data file must match the manifest recorded
+    at save time, and no manifest entry may be missing. Raises
+    CacheCorrupt on the first mismatch."""
+    segments = meta.get("segments")
+    if not isinstance(segments, dict):
+        raise CacheCorrupt(f"{d.name}: meta.json has no segment manifest")
+    for name, want in segments.items():
+        f = d / name
+        if not f.is_file():
+            raise CacheCorrupt(f"{d.name}/{name}: segment missing")
+        size = f.stat().st_size
+        if size != want.get("size"):
+            raise CacheCorrupt(
+                f"{d.name}/{name}: size {size} != recorded {want.get('size')}"
+                " (torn write?)"
+            )
+        crc = _file_crc(f)
+        if crc != want.get("crc32"):
+            raise CacheCorrupt(
+                f"{d.name}/{name}: crc32 {crc:#x} != recorded "
+                f"{int(want.get('crc32', 0)):#x} (corrupt segment)"
+            )
 
 
 def _parse_tag(name: str) -> Optional[int]:
@@ -336,12 +451,23 @@ def _parse_tag(name: str) -> Optional[int]:
         return None
 
 
-def load_snapshot(path: str) -> GraphSnapshot:
-    """Reload one cached snapshot directory (mmap — arrays page lazily)."""
+def load_snapshot(path: str, verify: bool = True) -> GraphSnapshot:
+    """Reload one cached snapshot directory (mmap — arrays page lazily).
+
+    ``verify`` checks every segment's size and crc32 against the manifest
+    recorded at save time before anything is served from the cache —
+    sequential reads at crc32 throughput, still orders of magnitude
+    cheaper than the ingest+build it replaces. Raises CacheCorrupt on any
+    mismatch (including a torn meta.json, surfaced as the JSON error)."""
     d = Path(path)
-    meta = json.loads((d / "meta.json").read_text())
+    try:
+        meta = json.loads((d / "meta.json").read_text())
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CacheCorrupt(f"{d.name}/meta.json unreadable: {e}") from None
     if meta.get("format") != FORMAT_VERSION:
         raise ValueError(f"cache format {meta.get('format')} != {FORMAT_VERSION}")
+    if verify:
+        _verify_segments(d, meta)
     interned = CachedInterned(d, meta)
     mm = lambda name: np.load(d / name, mmap_mode="r")  # noqa: E731
     buckets = [
@@ -368,11 +494,17 @@ def load_snapshot(path: str) -> GraphSnapshot:
 
 
 def load_latest(
-    cache_dir: str, max_watermark: Optional[int] = None
+    cache_dir: str, max_watermark: Optional[int] = None, stats=None
 ) -> Optional[GraphSnapshot]:
     """Newest loadable cache under ``cache_dir`` with watermark ≤
     ``max_watermark`` (the store's current watermark — a cache AHEAD of
-    the store belongs to other data and must never serve), or None."""
+    the store belongs to other data and must never serve), or None.
+
+    A cache that fails its integrity verification is QUARANTINED (moved
+    aside, counted into ``stats`` as ``cache_quarantined`` when a
+    MaintenanceStats-like sink is given) and the next-newest candidate is
+    tried — the recovery contract is "loads clean or is rejected", never
+    wrong decisions and never a crash."""
     base = Path(cache_dir)
     if not base.is_dir():
         return None
@@ -387,6 +519,8 @@ def load_latest(
     for _, d in sorted(candidates, reverse=True):
         try:
             return load_snapshot(str(d))
+        except CacheCorrupt:
+            _quarantine(d, stats=stats)  # rejected; rebuild path takes over
         except Exception:
-            continue  # unreadable/corrupt cache → try the next, else rebuild
+            continue  # unreadable for other reasons → try the next
     return None

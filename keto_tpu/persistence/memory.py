@@ -27,12 +27,14 @@ import bisect
 import heapq
 import itertools
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
 
 from keto_tpu import namespace as namespace_pkg
-from keto_tpu.relationtuple.manager import Manager
+from keto_tpu.relationtuple.manager import Manager, TransactResult
+from keto_tpu.x import faults
 from keto_tpu.relationtuple.model import RelationQuery, RelationTuple, SubjectID, SubjectSet
 from keto_tpu.x.errors import ErrMalformedPageToken, ErrNilSubject
 from keto_tpu.x.pagination import (
@@ -170,6 +172,10 @@ class _SharedState:
         # zero-copy interning input (keto_tpu/graph/native.py
         # native_intern_columns). Any later mutation invalidates it.
         self.col_cache: dict[str, tuple[int, dict]] = {}
+        # idempotency dedup: nid → key → (snaptoken, created_at) — the
+        # in-memory analog of the SQL keto_idempotency table (same replay
+        # semantics; durability obviously ends with the process)
+        self.idempotency: dict[str, dict[str, tuple[int, float]]] = {}
 
 
 class MemoryPersister(Manager):
@@ -187,6 +193,8 @@ class MemoryPersister(Manager):
             self._nm = namespace_manager_source
         self.network_id = network_id
         self._shared = _shared or _SharedState()
+        #: how long idempotency keys dedup retries before GC forgets them
+        self.idempotency_ttl_s = 86400.0
 
     @property
     def namespaces(self):
@@ -423,12 +431,24 @@ class MemoryPersister(Manager):
         self.transact_relation_tuples((), tuples)
 
     def transact_relation_tuples(
-        self, insert: Sequence[RelationTuple], delete: Sequence[RelationTuple]
-    ) -> None:
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+        idempotency_key: Optional[str] = None,
+    ) -> TransactResult:
         """Atomic: namespace validation happens for the whole batch before any
         mutation, so a failing insert/delete leaves the store untouched
-        (rollback semantics of reference relationtuples.go:271-278)."""
+        (rollback semantics of reference relationtuples.go:271-278).
+        ``idempotency_key`` dedups retries exactly like the SQL stores:
+        an already-applied key re-applies nothing and replays the
+        original snaptoken."""
         with self._shared.lock:
+            if idempotency_key is not None:
+                dedup = self._shared.idempotency.setdefault(self.network_id, {})
+                got = dedup.get(idempotency_key)
+                if got is not None:
+                    return TransactResult(snaptoken=got[0], replayed=True)
+            faults.check("transact-commit")
             new_sorted: Optional[list[InternalRow]] = None
             bundle = None
             if len(insert) >= 4096:
@@ -539,6 +559,17 @@ class MemoryPersister(Manager):
                         drop = len(log) - self._shared.LOG_CAP
                         self._shared.log_floor[nid] = log[drop - 1][0]
                         del log[:drop]
+            if idempotency_key is not None:
+                now = time.time()
+                dedup = self._shared.idempotency.setdefault(nid, {})
+                dedup[idempotency_key] = (wm, now)
+                # GC expired keys (same TTL contract as the SQL stores)
+                ttl = self.idempotency_ttl_s
+                expired = [k for k, (_, t) in dedup.items() if t <= now - ttl]
+                for k in expired:
+                    del dedup[k]
+            faults.check("transact-ack")
+            return TransactResult(snaptoken=wm)
 
     def watermark(self) -> int:
         with self._shared.lock:

@@ -158,3 +158,27 @@ class PostgresPersister(SQLPersisterBase):
 
     def _order_sql(self) -> str:  # composition-time seam (see base)
         return _PG_ORDER
+
+    def _is_disconnect(self, exc: BaseException) -> bool:
+        """A dropped server connection, across the three supported
+        drivers (psycopg/psycopg2 raise OperationalError or
+        InterfaceError for lost connections; pg8000 surfaces raw socket
+        errors). Matching by exception NAME keeps this working whichever
+        driver the host has without importing all of them."""
+        if isinstance(exc, (ConnectionError, BrokenPipeError, EOFError)):
+            return True
+        name = type(exc).__name__
+        if name == "InterfaceError":
+            return True
+        if name == "OperationalError":
+            # OperationalError also covers server-side faults (e.g.
+            # query canceled) — only connection-shaped messages re-dial
+            msg = str(exc).lower()
+            return any(
+                s in msg
+                for s in (
+                    "connection", "closed", "terminat", "server",
+                    "eof", "ssl", "timeout",
+                )
+            )
+        return False

@@ -25,18 +25,20 @@ from __future__ import annotations
 
 import threading
 import uuid
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 from keto_tpu import namespace as namespace_pkg
 from keto_tpu.persistence.memory import InternalRow
-from keto_tpu.relationtuple.manager import Manager
+from keto_tpu.relationtuple.manager import Manager, TransactResult
 from keto_tpu.relationtuple.model import RelationQuery, RelationTuple, SubjectID, SubjectSet
+from keto_tpu.x import faults
 from keto_tpu.x.errors import ErrMalformedPageToken, ErrNilSubject
 from keto_tpu.x.pagination import (
     DEFAULT_PAGE_SIZE,
     PaginationOptionSetter,
     get_pagination_options,
 )
+from keto_tpu.x.retry import retry_call
 
 #: versioned migrations; the DDL is intentionally dialect-portable (the
 #: reference keeps per-dialect files; this schema stays in the common
@@ -162,6 +164,33 @@ MIGRATIONS: list[tuple[str, str, str]] = [
         """,
         "DROP INDEX keto_relation_tuples_commit_time_idx",
     ),
+    (
+        # idempotency dedup table: key → the snaptoken the keyed
+        # transaction committed at. Written in the SAME transaction as
+        # the tuple rows, so a retry after an ambiguous failure
+        # (connection died post-COMMIT, pre-ack) finds the key and
+        # replays the original response instead of re-applying the write
+        "20260804000000_idempotency",
+        """
+        CREATE TABLE keto_idempotency (
+            nid TEXT NOT NULL,
+            idem_key TEXT NOT NULL,
+            snaptoken BIGINT NOT NULL,
+            created_at BIGINT NOT NULL,
+            PRIMARY KEY (nid, idem_key)
+        )
+        """,
+        "DROP TABLE keto_idempotency",
+    ),
+    (
+        # GC walks expired keys as one indexed range delete
+        "20260804000001_idempotency_gc_idx",
+        """
+        CREATE INDEX keto_idempotency_created_idx
+        ON keto_idempotency (nid, created_at)
+        """,
+        "DROP INDEX keto_idempotency_created_idx",
+    ),
 ]
 
 #: delete-log retention window in watermark units; older entries prune and
@@ -172,6 +201,24 @@ _ORDER = (
     "ORDER BY namespace_id, object, relation, subject_id, "
     "subject_set_namespace_id, subject_set_object, subject_set_relation, commit_time"
 )
+
+#: idempotency keys are forgotten after this many seconds (overridable per
+#: persister via ``idempotency_ttl_s``, wired from ``serve.idempotency_ttl_s``)
+DEFAULT_IDEMPOTENCY_TTL_S = 86400.0
+
+
+class _ConnBox:
+    """Shared mutable holder for the live DBAPI connection.
+
+    Every network-scoped view of one store shares this box (they already
+    share the lock), so a reconnect after a dropped server connection is
+    visible to ALL views — a view holding a direct reference to the dead
+    connection object would keep failing forever."""
+
+    __slots__ = ("conn",)
+
+    def __init__(self, conn):
+        self.conn = conn
 
 
 def _apply_delete_ops(rows: list, dels) -> list:
@@ -253,8 +300,17 @@ class SQLPersisterBase(Manager):
         # one connection instead of interleaving BEGINs
         self._lock = _lock or threading.RLock()
         self._owns_conn = _conn is None
-        self._conn = _conn or self._connect(dsn)
+        if isinstance(_conn, _ConnBox):
+            self._box = _conn
+        else:
+            self._box = _ConnBox(_conn if _conn is not None else self._connect(dsn))
         self._dsn = dsn
+        #: how long idempotency keys dedup retries before GC forgets them
+        self.idempotency_ttl_s = DEFAULT_IDEMPOTENCY_TTL_S
+        #: budget for reconnect+retry after a mid-query connection loss
+        self.reconnect_max_wait_s = 30.0
+        #: times the live connection was re-dialed after a detected loss
+        self.reconnects = 0
         # snapshot-row cache: (sorted InternalRow list, watermark). Full
         # rebuild reads at 50M rows would otherwise re-read and re-encode
         # every row per snapshot; insert-only advances extend the cache
@@ -289,6 +345,67 @@ class SQLPersisterBase(Manager):
         tearing the (rows, watermark) pairing the delta seams depend on."""
         self._exec("BEGIN")
 
+    def _supports_returning(self) -> bool:
+        """Whether the watermark upsert may use ``RETURNING`` (postgres:
+        always; sqlite only from 3.35 — older builds take the
+        upsert-then-SELECT path inside the same transaction)."""
+        return True
+
+    def _is_disconnect(self, exc: BaseException) -> bool:
+        """Whether ``exc`` means the server connection is gone (and a
+        re-dial could help). False for embedded dialects — a sqlite file
+        cannot drop its connection."""
+        return False
+
+    # -- connection loss -----------------------------------------------------
+
+    @property
+    def _conn(self):
+        return self._box.conn
+
+    def _reconnect(self) -> None:
+        """Replace the shared connection after a detected loss (caller
+        holds the lock). The old connection's transaction — if any — died
+        with the server; the new connection starts clean in autocommit."""
+        self.reconnects += 1
+        try:
+            self._box.conn.close()
+        except Exception:
+            pass
+        self._box.conn = self._connect(self._dsn)
+
+    def _safe_rollback(self) -> None:
+        try:
+            self._exec("ROLLBACK")
+        except Exception:
+            pass  # connection gone — the server already discarded the txn
+
+    def _with_reconnect(self, fn: Callable, *, retry: bool):
+        """Run ``fn`` (which takes the lock itself); on a
+        dialect-recognized connection loss, re-dial — and, when ``retry``
+        (reads always; writes only when idempotency-keyed, so a retried
+        write can never double-apply), re-run ``fn`` through the shared
+        jittered-backoff policy up to ``reconnect_max_wait_s``."""
+
+        def attempt():
+            try:
+                return fn()
+            except Exception as e:
+                if self._is_disconnect(e):
+                    with self._lock:
+                        self._reconnect()
+                raise
+
+        if not retry:
+            return attempt()
+        return retry_call(
+            attempt,
+            max_wait_s=self.reconnect_max_wait_s,
+            base_s=0.05,
+            max_s=1.0,
+            retryable=self._is_disconnect,
+        )
+
     # -- execution helpers ---------------------------------------------------
 
     def _exec(self, sql: str, params: Sequence = ()):
@@ -307,7 +424,7 @@ class SQLPersisterBase(Manager):
         (reference internal/relationtuple/manager_isolation.go:39-116)."""
         return type(self)(
             self._dsn, self._nm, network_id,
-            auto_migrate=False, _conn=self._conn, _lock=self._lock,
+            auto_migrate=False, _conn=self._box, _lock=self._lock,
         )
 
     def close(self) -> None:
@@ -432,16 +549,21 @@ class SQLPersisterBase(Manager):
             raise ErrMalformedPageToken()
 
         where, params = self._where(query)
-        with self._lock:
-            total = self._exec(
-                f"SELECT COUNT(*) FROM keto_relation_tuples WHERE {where}", params
-            ).fetchone()[0]
-            rows = self._exec(
-                f"SELECT namespace_id, object, relation, subject_id, subject_set_namespace_id, "
-                f"subject_set_object, subject_set_relation FROM keto_relation_tuples "
-                f"WHERE {where} {self._order_sql()} LIMIT ? OFFSET ?",
-                params + [per_page, (page - 1) * per_page],
-            ).fetchall()
+
+        def run():
+            with self._lock:
+                total = self._exec(
+                    f"SELECT COUNT(*) FROM keto_relation_tuples WHERE {where}", params
+                ).fetchone()[0]
+                rows = self._exec(
+                    f"SELECT namespace_id, object, relation, subject_id, subject_set_namespace_id, "
+                    f"subject_set_object, subject_set_relation FROM keto_relation_tuples "
+                    f"WHERE {where} {self._order_sql()} LIMIT ? OFFSET ?",
+                    params + [per_page, (page - 1) * per_page],
+                ).fetchall()
+            return total, rows
+
+        total, rows = self._with_reconnect(run, retry=True)
         total_pages = -(-total // per_page)
         next_token = "" if page >= total_pages else str(page + 1)
         return [self._to_tuple(r) for r in rows], next_token
@@ -452,120 +574,201 @@ class SQLPersisterBase(Manager):
     def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
         self.transact_relation_tuples((), tuples)
 
+    def _alloc_commit_time(self) -> int:
+        """Freshly allocated per-network watermark, doubling as this
+        transaction's commit_time: O(1) to obtain (vs. a MAX() scan per
+        row), monotone across transactions, constant within one (like the
+        reference's commit_time=now(), relationtuples.go:128-149). The
+        upsert is ATOMIC across connections — a plain SELECT-then-bump
+        would let two server-dialect writers mint the same commit_time
+        and double-bump the watermark, hiding one writer's rows from
+        every delta reader forever; the row lock it takes also serializes
+        concurrent writers per network for the rest of the transaction.
+        A no-op transaction rolls the bump back, so the watermark still
+        only moves when data moved."""
+        if self._supports_returning():
+            return self._exec(
+                "INSERT INTO keto_watermarks (nid, watermark) VALUES (?, 1) "
+                "ON CONFLICT(nid) DO UPDATE "
+                "SET watermark = keto_watermarks.watermark + 1 "
+                "RETURNING watermark",
+                (self.network_id,),
+            ).fetchone()[0]
+        # RETURNING-less dialects (stock sqlite < 3.35): bump, then read
+        # the bumped value back INSIDE the same transaction — the write
+        # lock the upsert takes keeps the pair atomic
+        self._exec(
+            "INSERT INTO keto_watermarks (nid, watermark) VALUES (?, 1) "
+            "ON CONFLICT(nid) DO UPDATE "
+            "SET watermark = keto_watermarks.watermark + 1",
+            (self.network_id,),
+        )
+        return self._exec(
+            "SELECT watermark FROM keto_watermarks WHERE nid = ?",
+            (self.network_id,),
+        ).fetchone()[0]
+
     def transact_relation_tuples(
-        self, insert: Sequence[RelationTuple], delete: Sequence[RelationTuple]
-    ) -> None:
-        with self._lock:
-            # resolve everything before mutating so namespace errors roll
-            # back cleanly (reference relationtuples.go:271-278)
-            ins_rows = [self._row_values(rt) for rt in insert]
-            del_rows = [self._row_values(rt) for rt in delete]
-            self._exec("BEGIN")
-            try:
-                # commit_time is the freshly allocated per-network
-                # watermark: O(1) to obtain (vs. a MAX() scan per row),
-                # monotone across transactions, constant within one (like
-                # the reference's commit_time=now(),
-                # relationtuples.go:128-149). The upsert-RETURNING is
-                # ATOMIC across connections — a plain SELECT-then-bump
-                # would let two server-dialect writers mint the same
-                # commit_time and double-bump the watermark, hiding one
-                # writer's rows from every delta reader forever; the row
-                # lock it takes also serializes concurrent writers per
-                # network for the rest of the transaction. A no-op
-                # transaction ROLLS BACK below, undoing the bump, so the
-                # watermark still only moves when data moved.
-                commit_time = self._exec(
-                    "INSERT INTO keto_watermarks (nid, watermark) VALUES (?, 1) "
-                    "ON CONFLICT(nid) DO UPDATE "
-                    "SET watermark = keto_watermarks.watermark + 1 "
-                    "RETURNING watermark",
-                    (self.network_id,),
-                ).fetchone()[0]
-                changed = bool(ins_rows)
-                if ins_rows:
-                    shard_ids = uuid.uuid4().hex
-                    self._executemany(
-                        "INSERT INTO keto_relation_tuples (shard_id, nid, namespace_id, "
-                        "object, relation, subject_id, subject_set_namespace_id, "
-                        "subject_set_object, subject_set_relation, commit_time) "
-                        "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
-                        [
-                            (f"{shard_ids}-{i}", self.network_id) + values + (commit_time,)
-                            for i, values in enumerate(ins_rows)
-                        ],
-                    )
-                effective_dels: list[tuple] = []
-                if del_rows:
-                    null_safe = " AND ".join(
-                        self._null_safe_eq(col) for col in (
-                            "subject_id",
-                            "subject_set_namespace_id",
-                            "subject_set_object",
-                            "subject_set_relation",
-                        )
-                    )
-                    # per-key deletes (like the reference's per-tuple loop,
-                    # relationtuples.go:178-201) so only keys that actually
-                    # removed rows enter the delete log — a logged no-op
-                    # under an unbumped watermark would leak into a later
-                    # delta read
-                    for values in dict.fromkeys(del_rows):
-                        cur = self._exec(
-                            "DELETE FROM keto_relation_tuples WHERE nid = ? "
-                            "AND namespace_id = ? AND object = ? AND relation = ? "
-                            "AND " + null_safe,
-                            (self.network_id,) + values,
-                        )
-                        if cur.rowcount > 0:
-                            effective_dels.append(values)
-                    changed = changed or bool(effective_dels)
-                if changed:
-                    if effective_dels:
-                        self._exec(
-                            "UPDATE keto_watermarks SET delete_wm = watermark "
-                            "WHERE nid = ?",
-                            (self.network_id,),
-                        )
-                        self._executemany(
-                            "INSERT INTO keto_tuple_delete_log (nid, namespace_id, "
-                            "object, relation, subject_id, subject_set_namespace_id, "
-                            "subject_set_object, subject_set_relation, commit_time) "
-                            "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
-                            [
-                                (self.network_id,) + values + (commit_time,)
-                                for values in effective_dels
-                            ],
-                        )
-                        floor = commit_time - _DELETE_LOG_KEEP
-                        if floor > 0:
-                            self._exec(
-                                "DELETE FROM keto_tuple_delete_log "
-                                "WHERE nid = ? AND commit_time <= ?",
-                                (self.network_id, floor),
-                            )
-                            self._exec(
-                                "UPDATE keto_watermarks SET del_log_floor = ? "
-                                "WHERE nid = ?",
-                                (floor, self.network_id),
-                            )
-                if changed:
-                    self._exec("COMMIT")
-                else:
-                    # no data moved (e.g. deleting nonexistent tuples):
-                    # roll back so the pre-allocated watermark bump never
-                    # lands — the device snapshot is not rebuilt for no-ops
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+        idempotency_key: Optional[str] = None,
+    ) -> TransactResult:
+        # resolve everything before mutating so namespace errors roll
+        # back cleanly (reference relationtuples.go:271-278) — and are
+        # never retried as connection weather
+        ins_rows = [self._row_values(rt) for rt in insert]
+        del_rows = [self._row_values(rt) for rt in delete]
+
+        def run():
+            with self._lock:
+                return self._transact_locked(ins_rows, del_rows, idempotency_key)
+
+        # a mid-query connection loss re-dials for every caller, but only
+        # RE-RUNS the transaction when it is idempotency-keyed: the re-run
+        # either finds its key recorded (the lost connection's COMMIT did
+        # land — replay) or applies cleanly; an unkeyed write retried
+        # blind could double-apply
+        return self._with_reconnect(run, retry=idempotency_key is not None)
+
+    def _transact_locked(
+        self, ins_rows: list, del_rows: list, idempotency_key: Optional[str]
+    ) -> TransactResult:
+        self._exec("BEGIN")
+        try:
+            if idempotency_key is not None:
+                row = self._exec(
+                    "SELECT snaptoken FROM keto_idempotency "
+                    "WHERE nid = ? AND idem_key = ?",
+                    (self.network_id, idempotency_key),
+                ).fetchone()
+                if row is not None:
+                    # the key already applied (this is a retry after an
+                    # ambiguous failure): re-apply NOTHING, answer with
+                    # the original transaction's snaptoken
                     self._exec("ROLLBACK")
-            except Exception:
-                self._exec("ROLLBACK")
-                raise
+                    return TransactResult(snaptoken=int(row[0]), replayed=True)
+            commit_time = self._alloc_commit_time()
+            changed = bool(ins_rows)
+            if ins_rows:
+                shard_ids = uuid.uuid4().hex
+                self._executemany(
+                    "INSERT INTO keto_relation_tuples (shard_id, nid, namespace_id, "
+                    "object, relation, subject_id, subject_set_namespace_id, "
+                    "subject_set_object, subject_set_relation, commit_time) "
+                    "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    [
+                        (f"{shard_ids}-{i}", self.network_id) + values + (commit_time,)
+                        for i, values in enumerate(ins_rows)
+                    ],
+                )
+            effective_dels: list[tuple] = []
+            if del_rows:
+                null_safe = " AND ".join(
+                    self._null_safe_eq(col) for col in (
+                        "subject_id",
+                        "subject_set_namespace_id",
+                        "subject_set_object",
+                        "subject_set_relation",
+                    )
+                )
+                # per-key deletes (like the reference's per-tuple loop,
+                # relationtuples.go:178-201) so only keys that actually
+                # removed rows enter the delete log — a logged no-op
+                # under an unbumped watermark would leak into a later
+                # delta read
+                for values in dict.fromkeys(del_rows):
+                    cur = self._exec(
+                        "DELETE FROM keto_relation_tuples WHERE nid = ? "
+                        "AND namespace_id = ? AND object = ? AND relation = ? "
+                        "AND " + null_safe,
+                        (self.network_id,) + values,
+                    )
+                    if cur.rowcount > 0:
+                        effective_dels.append(values)
+                changed = changed or bool(effective_dels)
+            if changed and effective_dels:
+                self._exec(
+                    "UPDATE keto_watermarks SET delete_wm = watermark "
+                    "WHERE nid = ?",
+                    (self.network_id,),
+                )
+                self._executemany(
+                    "INSERT INTO keto_tuple_delete_log (nid, namespace_id, "
+                    "object, relation, subject_id, subject_set_namespace_id, "
+                    "subject_set_object, subject_set_relation, commit_time) "
+                    "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    [
+                        (self.network_id,) + values + (commit_time,)
+                        for values in effective_dels
+                    ],
+                )
+                floor = commit_time - _DELETE_LOG_KEEP
+                if floor > 0:
+                    self._exec(
+                        "DELETE FROM keto_tuple_delete_log "
+                        "WHERE nid = ? AND commit_time <= ?",
+                        (self.network_id, floor),
+                    )
+                    self._exec(
+                        "UPDATE keto_watermarks SET del_log_floor = ? "
+                        "WHERE nid = ?",
+                        (floor, self.network_id),
+                    )
+            if idempotency_key is not None:
+                token = commit_time
+                if not changed:
+                    # keep "the watermark only moves when data moved"
+                    # while still committing the dedup row durably: undo
+                    # the pre-allocated bump inside this transaction (the
+                    # upsert's row lock serialized concurrent writers, so
+                    # nobody observed the bumped value)
+                    self._exec(
+                        "UPDATE keto_watermarks SET watermark = watermark - 1 "
+                        "WHERE nid = ?",
+                        (self.network_id,),
+                    )
+                    token = commit_time - 1
+                self._exec(
+                    "INSERT INTO keto_idempotency (nid, idem_key, snaptoken, "
+                    f"created_at) VALUES (?, ?, ?, {self._epoch_expr()})",
+                    (self.network_id, idempotency_key, int(token)),
+                )
+                # GC expired keys while we hold the write lock anyway —
+                # one indexed range delete, bounded by the TTL window
+                self._exec(
+                    "DELETE FROM keto_idempotency WHERE nid = ? "
+                    f"AND created_at <= {self._epoch_expr()} - ?",
+                    (self.network_id, int(self.idempotency_ttl_s)),
+                )
+                faults.check("transact-commit")
+                self._exec("COMMIT")
+                faults.check("transact-ack")
+                return TransactResult(snaptoken=int(token))
+            if changed:
+                faults.check("transact-commit")
+                self._exec("COMMIT")
+                faults.check("transact-ack")
+                return TransactResult(snaptoken=int(commit_time))
+            # no data moved (e.g. deleting nonexistent tuples): roll back
+            # so the pre-allocated watermark bump never lands — the
+            # device snapshot is not rebuilt for no-ops
+            self._exec("ROLLBACK")
+            return TransactResult(snaptoken=int(commit_time) - 1)
+        except Exception:
+            self._safe_rollback()
+            raise
 
     def watermark(self) -> int:
-        with self._lock:
-            row = self._exec(
-                "SELECT watermark FROM keto_watermarks WHERE nid = ?", (self.network_id,)
-            ).fetchone()
-            return row[0] if row else 0
+        def run():
+            with self._lock:
+                row = self._exec(
+                    "SELECT watermark FROM keto_watermarks WHERE nid = ?",
+                    (self.network_id,),
+                ).fetchone()
+                return row[0] if row else 0
+
+        return self._with_reconnect(run, retry=True)
 
     # -- snapshot support (TPU graph builder) --------------------------------
 
@@ -580,6 +783,9 @@ class SQLPersisterBase(Manager):
         deleted iff some delete of its key committed at-or-after its own
         commit_time) — a full re-read only happens when the delete log no
         longer reaches back to the cache watermark."""
+        return self._with_reconnect(self._snapshot_rows_once, retry=True)
+
+    def _snapshot_rows_once(self) -> tuple[list[InternalRow], int]:
         import heapq
 
         with self._lock:
@@ -650,6 +856,9 @@ class SQLPersisterBase(Manager):
         or ``None`` when a delete happened since (the delta-overlay seam —
         commit_time doubles as the insert log, so this is one indexed
         range read plus an O(1) delete-watermark check)."""
+        return self._with_reconnect(lambda: self._rows_since_once(watermark), retry=True)
+
+    def _rows_since_once(self, watermark: int):
         with self._lock:
             self._begin_snapshot_read()
             try:
@@ -682,6 +891,11 @@ class SQLPersisterBase(Manager):
         commit_time inserts order before deletes (the transact path deletes
         after inserting, so a tuple inserted+deleted in one transaction
         nets to deleted)."""
+        return self._with_reconnect(
+            lambda: self._changes_since_once(watermark), retry=True
+        )
+
+    def _changes_since_once(self, watermark: int):
         with self._lock:
             self._begin_snapshot_read()
             try:

@@ -55,7 +55,13 @@ class SQLitePersister(SQLPersisterBase):
         return f"{col} IS ?"  # sqlite's IS is null-safe equality
 
     def _epoch_expr(self) -> str:
-        return "strftime('%s','now')"
+        return "CAST(strftime('%s','now') AS INTEGER)"
+
+    def _supports_returning(self) -> bool:
+        # RETURNING landed in sqlite 3.35; stock distro builds are often
+        # older, so the base takes its upsert-then-SELECT watermark path
+        # (atomic under the transaction's write lock) on those
+        return sqlite3.sqlite_version_info >= (3, 35, 0)
 
 
 #: import alias

@@ -10,10 +10,29 @@ both the oracle engines and the TPU snapshot builder.
 from __future__ import annotations
 
 import abc
+from dataclasses import dataclass
 from typing import Iterable, Optional, Sequence
 
 from keto_tpu.relationtuple.model import RelationQuery, RelationTuple
 from keto_tpu.x.pagination import PaginationOptionSetter, get_pagination_options
+
+
+@dataclass(frozen=True)
+class TransactResult:
+    """Outcome of one write transaction.
+
+    ``snaptoken`` is the watermark the transaction committed at (the
+    consistency token a caller can pin subsequent checks to — the
+    durability contract says an acknowledged snaptoken survives server
+    death, docs/concepts/snaptokens.md). ``replayed`` is True when the
+    transaction was deduplicated against an earlier application of the
+    same idempotency key: nothing was re-applied and ``snaptoken`` is the
+    ORIGINAL transaction's token, so a client retrying after an ambiguous
+    failure (connection lost post-commit, pre-ack) observes exactly the
+    response it missed."""
+
+    snaptoken: int
+    replayed: bool = False
 
 
 class Manager(abc.ABC):
@@ -31,9 +50,20 @@ class Manager(abc.ABC):
 
     @abc.abstractmethod
     def transact_relation_tuples(
-        self, insert: Sequence[RelationTuple], delete: Sequence[RelationTuple]
-    ) -> None:
-        """Atomically apply inserts then deletes; all-or-nothing."""
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+        idempotency_key: Optional[str] = None,
+    ) -> Optional[TransactResult]:
+        """Atomically apply inserts then deletes; all-or-nothing.
+
+        With ``idempotency_key`` set, the transaction is exactly-once per
+        key: the key → snaptoken binding is recorded atomically WITH the
+        writes, and a retry of an already-applied key re-applies nothing
+        and returns the original snaptoken with ``replayed=True`` (the
+        CRDB-style answer to ambiguous-commit retries). Implementations
+        return a :class:`TransactResult`; the base contract allows None
+        for legacy stores without a watermark concept."""
 
     def watermark(self) -> int:
         """Monotonic write counter, used by the TPU engine to detect staleness
@@ -66,9 +96,14 @@ class ManagerWrapper(Manager):
         self.manager.delete_relation_tuples(*tuples)
 
     def transact_relation_tuples(
-        self, insert: Sequence[RelationTuple], delete: Sequence[RelationTuple]
-    ) -> None:
-        self.manager.transact_relation_tuples(insert, delete)
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+        idempotency_key: Optional[str] = None,
+    ) -> Optional[TransactResult]:
+        return self.manager.transact_relation_tuples(
+            insert, delete, idempotency_key=idempotency_key
+        )
 
     def watermark(self) -> int:
         return self.manager.watermark()

@@ -54,6 +54,11 @@ class AsyncRestServer:
         self._ready = threading.Event()
         self._boot_error: Optional[BaseException] = None
         self._conns: set[asyncio.StreamWriter] = set()
+        # request exchanges mid-flight (head parsed → response flushed);
+        # only the event-loop thread mutates it, other threads poll it in
+        # drain() — the SIGTERM path waits for this to hit zero before
+        # connections are aborted, so accepted requests get their bytes
+        self._active = 0
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix=f"rest-{role}"
         )
@@ -100,6 +105,19 @@ class AsyncRestServer:
             loop.run_forever()
         finally:
             loop.close()
+
+    def drain(self, timeout_s: float) -> bool:
+        """Wait (from any thread) until no request exchange is mid-flight
+        — every accepted request has had its response flushed. True when
+        idle within ``timeout_s``."""
+        import time as _time
+
+        deadline = _time.monotonic() + max(0.0, timeout_s)
+        while _time.monotonic() < deadline:
+            if self._active == 0:
+                return True
+            _time.sleep(0.01)
+        return self._active == 0
 
     def stop(self) -> None:
         loop = self._loop
@@ -169,15 +187,19 @@ class AsyncRestServer:
                 body = await reader.readexactly(length) if length else b""
                 parts = urlsplit(target)
                 query = parse_qs(parts.query, keep_blank_values=True)
-                status, payload, extra = await asyncio.get_running_loop().run_in_executor(
-                    self._pool, self.app.handle, method, parts.path, query, body,
-                    headers,
-                )
-                close = (
-                    version == "HTTP/1.0"
-                    or headers.get("connection", "").lower() == "close"
-                )
-                await self._write_response(writer, status, payload, extra, close)
+                self._active += 1
+                try:
+                    status, payload, extra = await asyncio.get_running_loop().run_in_executor(
+                        self._pool, self.app.handle, method, parts.path, query, body,
+                        headers,
+                    )
+                    close = (
+                        version == "HTTP/1.0"
+                        or headers.get("connection", "").lower() == "close"
+                    )
+                    await self._write_response(writer, status, payload, extra, close)
+                finally:
+                    self._active -= 1
                 if close:
                     return
         except (

@@ -220,9 +220,27 @@ class WriteService:
                 delete.append(tuple_from_proto(delta.relation_tuple))
             else:
                 raise ErrBadRequest(f"unknown action {action}")
+        # the gRPC face of REST's X-Idempotency-Key: an
+        # ``x-idempotency-key`` metadata entry makes the transaction
+        # exactly-once per key — a retry after an ambiguous failure
+        # (connection died post-commit, pre-ack) replays the original
+        # snaptoken, flagged by ``keto-idempotent-replay`` trailing
+        # metadata, instead of re-applying the deltas
+        idem_key = None
+        for k, v in context.invocation_metadata() or ():
+            if k.lower() == "x-idempotency-key" and v:
+                idem_key = v
+                break
         manager = self.registry.relation_tuple_manager()
-        manager.transact_relation_tuples(insert, delete)
-        token = str(manager.watermark())
+        result = manager.transact_relation_tuples(
+            insert, delete, idempotency_key=idem_key
+        )
+        if result is not None:
+            token = str(result.snaptoken)
+            if result.replayed:
+                context.set_trailing_metadata((("keto-idempotent-replay", "true"),))
+        else:  # legacy manager without a transact result
+            token = str(manager.watermark())
         return write_service_pb2.TransactRelationTuplesResponse(
             snaptokens=[token] * len(request.relation_tuple_deltas)
         )

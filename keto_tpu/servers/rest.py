@@ -113,11 +113,11 @@ class RestApp:
                     return self._get_relation_tuples(query)
             else:
                 if route == ("PUT", "/relation-tuples"):
-                    return self._put_relation_tuple(body)
+                    return self._put_relation_tuple(body, headers)
                 if route == ("DELETE", "/relation-tuples"):
-                    return self._delete_relation_tuple(query)
+                    return self._delete_relation_tuple(query, headers)
                 if route == ("PATCH", "/relation-tuples"):
-                    return self._patch_relation_tuples(body)
+                    return self._patch_relation_tuples(body, headers)
 
             err = KetoError("404 page not found")
             err.status_code = 404
@@ -246,22 +246,49 @@ class RestApp:
 
     # -- write ---------------------------------------------------------------
 
-    def _put_relation_tuple(self, body: bytes):
+    @staticmethod
+    def _idempotency_key_from(headers) -> Optional[str]:
+        """``X-Idempotency-Key`` on a write request opts into exactly-once
+        semantics: retried keys replay the original response (snaptoken +
+        ``X-Keto-Idempotent-Replay: true``) instead of re-applying."""
+        if not headers:
+            return None
+        return headers.get("x-idempotency-key") or None
+
+    @staticmethod
+    def _write_headers(result) -> dict[str, str]:
+        """Response headers for a write: the snaptoken the transaction
+        committed at (pin follow-up checks with ``?snaptoken=``; the
+        durability contract says an acknowledged token survives server
+        death) and the replay marker on deduplicated retries."""
+        if result is None:
+            return {}
+        out = {"X-Keto-Snaptoken": str(result.snaptoken)}
+        if result.replayed:
+            out["X-Keto-Idempotent-Replay"] = "true"
+        return out
+
+    def _put_relation_tuple(self, body: bytes, headers=None):
         try:
             obj = json.loads(body or b"{}")
         except json.JSONDecodeError as e:
             raise ErrBadRequest(str(e)) from None
         rel = RelationTuple.from_json(obj)
-        self.registry.relation_tuple_manager().write_relation_tuples(rel)
-        location = "/relation-tuples?" + rel.to_url_query()
-        return 201, rel.to_json(), {"Location": location}
+        result = self.registry.relation_tuple_manager().transact_relation_tuples(
+            [rel], (), idempotency_key=self._idempotency_key_from(headers)
+        )
+        resp = {"Location": "/relation-tuples?" + rel.to_url_query()}
+        resp.update(self._write_headers(result))
+        return 201, rel.to_json(), resp
 
-    def _delete_relation_tuple(self, query):
+    def _delete_relation_tuple(self, query, headers=None):
         rel = RelationTuple.from_url_query(query)
-        self.registry.relation_tuple_manager().delete_relation_tuples(rel)
-        return 204, None, {}
+        result = self.registry.relation_tuple_manager().transact_relation_tuples(
+            (), [rel], idempotency_key=self._idempotency_key_from(headers)
+        )
+        return 204, None, self._write_headers(result)
 
-    def _patch_relation_tuples(self, body: bytes):
+    def _patch_relation_tuples(self, body: bytes, headers=None):
         try:
             deltas = json.loads(body or b"[]")
         except json.JSONDecodeError as e:
@@ -280,8 +307,10 @@ class RestApp:
                 delete.append(RelationTuple.from_json(raw))
             else:
                 raise ErrBadRequest(f"unknown action {action}")
-        self.registry.relation_tuple_manager().transact_relation_tuples(insert, delete)
-        return 204, None, {}
+        result = self.registry.relation_tuple_manager().transact_relation_tuples(
+            insert, delete, idempotency_key=self._idempotency_key_from(headers)
+        )
+        return 204, None, self._write_headers(result)
 
 
 def _make_handler(app: RestApp):
@@ -292,23 +321,31 @@ def _make_handler(app: RestApp):
         server_version = "keto-tpu"
 
         def _serve(self, method: str):
-            parts = urlsplit(self.path)
-            query = parse_qs(parts.query, keep_blank_values=True)
-            length = int(self.headers.get("Content-Length") or 0)
-            body = self.rfile.read(length) if length else b""
-            req_headers = {k.lower(): v for k, v in self.headers.items()}
-            status, payload, headers = app.handle(
-                method, parts.path, query, body, req_headers
-            )
-            data = b"" if payload is None else json.dumps(payload).encode()
-            self.send_response(status)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(data)))
-            for k, v in headers.items():
-                self.send_header(k, v)
-            self.end_headers()
-            if data:
-                self.wfile.write(data)
+            # in-flight accounting for the SIGTERM drain: the exchange
+            # counts until the response bytes are handed to the kernel
+            with self.server.active_lock:
+                self.server.active_count += 1
+            try:
+                parts = urlsplit(self.path)
+                query = parse_qs(parts.query, keep_blank_values=True)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                req_headers = {k.lower(): v for k, v in self.headers.items()}
+                status, payload, headers = app.handle(
+                    method, parts.path, query, body, req_headers
+                )
+                data = b"" if payload is None else json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                if data:
+                    self.wfile.write(data)
+            finally:
+                with self.server.active_lock:
+                    self.server.active_count -= 1
 
         def log_message(self, fmt, *args):  # per-request logging, health excluded
             if not self.path.startswith("/health/"):
@@ -339,11 +376,25 @@ class RestServer:
         self.app = RestApp(registry, role)
         self.httpd = ThreadingHTTPServer((host or "0.0.0.0", port), _make_handler(self.app))
         self.httpd.daemon_threads = True
+        self.httpd.active_count = 0
+        self.httpd.active_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
     @property
     def port(self) -> int:
         return self.httpd.server_address[1]
+
+    def drain(self, timeout_s: float) -> bool:
+        """Wait until every accepted request has had its response written
+        (the SIGTERM drain seam). True when idle within ``timeout_s``."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while time.monotonic() < deadline:
+            with self.httpd.active_lock:
+                if self.httpd.active_count == 0:
+                    return True
+            time.sleep(0.01)
+        with self.httpd.active_lock:
+            return self.httpd.active_count == 0
 
     def start(self) -> None:
         self._thread = threading.Thread(

@@ -20,6 +20,20 @@ comma list of ``point:raise``, ``point:raise:<count>``, or
 module-level ``ACTIVE`` flag, so an unarmed build pays a single attribute
 load per instrumented call — and every instrumented site is per-batch or
 per-maintenance-pass, never per-query.
+
+CRASH POINTS: the kill-and-recover chaos harness (tests/chaos_runner.py)
+arms ``point:kill`` (die the first time the point passes) or
+``point:kill:<n>`` (die on the n-th pass) — the site calls ``os._exit``
+with no cleanup, the closest injectable analog of a SIGKILL landing at
+exactly that line. The durable write/maintenance sites are instrumented:
+
+- ``transact-commit`` — inside a write transaction, before COMMIT
+- ``transact-ack``    — after COMMIT, before the caller is answered
+  (the ambiguous-failure window idempotency keys exist for)
+- ``refresh-read``    — mid snapshot refresh
+- ``overlay-apply``   — mid delta-overlay application
+- ``compaction``      — mid overlay compaction
+- ``cache-save``      — mid snapshot-cache serialization
 """
 
 from __future__ import annotations
@@ -38,7 +52,19 @@ POINTS = (
     "cache-save",
     "compaction",
     "check-dispatch",
+    "transact-commit",
+    "transact-ack",
+    "overlay-apply",
 )
+
+#: process-exit hook for kill faults — a module seam so tests can observe
+#: the would-be death without actually dying (the chaos harness does NOT
+#: patch it: its subprocesses really die here)
+_EXIT = os._exit
+
+#: exit status a kill fault dies with (mirrors 128+SIGKILL, so the chaos
+#: runner can tell an injected crash from an ordinary failure)
+KILL_STATUS = 137
 
 #: fast gate: False ⇔ no fault armed anywhere. Instrumented sites read
 #: this once per call and skip the locked dict entirely when clear.
@@ -54,12 +80,21 @@ class FaultInjected(RuntimeError):
 
 
 class _Fault:
-    __slots__ = ("exc", "delay_s", "remaining")
+    __slots__ = ("exc", "delay_s", "remaining", "kill", "skip")
 
-    def __init__(self, exc, delay_s: float, remaining: Optional[int]):
+    def __init__(
+        self,
+        exc,
+        delay_s: float,
+        remaining: Optional[int],
+        kill: bool = False,
+        skip: int = 0,
+    ):
         self.exc = exc
         self.delay_s = delay_s
         self.remaining = remaining  # None = until cleared
+        self.kill = kill  # die via _EXIT instead of raising
+        self.skip = skip  # passes to let through before firing
 
 
 def inject(
@@ -68,13 +103,18 @@ def inject(
     exc=FaultInjected,
     delay_s: float = 0.0,
     count: Optional[int] = None,
+    kill: bool = False,
+    skip: int = 0,
 ) -> None:
-    """Arm ``point``: the next ``count`` passes (None = every pass until
-    ``clear``) sleep ``delay_s`` then raise ``exc(point)`` (pass
-    ``exc=None`` for a delay-only fault)."""
+    """Arm ``point``: after letting ``skip`` passes through untouched,
+    the next ``count`` passes (None = every pass until ``clear``) sleep
+    ``delay_s`` then raise ``exc(point)`` (pass ``exc=None`` for a
+    delay-only fault). With ``kill=True`` the firing pass instead exits
+    the process via ``os._exit(KILL_STATUS)`` — an injected SIGKILL at
+    exactly that site."""
     global ACTIVE
     with _lock:
-        _faults[point] = _Fault(exc, delay_s, count)
+        _faults[point] = _Fault(exc, delay_s, count, kill=kill, skip=skip)
         ACTIVE = True
 
 
@@ -118,12 +158,20 @@ def check(point: str) -> None:
         f = _faults.get(point)
         if f is None:
             return
+        if f.skip > 0:
+            f.skip -= 1
+            return
         if f.remaining is not None:
             if f.remaining <= 0:
                 return
             f.remaining -= 1
         _hits[point] = _hits.get(point, 0) + 1
-        exc, delay_s = f.exc, f.delay_s
+        exc, delay_s, kill = f.exc, f.delay_s, f.kill
+    if kill:
+        # no cleanup, no atexit, no flushing — the closest injectable
+        # analog of SIGKILL landing at this exact line
+        _EXIT(KILL_STATUS)
+        return  # only reachable when a test monkeypatched _EXIT
     if delay_s:
         time.sleep(delay_s)
     if exc is not None:
@@ -133,7 +181,10 @@ def check(point: str) -> None:
 def load_env(spec: Optional[str] = None) -> None:
     """Parse a ``KETO_TPU_FAULTS`` spec (default: the live env var) into
     armed faults. Unknown/malformed entries are ignored — a typo'd env
-    var must never take a serving process down."""
+    var must never take a serving process down. Kinds: ``point:raise``
+    (every pass), ``point:raise:<count>`` (the next count passes),
+    ``point:delay=<seconds>``, ``point:kill`` (die on the first pass),
+    ``point:kill:<n>`` (die on the n-th pass)."""
     spec = os.environ.get("KETO_TPU_FAULTS", "") if spec is None else spec
     for entry in spec.split(","):
         entry = entry.strip()
@@ -144,6 +195,11 @@ def load_env(spec: Optional[str] = None) -> None:
         try:
             if kind == "raise":
                 inject(point, count=int(arg) if arg else None)
+            elif kind == "kill":
+                nth = int(arg) if arg else 1
+                if nth < 1:
+                    continue
+                inject(point, kill=True, skip=nth - 1, count=1)
             elif kind.startswith("delay="):
                 inject(point, exc=None, delay_s=float(kind[6:]))
         except ValueError:

@@ -1,0 +1,93 @@
+"""Chaos-harness daemon: a real keto-tpu server in its own process.
+
+tests/test_chaos.py spawns this script as a subprocess, arms a crash
+point through ``KETO_TPU_FAULTS`` (``<point>:kill:<n>`` — the site calls
+``os._exit`` on its n-th pass, the injectable analog of SIGKILL landing
+mid-write, mid-compaction, mid-cache-save, …), drives concurrent traffic
+at it until it dies, restarts it clean, and verifies the recovery
+invariants. This wrapper exists so the DEATH is real: a process exit with
+no rollback, no atexit, no flushing — exception-based fault injection
+(tests/test_faults.py) can never prove durability, only error handling.
+
+Run: ``python tests/chaos_runner.py --dsn sqlite://<file>
+--cache-dir <dir> --port-file <path>`` — serves the read and write APIs
+on ephemeral ports, publishes them (atomically) to ``--port-file`` as
+JSON ``{"read": .., "write": .., "pid": ..}``, then blocks until
+SIGTERM/SIGINT and exits through the graceful drain path (exit 0).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+# run as a script (python tests/chaos_runner.py): the repo root, not
+# tests/, must be importable
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+#: namespace config shared with the parent test (it builds the CPU
+#: reference oracle over the same store, so the ids must agree)
+NAMESPACES = [{"id": 0, "name": "docs"}, {"id": 1, "name": "groups"}]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dsn", required=True)
+    ap.add_argument("--cache-dir", required=True)
+    ap.add_argument("--port-file", required=True)
+    ap.add_argument("--overlay-budget", type=int, default=24)
+    ap.add_argument("--drain-timeout-s", type=float, default=5.0)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": NAMESPACES,
+            "dsn": args.dsn,
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "serve.snapshot_cache_dir": args.cache_dir,
+            # small budget so a few dozen writes already exercise the
+            # compaction path (and its crash point)
+            "serve.overlay_edge_budget": args.overlay_budget,
+            "serve.drain_timeout_s": args.drain_timeout_s,
+            "engine.batch_window_ms": 0.5,
+        }
+    )
+    daemon = Daemon(Registry(cfg))
+    daemon.install_signal_handlers()
+    daemon.serve_all(block=False)
+
+    ports = {"read": daemon.read_port, "write": daemon.write_port, "pid": os.getpid()}
+    # atomic publish: the parent polls this file and must never read a
+    # half-written JSON
+    target = Path(args.port_file)
+    fd, tmp = tempfile.mkstemp(dir=target.parent, prefix=".ports-")
+    with os.fdopen(fd, "w") as f:
+        json.dump(ports, f)
+    os.replace(tmp, target)
+
+    # block until a shutdown signal, then leave through the drain path —
+    # every clean exit in the chaos loop also regression-tests SIGTERM
+    daemon._stop_requested.wait()
+    try:
+        daemon.drain_and_shutdown()
+    except BaseException:
+        # a failed drain is a real finding: leave the traceback in the
+        # harness log and exit distinctly from a generic crash
+        import traceback
+
+        traceback.print_exc()
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,351 @@
+"""Kill-and-recover chaos harness: crash safety proven by real deaths.
+
+Each cycle arms ONE crash point (``KETO_TPU_FAULTS=<point>:kill:<n>`` —
+``os._exit`` at that site, the injectable analog of SIGKILL) in a real
+daemon subprocess (tests/chaos_runner.py), drives keyed writes and checks
+at it until it dies mid-flight, restarts it clean over the same sqlite
+file + snapshot-cache dir, and verifies the recovery invariants:
+
+- every ACKNOWLEDGED write is visible after recovery and its snaptoken is
+  satisfiable (the zookie durability contract: an acked token survives
+  server death);
+- the store watermark is monotone across restarts;
+- a keyed write that died AMBIGUOUSLY (connection lost mid-request)
+  retries safely: if the commit landed the retry REPLAYS the original
+  snaptoken (X-Keto-Idempotent-Replay) and the store holds exactly one
+  application; if it did not land, the retry applies fresh;
+- post-recovery check AND expand answers are bit-identical to the CPU
+  reference engines reading the same store (a torn snapshot cache must be
+  rejected — never serve wrong decisions);
+- the clean daemon of every cycle exits 0 through the SIGTERM drain path.
+
+Cycles/seed scale via KETO_CHAOS_CYCLES / KETO_CHAOS_SEED (CI chaos-smoke
+runs a bigger fixed set; the default covers every crash point once).
+"""
+
+import json
+import os
+import random
+import signal
+import sqlite3
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.httpclient import KetoClient
+from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+from tests.chaos_runner import NAMESPACES
+
+REPO = Path(__file__).resolve().parents[1]
+
+#: the armed sites, in rotation — every default run covers each once
+CRASH_POINTS = [
+    "transact-ack",      # post-COMMIT, pre-ack: the ambiguous window
+    "transact-commit",   # pre-COMMIT: the write must NOT survive
+    "overlay-apply",     # mid delta application
+    "cache-save",        # mid snapshot-cache serialization
+    "refresh-read",      # mid snapshot refresh (often at boot warm)
+    "compaction",        # mid overlay compaction
+]
+
+CYCLES = int(os.environ.get("KETO_CHAOS_CYCLES", len(CRASH_POINTS)))
+SEED = int(os.environ.get("KETO_CHAOS_SEED", "0"))
+WRITES_PER_CYCLE = 24
+
+
+def T(obj, sub):
+    return RelationTuple(
+        namespace="docs", object=obj, relation="view", subject=SubjectID(sub)
+    )
+
+
+class DaemonProc:
+    """One chaos_runner subprocess plus its published ports."""
+
+    def __init__(self, dbfile: Path, cache_dir: Path, workdir: Path, faults: str = ""):
+        self.port_file = workdir / f"ports-{os.urandom(4).hex()}.json"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)  # single-device is plenty (and faster to boot)
+        if faults:
+            env["KETO_TPU_FAULTS"] = faults
+        else:
+            env.pop("KETO_TPU_FAULTS", None)
+        # daemon output lands in a per-process log for post-mortems
+        self.log = open(workdir / f"daemon-{os.urandom(4).hex()}.log", "wb")
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, str(REPO / "tests" / "chaos_runner.py"),
+                "--dsn", f"sqlite://{dbfile}",
+                "--cache-dir", str(cache_dir),
+                "--port-file", str(self.port_file),
+            ],
+            cwd=REPO,
+            env=env,
+            stdout=self.log,
+            stderr=self.log,
+        )
+        self.ports = None
+
+    def wait_ports(self, timeout=90.0):
+        """Ports once the daemon is up, or None if it died first (a
+        crash point armed at a boot-path site is a legitimate outcome)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.port_file.is_file():
+                try:
+                    self.ports = json.loads(self.port_file.read_text())
+                except json.JSONDecodeError:
+                    pass  # mid-rename race; retry
+                else:
+                    return self.ports
+            if self.proc.poll() is not None:
+                return None
+            time.sleep(0.05)
+        raise AssertionError("daemon neither published ports nor died")
+
+    def client(self, retry_max_wait_s=0.0) -> KetoClient:
+        assert self.ports
+        return KetoClient(
+            f"http://127.0.0.1:{self.ports['read']}",
+            f"http://127.0.0.1:{self.ports['write']}",
+            timeout=20.0,
+            retry_max_wait_s=retry_max_wait_s,
+        )
+
+    def wait_alive(self, timeout=30.0) -> bool:
+        assert self.ports
+        deadline = time.monotonic() + timeout
+        url = f"http://127.0.0.1:{self.ports['read']}/health/alive"
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                return False
+            try:
+                with urllib.request.urlopen(url, timeout=2) as resp:
+                    if resp.status == 200:
+                        return True
+            except Exception:
+                time.sleep(0.05)
+        return False
+
+    def wait_death(self, timeout=30.0):
+        """Exit code, SIGKILLing as a fallback when the armed point never
+        fired (e.g. compaction armed but the cycle never tripped the
+        budget) so every cycle still kills and recovers."""
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+        return self.proc.returncode
+
+    def terminate_gracefully(self, timeout=30.0) -> int:
+        self.proc.send_signal(signal.SIGTERM)
+        return self.proc.wait(timeout=timeout)
+
+    def log_tail(self, nbytes=4000) -> str:
+        try:
+            self.log.flush()
+            data = Path(self.log.name).read_bytes()
+            return data[-nbytes:].decode(errors="replace")
+        except Exception as e:
+            return f"<log unreadable: {e}>"
+
+    def kill(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+        self.log.close()
+
+
+def read_watermark(dbfile: Path) -> int:
+    """The durable watermark, read directly from the sqlite file (the
+    daemon may be up or down — reads don't need it)."""
+    conn = sqlite3.connect(dbfile, timeout=10)
+    try:
+        row = conn.execute(
+            "SELECT watermark FROM keto_watermarks WHERE nid = 'default'"
+        ).fetchone()
+        return int(row[0]) if row else 0
+    finally:
+        conn.close()
+
+
+def _sent_but_lost(exc: BaseException) -> bool:
+    """True when the request may have REACHED the server (ambiguous: the
+    connection died mid-request/mid-response). Connection-refused means
+    the daemon was already gone — unambiguously not applied."""
+    reason = getattr(exc, "reason", exc)
+    return not isinstance(reason, ConnectionRefusedError)
+
+
+def _local_oracles(dbfile: Path):
+    """CPU reference engines over the same sqlite file — the parity
+    baseline the recovered daemon must match bit-for-bit."""
+    from keto_tpu.check.engine import CheckEngine
+    from keto_tpu.expand.engine import ExpandEngine
+    from keto_tpu.persistence.sqlite import SQLitePersister
+
+    nm = namespace_pkg.MemoryManager(
+        [namespace_pkg.Namespace(id=n["id"], name=n["name"]) for n in NAMESPACES]
+    )
+    store = SQLitePersister(f"sqlite://{dbfile}", nm)
+    return store, CheckEngine(store), ExpandEngine(store)
+
+
+def test_chaos_kill_and_recover(tmp_path):
+    dbfile = tmp_path / "chaos.db"
+    cache_dir = tmp_path / "snapcache"
+    acked: dict[str, tuple[RelationTuple, int]] = {}  # key -> (tuple, snaptoken)
+    max_wm = 0
+    replays_seen = 0
+
+    for cycle in range(CYCLES):
+        rng = random.Random(SEED * 7919 + cycle)
+        point = CRASH_POINTS[cycle % len(CRASH_POINTS)]
+        nth = rng.randint(1, 3)
+
+        # -- phase 1: armed daemon, drive load until it dies -------------
+        victim = DaemonProc(dbfile, cache_dir, tmp_path, faults=f"{point}:kill:{nth}")
+        ambiguous: list[tuple[str, RelationTuple]] = []
+        failed_refused: list[tuple[str, RelationTuple]] = []
+        try:
+            if victim.wait_ports() is not None and victim.wait_alive():
+                client = victim.client()
+                for i in range(WRITES_PER_CYCLE):
+                    key = f"c{cycle}-w{i}"
+                    t = T(f"c{cycle}-o{i}", f"u{rng.randrange(6)}")
+                    try:
+                        resp = client.patch_relation_tuples([t], idempotency_key=key)
+                        assert resp.snaptoken is not None
+                        acked[key] = (t, resp.snaptoken)
+                        max_wm = max(max_wm, resp.snaptoken)
+                    except Exception as e:
+                        if _sent_but_lost(e):
+                            ambiguous.append((key, t))
+                        else:
+                            failed_refused.append((key, t))
+                        break  # daemon is dying; stop driving it
+                    # checks between writes keep the snapshot machinery
+                    # (delta apply, compaction, cache save) hot so the
+                    # maintenance crash points get passes to fire on
+                    try:
+                        client.check(t)
+                    except Exception:
+                        pass
+            code = victim.wait_death()
+            assert code != 0, "armed daemon exited cleanly; crash never happened"
+        finally:
+            victim.kill()
+
+        # -- phase 2: clean restart over the same durable state ----------
+        survivor = DaemonProc(dbfile, cache_dir, tmp_path)
+        try:
+            assert survivor.wait_ports() is not None, "clean daemon died at boot"
+            assert survivor.wait_alive(), "clean daemon never became alive"
+            client = survivor.client(retry_max_wait_s=4.0)
+
+            # ambiguous keyed writes retry safely: dedup replays a landed
+            # commit (transact-ack kills MUST replay — the kill fired
+            # after COMMIT), a lost one applies fresh (transact-commit
+            # kills MUST NOT replay — the kill fired before COMMIT)
+            for key, t in ambiguous + failed_refused:
+                resp = client.patch_relation_tuples([t], idempotency_key=key)
+                assert resp.snaptoken is not None
+                if (key, t) in ambiguous:
+                    if point == "transact-ack":
+                        assert resp.replayed, (
+                            f"cycle {cycle}: post-commit crash retry did not replay"
+                        )
+                    if point == "transact-commit":
+                        assert not resp.replayed, (
+                            f"cycle {cycle}: pre-commit crash retry claims replay"
+                        )
+                replays_seen += int(resp.replayed)
+                acked[key] = (t, resp.snaptoken)
+                max_wm = max(max_wm, resp.snaptoken)
+
+            # watermark monotone across the crash/restart boundary
+            wm_now = read_watermark(dbfile)
+            assert wm_now >= max_wm, (
+                f"cycle {cycle}: watermark regressed {max_wm} -> {wm_now}"
+            )
+            max_wm = wm_now
+
+            # every acknowledged write visible, its snaptoken satisfiable
+            for key, (t, token) in acked.items():
+                assert client.check(t, snaptoken=token), (
+                    f"cycle {cycle}: acked write {key} (token {token}) lost"
+                )
+
+            # exactly one application per keyed write of this cycle
+            from keto_tpu.relationtuple.model import RelationQuery
+
+            for i in range(WRITES_PER_CYCLE):
+                key = f"c{cycle}-w{i}"
+                if key not in acked:
+                    continue
+                t = acked[key][0]
+                got = client.get_relation_tuples(
+                    RelationQuery(
+                        namespace=t.namespace, object=t.object,
+                        relation=t.relation, subject_id=t.subject.id,
+                    )
+                )
+                assert len(got.relation_tuples) == 1, (
+                    f"cycle {cycle}: {key} applied "
+                    f"{len(got.relation_tuples)} times"
+                )
+
+            # post-recovery decisions bit-identical to the CPU reference
+            store, check_oracle, expand_oracle = _local_oracles(dbfile)
+            try:
+                battery = [t for t, _ in acked.values()]
+                battery += [
+                    T(f"c{cycle}-o{rng.randrange(WRITES_PER_CYCLE)}", "ghost")
+                    for _ in range(8)
+                ]
+                battery.append(
+                    RelationTuple(
+                        namespace="docs", object=f"c{cycle}-o0", relation="view",
+                        subject=SubjectSet("groups", "nope", "member"),
+                    )
+                )
+                for t in battery:
+                    want = check_oracle.subject_is_allowed(t)
+                    got = client.check(t, snaptoken=max_wm)
+                    assert got == want, (
+                        f"cycle {cycle}: check parity mismatch on {t} "
+                        f"(daemon={got}, reference={want})"
+                    )
+                for i in (0, WRITES_PER_CYCLE // 2):
+                    subject = SubjectSet("docs", f"c{cycle}-o{i}", "view")
+                    want_tree = expand_oracle.build_tree(subject, 4)
+                    got_tree = client.expand("docs", f"c{cycle}-o{i}", "view", 4)
+                    want_json = None if want_tree is None else want_tree.to_json()
+                    got_json = None if got_tree is None else got_tree.to_json()
+                    assert got_json == want_json, (
+                        f"cycle {cycle}: expand parity mismatch on {subject}"
+                    )
+            finally:
+                store.close()
+
+            # leave through the SIGTERM drain path: the clean daemon of
+            # every cycle is also a rolling-restart regression test
+            code = survivor.terminate_gracefully()
+            assert code == 0, (
+                f"cycle {cycle}: graceful shutdown exited {code}; "
+                f"daemon log tail:\n{survivor.log_tail()}"
+            )
+        finally:
+            survivor.kill()
+
+    # at least the transact-ack cycles must have produced real replays
+    if CYCLES >= len(CRASH_POINTS):
+        assert replays_seen >= 1, "no ambiguous retry ever replayed — dedup untested"

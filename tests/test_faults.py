@@ -507,4 +507,167 @@ def test_env_trigger_parsing():
 def test_inactive_harness_is_free():
     faults.clear()
     assert faults.ACTIVE is False
+
+
+# -- crash points (the kill action the chaos harness arms) --------------------
+
+
+def test_kill_env_parsing_fires_exit(monkeypatch):
+    deaths = []
+    monkeypatch.setattr(faults, "_EXIT", lambda status: deaths.append(status))
+    faults.load_env("transact-commit:kill")
+    faults.check("transact-commit")
+    assert deaths == [faults.KILL_STATUS]
+    # one-shot: the armed kill fired; later passes are clean
+    faults.check("transact-commit")
+    assert deaths == [faults.KILL_STATUS]
+
+
+def test_kill_nth_pass_skips_then_fires(monkeypatch):
+    deaths = []
+    monkeypatch.setattr(faults, "_EXIT", lambda status: deaths.append(status))
+    faults.load_env("cache-save:kill:3")
+    faults.check("cache-save")
+    faults.check("cache-save")
+    assert deaths == []  # passes 1 and 2 let through
+    faults.check("cache-save")
+    assert deaths == [faults.KILL_STATUS]
+    assert faults.hits("cache-save") == 1  # skipped passes are not hits
+
+
+def test_kill_env_malformed_specs_ignored(monkeypatch):
+    deaths = []
+    monkeypatch.setattr(faults, "_EXIT", lambda status: deaths.append(status))
+    faults.load_env("p1:kill:0,p2:kill:-3,p3:kill:x")
+    for p in ("p1", "p2", "p3"):
+        faults.check(p)
+    assert deaths == []  # a typo'd env var must never kill a server
+
+
+def test_programmatic_kill_inject(monkeypatch):
+    deaths = []
+    monkeypatch.setattr(faults, "_EXIT", lambda status: deaths.append(status))
+    faults.inject("overlay-apply", kill=True, skip=1, count=1)
+    faults.check("overlay-apply")
+    faults.check("overlay-apply")
+    assert deaths == [faults.KILL_STATUS]
+
+
+# -- idempotent transact: the ambiguous-failure window ------------------------
+
+
+def _ambiguous_retry_scenario(p):
+    """Arm the post-COMMIT/pre-ack window, transact with a key, observe
+    the ambiguous failure, retry: the retry must REPLAY (same snaptoken,
+    nothing re-applied)."""
+    t = T("docs", "readme", "view", SubjectID("alice"))
+    with faults.injected("transact-ack", count=1):
+        with pytest.raises(faults.FaultInjected):
+            p.transact_relation_tuples([t], (), idempotency_key="k-ambig")
+    # the commit landed before the (injected) connection loss…
+    assert p.watermark() == 1
+    # …so the retry replays the original response instead of re-applying
+    res = p.transact_relation_tuples([t], (), idempotency_key="k-ambig")
+    assert res.replayed is True
+    assert res.snaptoken == 1
+    rows, wm = p.snapshot_rows()
+    assert len(rows) == 1, "retried keyed transact double-applied"
+    assert wm == 1
+
+
+def test_ambiguous_keyed_retry_replays_memory(make_persister):
+    _ambiguous_retry_scenario(make_persister([("docs", 0)]))
+
+
+def test_ambiguous_keyed_retry_replays_sqlite(tmp_path):
+    from keto_tpu.persistence.sqlite import SQLitePersister
+
+    nm = namespace_pkg.MemoryManager([namespace_pkg.Namespace(id=0, name="docs")])
+    _ambiguous_retry_scenario(SQLitePersister(f"sqlite://{tmp_path/'a.db'}", nm))
+
+
+def test_precommit_fault_applies_nothing(make_persister):
+    """The other half of the window: a failure BEFORE commit leaves no
+    trace, and the retry applies fresh (no replay)."""
+    p = make_persister([("docs", 0)])
+    t = T("docs", "readme", "view", SubjectID("alice"))
+    with faults.injected("transact-commit", count=1):
+        with pytest.raises(faults.FaultInjected):
+            p.transact_relation_tuples([t], (), idempotency_key="k-pre")
+    assert p.watermark() == 0
+    assert p.snapshot_rows()[0] == []
+    res = p.transact_relation_tuples([t], (), idempotency_key="k-pre")
+    assert res.replayed is False
+    assert len(p.snapshot_rows()[0]) == 1
+
+
+def test_sql_reconnect_retries_reads_and_keyed_writes(tmp_path):
+    """Mid-query connection loss: reads reconnect+retry transparently;
+    writes only when keyed (a blind unkeyed resend could double-apply)."""
+    import sqlite3 as _sqlite3
+
+    from keto_tpu.persistence.sqlite import SQLitePersister
+
+    class DropOnce(SQLitePersister):
+        """Simulates a server dropping the connection: the next statement
+        raises a disconnect-shaped error and the connection is poisoned
+        until re-dialed."""
+
+        def __init__(self, *a, **kw):
+            self.drop_next = False
+            super().__init__(*a, **kw)
+
+        def _is_disconnect(self, exc):
+            return isinstance(exc, ConnectionResetError)
+
+        def _exec(self, sql, params=()):
+            if self.drop_next:
+                self.drop_next = False
+                try:
+                    self._box.conn.close()  # poison: later statements fail too
+                except Exception:
+                    pass
+                raise ConnectionResetError("server closed the connection")
+            try:
+                return super()._exec(sql, params)
+            except _sqlite3.ProgrammingError as e:
+                raise ConnectionResetError(str(e)) from None  # closed conn
+
+        # sqlite re-opens the same file — the "server" came back
+    nm = namespace_pkg.MemoryManager([namespace_pkg.Namespace(id=0, name="docs")])
+    p = DropOnce(f"sqlite://{tmp_path/'r.db'}", nm)
+    p.reconnect_max_wait_s = 5.0
+    t = T("docs", "readme", "view", SubjectID("alice"))
+    p.transact_relation_tuples([t], (), idempotency_key="w1")
+
+    # reads: drop mid-query → reconnect → answer
+    p.drop_next = True
+    rows, wm = p.snapshot_rows()
+    assert (len(rows), wm) == (1, 1)
+    assert p.reconnects == 1
+    p.drop_next = True
+    assert p.watermark() == 1
+    assert p.reconnects == 2
+
+    # keyed write: drop mid-transaction → reconnect → retried exactly once
+    p.drop_next = True
+    res = p.transact_relation_tuples(
+        [T("docs", "readme", "view", SubjectID("bob"))], (), idempotency_key="w2"
+    )
+    assert res.replayed is False and res.snaptoken == 2
+    assert p.reconnects == 3
+    assert len(p.snapshot_rows()[0]) == 2
+
+    # unkeyed write: reconnects (so the NEXT call works) but does NOT
+    # retry — the failure surfaces to the caller
+    p.drop_next = True
+    with pytest.raises(ConnectionResetError):
+        p.transact_relation_tuples(
+            [T("docs", "readme", "view", SubjectID("carol"))], ()
+        )
+    assert p.reconnects == 4
+    assert len(p.snapshot_rows()[0]) == 2  # nothing applied
+    p.transact_relation_tuples([T("docs", "readme", "view", SubjectID("carol"))], ())
+    assert len(p.snapshot_rows()[0]) == 3  # the manual retry lands
+    p.close()
     faults.check("refresh-read")  # no-op, no raise

@@ -68,6 +68,46 @@ def T(ns, obj, rel, sub_id=None, sub_set=None):
     return acl_pb2.RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
 
 
+def test_transact_idempotency_metadata_replays(channels):
+    """x-idempotency-key metadata (the gRPC face of X-Idempotency-Key):
+    a retried key re-applies nothing, answers the ORIGINAL snaptoken, and
+    flags the replay via keto-idempotent-replay trailing metadata."""
+    read, write = channels
+    req = write_service_pb2.TransactRelationTuplesRequest(
+        relation_tuple_deltas=[
+            write_service_pb2.RelationTupleDelta(
+                action=write_service_pb2.RelationTupleDelta.INSERT,
+                relation_tuple=T("videos", "idem-v", "view", sub_id="ida"),
+            )
+        ]
+    )
+    call = write.unary_unary(
+        "/ory.keto.acl.v1alpha1.WriteService/TransactRelationTuples",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=write_service_pb2.TransactRelationTuplesResponse.FromString,
+    )
+    md = (("x-idempotency-key", "grpc-key-1"),)
+    first, call1 = call.with_call(req, metadata=md)
+    assert dict(call1.trailing_metadata()).get("keto-idempotent-replay") is None
+
+    second, call2 = call.with_call(req, metadata=md)
+    assert second.snaptokens[0] == first.snaptokens[0]
+    assert dict(call2.trailing_metadata()).get("keto-idempotent-replay") == "true"
+
+    listing = _unary(
+        read,
+        "/ory.keto.acl.v1alpha1.ReadService/ListRelationTuples",
+        read_service_pb2.ListRelationTuplesRequest(
+            query=read_service_pb2.ListRelationTuplesRequest.Query(
+                namespace="videos", object="idem-v", relation="view",
+                subject=acl_pb2.Subject(id="ida"),
+            )
+        ),
+        read_service_pb2.ListRelationTuplesResponse,
+    )
+    assert len(listing.relation_tuples) == 1, "keyed gRPC retry double-applied"
+
+
 def test_transact_and_check(channels):
     read, write = channels
     deltas = [

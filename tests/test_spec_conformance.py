@@ -253,6 +253,108 @@ def test_expired_deadline_conforms_end_to_end(daemon):
     _validate("/check", "GET", status, body)
 
 
+def _request_h(port, method, path, query=None, body=None, headers=None):
+    """(status, parsed-JSON body or None, response headers)."""
+    url = f"http://127.0.0.1:{port}{path}"
+    if query:
+        url += "?" + urllib.parse.urlencode(query)
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None, dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None, dict(e.headers)
+
+
+def test_idempotent_replay_conforms(daemon):
+    """The declared idempotency contract, end to end: same key twice →
+    both 201 with the declared body, identical X-Keto-Snaptoken, and the
+    declared X-Keto-Idempotent-Replay marker only on the replay — with
+    exactly one stored application."""
+    put = {
+        "namespace": "teams", "object": "sre", "relation": "member",
+        "subject_id": "ida",
+    }
+    key = {"X-Idempotency-Key": "conformance-key-1"}
+    status, body, h1 = _request_h(
+        daemon.write_port, "PUT", "/relation-tuples", body=put, headers=key
+    )
+    assert status == 201
+    _validate("/relation-tuples", "PUT", status, body)
+    assert h1.get("X-Keto-Snaptoken")
+    assert "X-Keto-Idempotent-Replay" not in h1
+
+    status, body, h2 = _request_h(
+        daemon.write_port, "PUT", "/relation-tuples", body=put, headers=key
+    )
+    assert status == 201
+    _validate("/relation-tuples", "PUT", status, body)
+    assert h2.get("X-Keto-Snaptoken") == h1.get("X-Keto-Snaptoken")
+    assert h2.get("X-Keto-Idempotent-Replay") == "true"
+
+    status, listing = _request(
+        daemon.read_port, "GET", "/relation-tuples",
+        query={"namespace": "teams", "object": "sre", "relation": "member",
+               "subject_id": "ida"},
+    )
+    assert status == 200
+    assert len(listing["relation_tuples"]) == 1, "keyed retry double-applied"
+
+    # PATCH declares the same headers
+    status, _, h3 = _request_h(
+        daemon.write_port, "PATCH", "/relation-tuples",
+        body=[{"action": "delete", "relation_tuple": put}],
+        headers={"X-Idempotency-Key": "conformance-key-2"},
+    )
+    assert status == 204
+    assert h3.get("X-Keto-Snaptoken")
+
+
+def test_idempotency_key_gc_conforms(daemon):
+    """Past serve.idempotency_ttl_s the key is forgotten: the dedup
+    table GCs it and a resend applies as a fresh write (new snaptoken,
+    no replay marker)."""
+    import time
+
+    manager = daemon.registry.relation_tuple_manager()
+    old_ttl = manager.idempotency_ttl_s
+    put = {
+        "namespace": "teams", "object": "gc", "relation": "member",
+        "subject_id": "gil",
+    }
+    key = {"X-Idempotency-Key": "conformance-gc-key"}
+    try:
+        status, _, h1 = _request_h(
+            daemon.write_port, "PUT", "/relation-tuples", body=put, headers=key
+        )
+        assert status == 201 and "X-Keto-Idempotent-Replay" not in h1
+        manager.idempotency_ttl_s = 0.0
+        time.sleep(1.1)  # sql created_at has second granularity
+        # any later keyed write sweeps expired keys
+        _request_h(
+            daemon.write_port, "PATCH", "/relation-tuples",
+            body=[{"action": "insert", "relation_tuple": {
+                "namespace": "teams", "object": "gc2", "relation": "member",
+                "subject_id": "gil"}}],
+            headers={"X-Idempotency-Key": "conformance-gc-sweeper"},
+        )
+        status, _, h2 = _request_h(
+            daemon.write_port, "PUT", "/relation-tuples", body=put, headers=key
+        )
+        assert status == 201
+        assert "X-Keto-Idempotent-Replay" not in h2, "expired key replayed"
+        assert h2.get("X-Keto-Snaptoken") != h1.get("X-Keto-Snaptoken")
+    finally:
+        manager.idempotency_ttl_s = old_ttl
+
+
 def test_spec_definitions_are_valid_schemas():
     """Every definition must itself be a valid draft-4 schema (catches
     spec edits that silently disable validation)."""
