@@ -179,7 +179,24 @@ CONFIG_SCHEMA = {
                 },
             },
         },
-        "profiling": {"type": "string", "enum": ["", "cpu", "mem"], "default": ""},
+        "profiling": {
+            "type": "string",
+            "enum": ["", "cpu", "mem", "trace"],
+            "default": "",
+            "description": "Process profiler: 'cpu' (cProfile), 'mem' (tracemalloc), or 'trace' (jax.profiler device timeline — no-op when jax/its profiler backend is unavailable). Stats land on stderr at clean shutdown; traces under KETO_TPU_TRACE_DIR (default ./keto-tpu-trace).",
+        },
+        "metrics": {
+            "type": "object",
+            "additionalProperties": False,
+            "description": "Prometheus exposition of the process-wide MetricsRegistry (keto_tpu/x/metrics.py) at GET /metrics on both API ports: request counters and latency histograms (trace-exemplared), batcher queue/shed gauges, engine slice service times, maintenance, health, tracer, and persistence counters.",
+            "properties": {
+                "enabled": {
+                    "type": "boolean",
+                    "default": True,
+                    "description": "false swaps in a no-op registry (recording sites stay, cost nothing) and /metrics answers 404.",
+                }
+            },
+        },
         "telemetry": {
             "type": "object",
             "additionalProperties": False,

@@ -210,6 +210,12 @@ class CheckBatcher:
         with self._inflight_lock:
             return self._inflight
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests queued but not yet packed into a device batch (the
+        /metrics pressure gauge; approximate by nature)."""
+        return self._queue.qsize()
+
     def drain(self, timeout_s: float) -> bool:
         """Wait until every in-flight request has been answered (the
         SIGTERM drain seam: new traffic is already shed by the health

@@ -152,6 +152,17 @@ class Daemon:
             drain = getattr(role.rest, "drain", None)
             if drain is not None:
                 drain(max(0.5, deadline - time.monotonic()))
+        # drain the TRACER too: the otlp-http exporter batches spans on a
+        # background thread, and a SIGTERM that tears the stacks down
+        # while a batch is queued (or held by the worker) would drop the
+        # very spans that explain the final requests. close() flushes and
+        # joins the exporter — inside the drain window, before teardown.
+        tracer = self.registry.peek("tracer")
+        if tracer is not None:
+            try:
+                tracer.close()
+            except Exception:
+                pass  # telemetry never blocks shutdown
         self.shutdown()
 
     def _warm_snapshot(self) -> None:

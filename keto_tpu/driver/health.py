@@ -90,6 +90,12 @@ class HealthMonitor:
     def staleness_budget_s(self) -> float:
         return self._budget
 
+    @property
+    def transitions(self) -> int:
+        """State transitions observed since boot (the /metrics counter)."""
+        with self._lock:
+            return self._transitions
+
     # -- the state machine ---------------------------------------------------
 
     def status(self) -> tuple[HealthState, str]:

@@ -114,7 +114,7 @@ class Registry:
             if backend != "oracle" and hasattr(store, "snapshot_rows"):
                 from keto_tpu.check.tpu_engine import TpuCheckEngine
 
-                return TpuCheckEngine(
+                engine = TpuCheckEngine(
                     store,
                     self.namespaces_source(),
                     it_cap=int(self._config.get("engine.it_cap", 4096)),
@@ -136,6 +136,16 @@ class Registry:
                         self._config.get("serve.degraded_probe_s", 5.0)
                     ),
                 )
+                # mirror per-slice service times into /metrics — the same
+                # numbers the adaptive width controller steers by
+                engine.stream_slice_stats.attach_histogram(
+                    self.metrics().histogram(
+                        "keto_engine_stream_slice_duration_seconds",
+                        "Per-slice device service time of the streaming "
+                        "check pipeline (what StreamSliceController steers by).",
+                    )
+                )
+                return engine
             return CheckEngine(store)
 
         return self._memo("permission_engine", build)
@@ -195,6 +205,203 @@ class Registry:
         )
 
     # -- observability -------------------------------------------------------
+
+    def metrics(self):
+        """The process-wide MetricsRegistry (keto_tpu/x/metrics.py),
+        bridged from every existing stat sink: REST/gRPC layers record
+        their request counters/histograms directly, while the batcher,
+        engine maintenance, health machine, tracer, and persister are
+        read through scrape-time callbacks — their hot paths never learn
+        about Prometheus. ``metrics.enabled: false`` swaps in the no-op
+        registry (and /metrics answers 404)."""
+
+        def build():
+            from keto_tpu.x.metrics import MetricsRegistry, NullMetricsRegistry
+
+            if not bool(self._config.get("metrics.enabled", True)):
+                return NullMetricsRegistry()
+            m = MetricsRegistry()
+            m.gauge(
+                "keto_build_info",
+                "Always 1; the version label identifies the running build.",
+                ("version",),
+            ).set((VERSION,), 1)
+            # engine slice service times: the SAME numbers the adaptive
+            # stream-width controller steers by, mirrored from the
+            # engine's DurationStats (attached in permission_engine())
+            m.histogram(
+                "keto_engine_stream_slice_duration_seconds",
+                "Per-slice device service time of the streaming check "
+                "pipeline (what StreamSliceController steers by).",
+            )
+            # request families are declared eagerly (the serving layers
+            # re-declare idempotently) so a scrape before first traffic
+            # already exposes the full documented family set
+            from keto_tpu.servers.grpc_api import _request_metrics
+
+            _request_metrics(m)
+            self._register_metric_bridges(m)
+            return m
+
+        return self._memo("metrics", build)
+
+    def _register_metric_bridges(self, m) -> None:
+        """Scrape-time callbacks over already-built components. They read
+        through ``peek`` so a scrape never constructs (or starts) a
+        component as a side effect; families report zeros until the
+        component exists."""
+
+        def batcher_attr(attr):
+            def read():
+                b = self.peek("check_batcher")
+                yield (), float(getattr(b, attr, 0) if b is not None else 0)
+
+            return read
+
+        m.register_callback(
+            "keto_check_queue_depth", "gauge",
+            "Coalescing check batcher: requests queued, not yet packed.",
+            batcher_attr("queue_depth"),
+        )
+        m.register_callback(
+            "keto_check_inflight", "gauge",
+            "Accepted check requests whose futures have not resolved.",
+            batcher_attr("inflight"),
+        )
+        m.register_callback(
+            "keto_check_shed_total", "counter",
+            "Check requests refused at the door with 429/RESOURCE_EXHAUSTED "
+            "(queue at capacity).",
+            batcher_attr("shed_count"),
+        )
+        m.register_callback(
+            "keto_check_deadline_drops_total", "counter",
+            "Check requests dropped before dispatch because their deadline "
+            "expired (504/DEADLINE_EXCEEDED).",
+            batcher_attr("deadline_drop_count"),
+        )
+
+        def maintenance_raw():
+            engine = self.peek("permission_engine")
+            stats = getattr(engine, "maintenance", None)
+            if stats is None:
+                return {}, {}, {}
+            return stats.raw()
+
+        def maintenance_events():
+            counters, _, _ = maintenance_raw()
+            return [((k,), float(v)) for k, v in counters.items()] or [(("none",), 0.0)]
+
+        m.register_callback(
+            "keto_maintenance_events_total", "counter",
+            "Snapshot maintenance events (delta applies, compactions, "
+            "rebuilds, cache saves/loads, failures), by event.",
+            maintenance_events, ("event",),
+        )
+
+        def maintenance_durations(field, scale):
+            def read():
+                _, _, durations = maintenance_raw()
+                return [
+                    ((op,), float(d[field]) * scale) for op, d in durations.items()
+                ] or [(("none",), 0.0)]
+
+            return read
+
+        m.register_callback(
+            "keto_maintenance_duration_seconds_total", "counter",
+            "Cumulative wall time spent in maintenance operations "
+            "(compaction, rebuild, cache save/reload), by op.",
+            maintenance_durations("total_ms", 1e-3), ("op",),
+        )
+        m.register_callback(
+            "keto_maintenance_runs_total", "counter",
+            "Completed maintenance operations, by op.",
+            maintenance_durations("count", 1.0), ("op",),
+        )
+
+        def overlay_gauge(key):
+            def read():
+                _, gauges, _ = maintenance_raw()
+                v = gauges.get(key, 0)
+                yield (), float(v) if isinstance(v, (int, float)) else 0.0
+
+            return read
+
+        m.register_callback(
+            "keto_overlay_edges", "gauge",
+            "Delta-overlay occupancy: pending edges + tombstones not yet "
+            "folded into the base layout.",
+            overlay_gauge("overlay_edges"),
+        )
+        m.register_callback(
+            "keto_overlay_budget", "gauge",
+            "serve.overlay_edge_budget: occupancy past this triggers "
+            "compaction.",
+            overlay_gauge("overlay_budget"),
+        )
+
+        def health_states():
+            from keto_tpu.driver.health import HealthState
+
+            monitor = self.peek("health_monitor")
+            current = monitor.status()[0] if monitor is not None else None
+            return [((s.value,), 1.0 if s is current else 0.0) for s in HealthState]
+
+        m.register_callback(
+            "keto_health_state", "gauge",
+            "Serving health state machine, one-hot over "
+            "starting/serving/degraded/not_serving.",
+            health_states, ("state",),
+        )
+
+        def health_transitions():
+            monitor = self.peek("health_monitor")
+            yield (), float(monitor.transitions if monitor is not None else 0)
+
+        m.register_callback(
+            "keto_health_transitions_total", "counter",
+            "Health state transitions since boot.",
+            health_transitions,
+        )
+
+        def tracer_attr(attr):
+            def read():
+                t = self.peek("tracer")
+                yield (), float(getattr(t, attr, 0) if t is not None else 0)
+
+            return read
+
+        m.register_callback(
+            "keto_tracer_spans_exported_total", "counter",
+            "Spans handed to the configured trace exporter.",
+            tracer_attr("spans_exported"),
+        )
+        m.register_callback(
+            "keto_tracer_spans_dropped_total", "counter",
+            "Spans lost (full export queue, collector down, dead file).",
+            tracer_attr("spans_dropped"),
+        )
+
+        def store_attr(attr):
+            def read():
+                s = self.peek("manager")
+                yield (), float(getattr(s, attr, 0) if s is not None else 0)
+
+            return read
+
+        m.register_callback(
+            "keto_persistence_reconnect_retries_total", "counter",
+            "Store operations re-run after a dialect-recognized connection "
+            "loss (reads always; writes only when idempotency-keyed).",
+            store_attr("reconnect_retries"),
+        )
+        m.register_callback(
+            "keto_idempotent_replays_total", "counter",
+            "Keyed write retries answered from the dedup table instead of "
+            "re-applying.",
+            store_attr("idempotent_replays"),
+        )
 
     def tracer(self):
         from keto_tpu.x.tracing import DEFAULT_OTLP_ENDPOINT, Tracer

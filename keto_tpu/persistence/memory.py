@@ -195,6 +195,9 @@ class MemoryPersister(Manager):
         self._shared = _shared or _SharedState()
         #: how long idempotency keys dedup retries before GC forgets them
         self.idempotency_ttl_s = 86400.0
+        #: keyed write retries answered from the dedup map instead of
+        #: re-applying (the /metrics replay counter, matching sql_base)
+        self.idempotent_replays = 0
 
     @property
     def namespaces(self):
@@ -447,6 +450,7 @@ class MemoryPersister(Manager):
                 dedup = self._shared.idempotency.setdefault(self.network_id, {})
                 got = dedup.get(idempotency_key)
                 if got is not None:
+                    self.idempotent_replays += 1
                     return TransactResult(snaptoken=got[0], replayed=True)
             faults.check("transact-commit")
             new_sorted: Optional[list[InternalRow]] = None

@@ -311,6 +311,13 @@ class SQLPersisterBase(Manager):
         self.reconnect_max_wait_s = 30.0
         #: times the live connection was re-dialed after a detected loss
         self.reconnects = 0
+        #: operations re-RUN after a detected connection loss (the
+        #: /metrics retry counter; distinct from re-dials — an unkeyed
+        #: write re-dials without re-running)
+        self.reconnect_retries = 0
+        #: keyed write retries answered from the dedup table instead of
+        #: re-applying (the /metrics replay counter)
+        self.idempotent_replays = 0
         # snapshot-row cache: (sorted InternalRow list, watermark). Full
         # rebuild reads at 50M rows would otherwise re-read and re-encode
         # every row per snapshot; insert-only advances extend the cache
@@ -398,12 +405,17 @@ class SQLPersisterBase(Manager):
 
         if not retry:
             return attempt()
+
+        def note_retry(exc, delay):
+            self.reconnect_retries += 1
+
         return retry_call(
             attempt,
             max_wait_s=self.reconnect_max_wait_s,
             base_s=0.05,
             max_s=1.0,
             retryable=self._is_disconnect,
+            on_retry=note_retry,
         )
 
     # -- execution helpers ---------------------------------------------------
@@ -647,6 +659,7 @@ class SQLPersisterBase(Manager):
                     # ambiguous failure): re-apply NOTHING, answer with
                     # the original transaction's snaptoken
                     self._exec("ROLLBACK")
+                    self.idempotent_replays += 1
                     return TransactResult(snaptoken=int(row[0]), replayed=True)
             commit_time = self._alloc_commit_time()
             changed = bool(ins_rows)

@@ -24,7 +24,7 @@ from http import HTTPStatus
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
-from keto_tpu.servers.rest import RestApp
+from keto_tpu.servers.rest import RawBody, RestApp
 
 _MAX_HEAD = 64 * 1024
 _MAX_BODY = 64 * 1024 * 1024
@@ -249,11 +249,15 @@ class AsyncRestServer:
         self, writer: asyncio.StreamWriter, status: int, payload, extra: dict,
         close: bool,
     ) -> None:
-        data = b"" if payload is None else json.dumps(payload).encode()
+        if isinstance(payload, RawBody):
+            data, content_type = payload.data, payload.content_type
+        else:
+            data = b"" if payload is None else json.dumps(payload).encode()
+            content_type = "application/json"
         reason = _REASONS.get(status, "")
         head = [
             f"HTTP/1.1 {status} {reason}",
-            "Content-Type: application/json",
+            f"Content-Type: {content_type}",
             f"Content-Length: {len(data)}",
             "Server: keto-tpu",
         ]

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from concurrent import futures
 from typing import Optional
 
@@ -39,7 +40,9 @@ from keto_tpu.relationtuple.proto_codec import (
     tuple_from_proto,
 )
 from keto_tpu.x.errors import ErrBadRequest, KetoError
+from keto_tpu.x.logging import request_context
 from keto_tpu.x.pagination import with_size, with_token
+from keto_tpu.x.tracing import parse_traceparent
 
 READ = "read"
 WRITE = "write"
@@ -52,20 +55,73 @@ def _abort(context, err: KetoError):
     context.abort(_CODE_BY_NUM.get(err.grpc_code, grpc.StatusCode.INTERNAL), err.message)
 
 
+def _request_metrics(m):
+    """The gRPC request counter + latency histogram over metrics
+    registry ``m`` (idempotent by name, so every servicer shares one
+    pair — and the driver registry pre-declares them so scrapes before
+    first traffic expose the families)."""
+    return (
+        m.counter(
+            "keto_grpc_requests_total",
+            "gRPC calls served, by full method and status code.",
+            ("method", "code"),
+        ),
+        m.histogram(
+            "keto_grpc_request_duration_seconds",
+            "gRPC call handling latency; the slowest sample per method "
+            "carries a trace_id exemplar.",
+            ("method",),
+        ),
+    )
+
+
 def _wrap(fn, registry=None, name: str = ""):
-    """Translate KetoError into gRPC status codes; trace + count the call
-    (the reference's otgrpc/grpc_logrus interceptor slot,
-    registry_default.go:327-346)."""
+    """Translate KetoError into gRPC status codes; trace + count + time
+    the call (the reference's otgrpc/grpc_logrus interceptor slot,
+    registry_default.go:327-346). Inbound ``traceparent`` metadata joins
+    the caller's trace; ``x-request-id`` is echoed (or minted) back as
+    initial metadata and bound into the logging context — the gRPC face
+    of the REST correlation headers."""
 
     def handler(request, context):
+        if registry is None:
+            try:
+                return fn(request, context)
+            except KetoError as e:
+                _abort(context, e)
+                return  # unreachable: abort raises
+        md = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
+        remote = parse_traceparent(md.get("traceparent", ""))
+        req_id = (md.get("x-request-id") or "").strip() or uuid.uuid4().hex
+        registry.telemetry().record(f"grpc {name}")
+        counter, latency = _request_metrics(registry.metrics())
+        code = "OK"
+        trace_id = remote[0] if remote else ""
+        t0 = time.perf_counter()
         try:
-            if registry is not None:
-                registry.telemetry().record(f"grpc {name}")
-                with registry.tracer().span(f"grpc.{name}"):
-                    return fn(request, context)
-            return fn(request, context)
-        except KetoError as e:
-            _abort(context, e)
+            with registry.tracer().span(f"grpc.{name}", remote_parent=remote) as span:
+                if span is not None:
+                    trace_id = span.trace_id
+                with request_context(request_id=req_id, trace_id=trace_id):
+                    try:
+                        context.send_initial_metadata((("x-request-id", req_id),))
+                    except Exception:
+                        pass  # already sent / stream torn down
+                    try:
+                        return fn(request, context)
+                    except KetoError as e:
+                        code = _CODE_BY_NUM.get(
+                            e.grpc_code, grpc.StatusCode.INTERNAL
+                        ).name
+                        if span is not None:
+                            span.tags["code"] = code
+                        _abort(context, e)
+                    except Exception:
+                        code = "INTERNAL"
+                        raise
+        finally:
+            counter.inc((name, code))
+            latency.observe((name,), time.perf_counter() - t0, trace_id=trace_id)
 
     return handler
 

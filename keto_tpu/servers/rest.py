@@ -24,13 +24,24 @@ Endpoint behavior is a 1:1 mapping of the reference REST surface:
   (keto_tpu/driver/health.py) answers 200 ``{"status": "ok"}`` /
   ``{"status": "degraded", ...}`` when traffic should flow and **503 +
   JSON reason** when the snapshot is beyond its staleness budget or
-  maintenance died; ``GET /version``.
+  maintenance died; ``GET /version``; ``GET /metrics`` serves the
+  Prometheus text exposition of the process-wide MetricsRegistry
+  (keto_tpu/x/metrics.py) on BOTH API ports — one scrape config covers
+  read and write processes.
 
 Deadline propagation: an ``X-Request-Timeout-Ms`` header (or
 ``timeout_ms`` query parameter) on ``/check`` rides into the batcher as
 an absolute deadline — expired requests shed with **504** before they
 occupy a device slice, and a full check queue sheds with **429**
 (keto_tpu/driver/batch.py).
+
+Request correlation: every non-health request gets (or echoes) an
+``X-Request-Id``, joins the caller's trace when a W3C ``traceparent``
+header is present, and binds both ids into the logging context
+(keto_tpu/x/logging.request_context) for the handler's duration — log
+lines, spans, response headers, and latency exemplars all carry the same
+ids. Route labels on the request metrics are cardinality-bounded: paths
+outside the declared surface count as ``other``.
 
 Errors render the herodot-style envelope from keto_tpu/x/errors.py.
 """
@@ -40,6 +51,8 @@ from __future__ import annotations
 import json
 import threading
 import time
+import uuid
+from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
 from urllib.parse import parse_qs, urlsplit
@@ -51,10 +64,23 @@ from keto_tpu.relationtuple.model import (
     subject_set_from_url_query,
 )
 from keto_tpu.x.errors import ErrBadRequest, ErrNilSubject, KetoError
+from keto_tpu.x.logging import request_context
+from keto_tpu.x.metrics import normalize_route
 from keto_tpu.x.pagination import with_size, with_token
+from keto_tpu.x.tracing import parse_traceparent
 
 READ = "read"
 WRITE = "write"
+
+
+@dataclass
+class RawBody:
+    """A non-JSON response payload (``/metrics`` exposition): the server
+    backends write ``data`` verbatim under ``content_type`` instead of
+    JSON-encoding."""
+
+    data: bytes
+    content_type: str
 
 
 class RestApp:
@@ -63,6 +89,22 @@ class RestApp:
     def __init__(self, registry, role: str):
         self.registry = registry
         self.role = role
+        self._log = registry.logger()
+        # request metrics, declared once per app (creation is idempotent
+        # across the two roles; recording is the per-request hot path)
+        m = registry.metrics()
+        self._req_count = m.counter(
+            "keto_http_requests_total",
+            "REST requests served, by role/method/route/status code "
+            "(health endpoints excluded; undeclared routes count as 'other').",
+            ("role", "method", "route", "code"),
+        )
+        self._req_latency = m.histogram(
+            "keto_http_request_duration_seconds",
+            "REST request handling latency; the slowest sample per route "
+            "carries a trace_id exemplar.",
+            ("role", "method", "route"),
+        )
 
     # -- dispatch ------------------------------------------------------------
 
@@ -76,14 +118,44 @@ class RestApp:
     ):
         """Returns (status, payload-dict | None, headers-dict).
         ``headers`` are the request headers, lowercase-keyed (deadline
-        propagation); absent for callers that don't carry them."""
-        # request span + usage counter (health endpoints excluded), matching
-        # the reference's middleware placement (registry_default.go:288-300)
-        if not path.startswith("/health/"):
-            self.registry.telemetry().record(f"{self.role} {method} {path}")
-            with self.registry.tracer().span(f"http.{method} {path}", role=self.role):
-                return self._route(method, path, query, body, headers)
-        return self._route(method, path, query, body, headers)
+        propagation, trace context); absent for callers that don't carry
+        them."""
+        # request span + usage counter + metrics (health endpoints
+        # excluded), matching the reference's middleware placement
+        # (registry_default.go:288-300)
+        if path.startswith("/health/"):
+            return self._route(method, path, query, body, headers)
+        hdrs = headers or {}
+        route = normalize_route(path)
+        # correlation: echo the caller's request id or mint one; join the
+        # caller's trace when a well-formed traceparent came in
+        req_id = (hdrs.get("x-request-id") or "").strip() or uuid.uuid4().hex
+        remote = parse_traceparent(hdrs.get("traceparent", ""))
+        self.registry.telemetry().record(f"{self.role} {method} {route}")
+        t0 = time.perf_counter()
+        with self.registry.tracer().span(
+            f"http.{method} {route}", remote_parent=remote, role=self.role
+        ) as span:
+            trace_id = (
+                span.trace_id if span is not None else (remote[0] if remote else "")
+            )
+            with request_context(request_id=req_id, trace_id=trace_id):
+                status, payload, resp_headers = self._route(
+                    method, path, query, body, headers
+                )
+                if span is not None:
+                    span.tags["status"] = status
+                    span.tags["request_id"] = req_id
+                # access log INSIDE the bound context: the formatters
+                # stamp request_id/trace_id onto the record, same ids as
+                # the span and the response headers
+                self._log.debug("%s %s %s -> %d", self.role, method, path, status)
+        dur_s = time.perf_counter() - t0
+        self._req_count.inc((self.role, method, route, str(status)))
+        self._req_latency.observe((self.role, method, route), dur_s, trace_id=trace_id)
+        resp_headers = dict(resp_headers)
+        resp_headers.setdefault("X-Request-Id", req_id)
+        return status, payload, resp_headers
 
     def _route(
         self,
@@ -101,6 +173,8 @@ class RestApp:
                 return self._health_ready()
             if path == "/version":
                 return 200, {"version": self.registry.version()}, {}
+            if route == ("GET", "/metrics"):
+                return self._get_metrics(headers)
 
             if self.role == READ:
                 if route == ("GET", "/check"):
@@ -127,6 +201,27 @@ class RestApp:
         except Exception as e:  # unexpected → 500 envelope
             err = KetoError(str(e) or "internal server error")
             return 500, err.to_json(), {}
+
+    # -- observability -------------------------------------------------------
+
+    def _get_metrics(self, headers):
+        """Prometheus text exposition of every registered family. A
+        scraper negotiating ``Accept: application/openmetrics-text`` (the
+        way Prometheus asks for exemplars) gets the OpenMetrics rendering
+        with trace-id exemplars on the latency histograms. 404 when
+        ``metrics.enabled: false``."""
+        m = self.registry.metrics()
+        if not m.enabled:
+            err = KetoError("metrics disabled by configuration")
+            err.status_code = 404
+            return 404, err.to_json(), {}
+        openmetrics = "application/openmetrics-text" in (headers or {}).get("accept", "")
+        content_type = (
+            "application/openmetrics-text; version=1.0.0; charset=utf-8"
+            if openmetrics
+            else "text/plain; version=0.0.4; charset=utf-8"
+        )
+        return 200, RawBody(m.render(openmetrics=openmetrics).encode(), content_type), {}
 
     # -- health --------------------------------------------------------------
 
@@ -334,9 +429,13 @@ def _make_handler(app: RestApp):
                 status, payload, headers = app.handle(
                     method, parts.path, query, body, req_headers
                 )
-                data = b"" if payload is None else json.dumps(payload).encode()
+                if isinstance(payload, RawBody):
+                    data, content_type = payload.data, payload.content_type
+                else:
+                    data = b"" if payload is None else json.dumps(payload).encode()
+                    content_type = "application/json"
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 for k, v in headers.items():
                     self.send_header(k, v)

@@ -3,14 +3,16 @@
 The analog of the reference's profilex wiring in main (reference
 main.go:25-28; config key ``profiling``, config.schema.json:271-280):
 ``profiling: cpu`` wraps the process in cProfile, ``profiling: mem`` in
-tracemalloc; stats print to stderr on clean shutdown.
+tracemalloc, ``profiling: trace`` captures a jax.profiler device trace
+(kernel timeline, viewable in TensorBoard/Perfetto). Stats print to
+stderr on clean shutdown.
 """
 
 from __future__ import annotations
 
 import atexit
+import os
 import sys
-from typing import Optional
 
 
 def attach(mode: str) -> None:
@@ -39,5 +41,35 @@ def attach(mode: str) -> None:
                 print(stat, file=sys.stderr)
 
         atexit.register(dump)
+    elif mode == "trace":
+        # device-timeline trace via jax.profiler: TPU kernels, host-device
+        # transfers, and compilation all land in the capture. Degrades to
+        # a no-op when jax (or its profiler backend) is unavailable — the
+        # config stays valid on CPU-only and stripped installs.
+        try:
+            import jax
+        except Exception:
+            print("profiling: trace requested but jax is unavailable; skipping",
+                  file=sys.stderr)
+            return
+        trace_dir = os.environ.get("KETO_TPU_TRACE_DIR") or os.path.join(
+            os.getcwd(), "keto-tpu-trace"
+        )
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception as e:
+            print(f"profiling: jax trace unavailable ({e!r}); skipping",
+                  file=sys.stderr)
+            return
+
+        def dump():
+            try:
+                jax.profiler.stop_trace()
+                print(f"== jax profiler trace written to {trace_dir} ==",
+                      file=sys.stderr)
+            except Exception:
+                pass
+
+        atexit.register(dump)
     elif mode:
-        raise ValueError(f"unknown profiling mode {mode!r} (want cpu|mem)")
+        raise ValueError(f"unknown profiling mode {mode!r} (want cpu|mem|trace)")

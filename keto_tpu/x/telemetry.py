@@ -29,11 +29,24 @@ class DurationStats:
         self._lock = threading.Lock()
         self._window: collections.deque = collections.deque(maxlen=capacity)
         self._count = 0
+        # optional /metrics bridge: a histogram (keto_tpu/x/metrics.py)
+        # mirroring every observation in seconds, so scrapes see the SAME
+        # numbers the slice controller steers by — without the engine
+        # knowing about the metrics registry
+        self._mirror = None
+
+    def attach_histogram(self, histogram) -> None:
+        """Mirror observations into ``histogram`` (anything with
+        ``observe(labels, seconds)``) from now on."""
+        self._mirror = histogram
 
     def observe(self, ms: float) -> None:
         with self._lock:
             self._window.append(float(ms))
             self._count += 1
+        mirror = self._mirror
+        if mirror is not None:
+            mirror.observe((), ms / 1e3)
 
     def reset(self) -> None:
         with self._lock:
@@ -101,10 +114,33 @@ class MaintenanceStats:
                 out[f"{key}_last_ms"] = round(d["last_ms"], 3)
             return out
 
+    def raw(self) -> tuple[dict, dict, dict]:
+        """``(counters, gauges, durations)`` as separate copies — the
+        /metrics bridge needs them typed (counter vs gauge vs duration
+        pair), which the flat ``snapshot`` view erases."""
+        with self._lock:
+            return (
+                dict(self._counters),
+                dict(self._gauges),
+                {k: dict(v) for k, v in self._durations.items()},
+            )
+
 
 class Telemetry:
-    def __init__(self, enabled: bool = False):
+    """Per-route request counters.
+
+    ``max_routes`` bounds label cardinality at the sink itself: the
+    serving layers already normalize unknown paths to ``other``
+    (keto_tpu/x/metrics.normalize_route), but ANY caller recording
+    unbounded strings here (a future surface, a bug) folds into
+    ``other`` past the cap instead of growing the counter map without
+    bound under a path-scanning client."""
+
+    OVERFLOW_ROUTE = "other"
+
+    def __init__(self, enabled: bool = False, max_routes: int = 256):
         self.enabled = enabled
+        self._max_routes = max_routes
         self._lock = threading.Lock()
         self._counts: collections.Counter = collections.Counter()
 
@@ -112,6 +148,8 @@ class Telemetry:
         if not self.enabled:
             return
         with self._lock:
+            if route not in self._counts and len(self._counts) >= self._max_routes:
+                route = self.OVERFLOW_ROUTE
             self._counts[route] += 1
 
     def snapshot(self) -> dict[str, int]:

@@ -39,6 +39,41 @@ _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar
 
 DEFAULT_OTLP_ENDPOINT = "http://127.0.0.1:4318/v1/traces"
 
+_HEX = set("0123456789abcdef")
+
+
+def parse_traceparent(value: str) -> Optional[tuple[str, str]]:
+    """``(trace_id, parent_span_id)`` from a W3C ``traceparent`` header
+    (``00-<32 hex>-<16 hex>-<2 hex>``), or None when malformed — a bad
+    header starts a fresh trace instead of failing the request."""
+    parts = (value or "").strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id = parts[0], parts[1], parts[2]
+    if version == "ff" or len(version) != 2 or not set(version) <= _HEX:
+        return None
+    if len(trace_id) != 32 or not set(trace_id) <= _HEX or trace_id == "0" * 32:
+        return None
+    if len(span_id) != 16 or not set(span_id) <= _HEX or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """A W3C ``traceparent`` value continuing ``trace_id`` under
+    ``span_id`` (sampled flag set — this process exported the span)."""
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def current_traceparent() -> str:
+    """The ``traceparent`` an outbound request should carry to join the
+    current trace, or "" outside any span (the httpclient SDK's
+    injection seam)."""
+    s = _current_span.get()
+    if s is None:
+        return ""
+    return format_traceparent(s.trace_id, s.span_id)
+
 
 @dataclass
 class Span:
@@ -52,6 +87,9 @@ class Span:
     start_unix_ns: int = 0
     end: Optional[float] = None
     tags: dict[str, Any] = field(default_factory=dict)
+    #: the parent span lives in ANOTHER process (joined via traceparent):
+    #: this span is still the local entry point (SERVER kind)
+    remote: bool = False
 
     @property
     def duration_ms(self) -> Optional[float]:
@@ -67,8 +105,10 @@ class Span:
             "name": self.name,
             # root spans are the request entry points (SERVER); nested
             # spans are INTERNAL — backends derive per-service request
-            # rates from server spans, so children must not double-count
-            "kind": 2 if self.parent_id is None else 1,
+            # rates from server spans, so children must not double-count.
+            # A remote-parented span (joined via traceparent) is still
+            # this process's entry point.
+            "kind": 2 if (self.parent_id is None or self.remote) else 1,
             "startTimeUnixNano": str(self.start_unix_ns),
             "endTimeUnixNano": str(self.start_unix_ns + dur_ns),
             "attributes": [
@@ -196,6 +236,10 @@ class Tracer:
         self._otlp_file = otlp_file
         self._file_handle = None
         self._file_failed = False
+        # export accounting for /metrics (the otlp-http provider counts in
+        # its exporter; every other provider counts here)
+        self._exported = 0
+        self._dropped = 0
         self._http: Optional[_OtlpHttpExporter] = None
         if provider == "otlp-file" and not otlp_file:
             raise ValueError(
@@ -208,20 +252,47 @@ class Tracer:
     def enabled(self) -> bool:
         return self.provider != ""
 
+    @property
+    def spans_exported(self) -> int:
+        """Spans handed to the provider (for otlp-http: POSTed)."""
+        return self._http.exported if self._http is not None else self._exported
+
+    @property
+    def spans_dropped(self) -> int:
+        """Spans lost (full export queue, collector down, dead file)."""
+        return self._http.dropped if self._http is not None else self._dropped
+
     @contextmanager
-    def span(self, name: str, **tags) -> Iterator[Optional[Span]]:
+    def span(
+        self,
+        name: str,
+        remote_parent: Optional[tuple[str, str]] = None,
+        **tags,
+    ) -> Iterator[Optional[Span]]:
+        """``remote_parent`` is a ``(trace_id, span_id)`` extracted from an
+        inbound ``traceparent`` header (keto_tpu.x.tracing.parse_traceparent):
+        a root span joins the caller's trace instead of starting its own,
+        so one trace follows the request across services. Ignored when a
+        local parent span is already active."""
         if not self.enabled:
             yield None
             return
         parent = _current_span.get()
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif remote_parent is not None:
+            trace_id, parent_id = remote_parent
+        else:
+            trace_id, parent_id = uuid.uuid4().hex, None
         s = Span(
             name=name,
-            trace_id=parent.trace_id if parent else uuid.uuid4().hex,
+            trace_id=trace_id,
             span_id=uuid.uuid4().hex[:16],
-            parent_id=parent.span_id if parent else None,
+            parent_id=parent_id,
             start=time.perf_counter(),
             start_unix_ns=time.time_ns(),
             tags=dict(tags),
+            remote=parent is None and remote_parent is not None,
         )
         token = _current_span.set(s)
         try:
@@ -236,9 +307,11 @@ class Tracer:
             self._logger.debug(
                 "span %s trace=%s dur=%.2fms %s", s.name, s.trace_id, s.duration_ms, s.tags
             )
+            self._exported += 1
         elif self.provider == "memory":
             with self._lock:
                 self.finished.append(s)
+                self._exported += 1
         elif self.provider == "otlp-file" and self._otlp_file:
             # telemetry never breaks serving: an unwritable path logs once
             # and disables the exporter instead of failing every request;
@@ -247,14 +320,17 @@ class Tracer:
             line = json.dumps(spans_to_otlp_request([s])) + "\n"
             with self._lock:
                 if self._file_failed:
+                    self._dropped += 1
                     return
                 try:
                     if self._file_handle is None:
                         self._file_handle = open(self._otlp_file, "a")
                     self._file_handle.write(line)
                     self._file_handle.flush()
+                    self._exported += 1
                 except OSError as e:
                     self._file_failed = True
+                    self._dropped += 1
                     if self._logger is not None:
                         self._logger.error(
                             "otlp-file exporter disabled: %s (%s)", e, self._otlp_file

@@ -1,0 +1,149 @@
+"""metrics-lint: scrape a live daemon and fail on convention violations.
+
+The CI seam keeping /metrics and its documentation honest:
+
+1. boots a real daemon (memory store), drives one request through every
+   signal path (check allowed/denied, a write, a gRPC check, a scrape);
+2. scrapes ``GET /metrics`` and strict-parses every line
+   (keto_tpu/x/metrics.parse_exposition): name/label/escaping
+   conventions, counters ending ``_total``, histogram bucket
+   monotonicity, ``_count``/``_sum`` consistency;
+3. cross-checks the scrape against the family table in
+   docs/concepts/observability.md — a family exposed but undocumented,
+   or documented but missing from the scrape, fails the build.
+
+Exit code 0 on a clean scrape; 1 with the violations listed.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+DOC = ROOT / "docs" / "concepts" / "observability.md"
+
+#: a documented family row: | `keto_...` | type | labels | meaning |
+_DOC_ROW_RE = re.compile(r"^\|\s*`(keto_[a-z0-9_]+)`\s*\|\s*(\w+)\s*\|")
+
+
+def documented_families() -> dict[str, str]:
+    families = {}
+    for line in DOC.read_text().splitlines():
+        m = _DOC_ROW_RE.match(line)
+        if m:
+            families[m.group(1)] = m.group(2)
+    return families
+
+
+def drive_traffic(read_port: int, write_port: int) -> None:
+    """One request through every signal path the families cover."""
+    import grpc
+    from ory.keto.acl.v1alpha1 import check_service_pb2
+
+    put = json.dumps(
+        {"namespace": "files", "object": "o", "relation": "r", "subject_id": "u"}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{write_port}/relation-tuples", data=put, method="PUT",
+        headers={"Content-Type": "application/json", "X-Idempotency-Key": "lint-1"},
+    )
+    urllib.request.urlopen(req, timeout=10)
+    urllib.request.urlopen(req, timeout=10)  # idempotent replay
+    base = f"http://127.0.0.1:{read_port}"
+    urllib.request.urlopen(f"{base}/check?namespace=files&object=o&relation=r&subject_id=u", timeout=10)
+    try:
+        urllib.request.urlopen(f"{base}/check?namespace=files&object=o&relation=r&subject_id=nobody", timeout=10)
+    except urllib.error.HTTPError:
+        pass  # 403 denial is the point
+    urllib.request.urlopen(f"{base}/health/ready", timeout=10)
+    channel = grpc.insecure_channel(f"127.0.0.1:{read_port}")
+    stub = channel.unary_unary(
+        "/ory.keto.acl.v1alpha1.CheckService/Check",
+        request_serializer=check_service_pb2.CheckRequest.SerializeToString,
+        response_deserializer=check_service_pb2.CheckResponse.FromString,
+    )
+    stub(
+        check_service_pb2.CheckRequest(
+            namespace="files", object="o", relation="r",
+            subject={"id": "u"},
+        ),
+        timeout=10,
+    )
+    channel.close()
+
+
+def lint(text: str) -> list[str]:
+    from keto_tpu.x.metrics import parse_exposition
+
+    problems: list[str] = []
+    try:
+        families = parse_exposition(text)
+    except ValueError as e:
+        return [f"exposition parse failure: {e}"]
+
+    documented = documented_families()
+    exposed = set(families)
+    for name in sorted(exposed - set(documented)):
+        problems.append(
+            f"family {name} is exposed but missing from the table in {DOC.relative_to(ROOT)}"
+        )
+    for name in sorted(set(documented) - exposed):
+        problems.append(f"family {name} is documented but absent from the scrape")
+    for name, fam in families.items():
+        if name in documented and documented[name] != fam["type"]:
+            problems.append(
+                f"family {name}: documented as {documented[name]}, exposed as {fam['type']}"
+            )
+        if not name.startswith("keto_"):
+            problems.append(f"family {name} missing the keto_ namespace prefix")
+        if fam["type"] == "histogram" and not name.endswith("_seconds"):
+            problems.append(f"histogram {name} should use base unit seconds (_seconds)")
+    if len(exposed) < 12:
+        problems.append(f"only {len(exposed)} families exposed; the spine promises >= 12")
+    return problems
+
+
+def main() -> int:
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "files"}],
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "tracing.provider": "memory",
+        }
+    )
+    daemon = Daemon(Registry(cfg))
+    daemon.serve_all(block=False)
+    try:
+        drive_traffic(daemon.read_port, daemon.write_port)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.read_port}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+    finally:
+        daemon.shutdown()
+    problems = lint(text)
+    if problems:
+        print("metrics-lint FAILED:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    n = len(text.splitlines())
+    print(f"metrics-lint OK: {n} exposition lines, every family documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -360,3 +360,46 @@ def test_spec_definitions_are_valid_schemas():
     spec edits that silently disable validation)."""
     for name, schema in SPEC["definitions"].items():
         jsonschema.validators.Draft4Validator.check_schema(schema)
+
+
+def test_metrics_endpoint_conforms(daemon):
+    """GET /metrics is declared in the spec and serves the Prometheus
+    text exposition with at least the promised family breadth."""
+    assert "/metrics" in SPEC["paths"], "spec does not declare /metrics"
+    url = f"http://127.0.0.1:{daemon.read_port}/metrics"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        assert resp.status == 200
+        assert "200" in SPEC["paths"]["/metrics"]["get"]["responses"]
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    families = [l.split()[2] for l in text.splitlines() if l.startswith("# TYPE")]
+    assert len(families) >= 12
+    # strict-parse: the exposition itself is the contract
+    from keto_tpu.x.metrics import parse_exposition
+
+    parse_exposition(text)
+
+
+def test_request_id_headers_conform(daemon):
+    """The declared X-Request-Id correlation contract on /check: echoed
+    when supplied, minted when absent — on allow AND deny."""
+    get = SPEC["paths"]["/check"]["get"]
+    assert any(p["name"] == "X-Request-Id" for p in get["parameters"])
+    assert any(p["name"] == "traceparent" for p in get["parameters"])
+    assert "X-Request-Id" in get["responses"]["200"]["headers"]
+    assert "X-Request-Id" in get["responses"]["403"]["headers"]
+
+    query = {
+        "namespace": "files", "object": "readme", "relation": "view",
+        "subject_id": "deb",
+    }
+    status, _, headers = _request_h(
+        daemon.read_port, "GET", "/check", query=query,
+        headers={"X-Request-Id": "spec-conform-1"},
+    )
+    assert status == 200
+    assert headers.get("X-Request-Id") == "spec-conform-1"
+    query["subject_id"] = "mallory"
+    status, _, headers = _request_h(daemon.read_port, "GET", "/check", query=query)
+    assert status == 403
+    assert headers.get("X-Request-Id"), "deny response missing a minted request id"
