@@ -83,7 +83,32 @@ CONFIG_SCHEMA = {
                 "shed_on_full": {
                     "type": "boolean",
                     "default": True,
-                    "description": "Load shedding: answer 429 / RESOURCE_EXHAUSTED immediately when the check queue is at capacity, instead of blocking callers into their own timeouts. Expired request deadlines (gRPC deadline, X-Request-Timeout-Ms) always shed with 504 / DEADLINE_EXCEEDED before packing.",
+                    "description": "Load shedding: answer 429 / RESOURCE_EXHAUSTED (with a Retry-After hint) immediately when a check lane is at capacity, instead of blocking callers into their own timeouts. Expired request deadlines (gRPC deadline, X-Request-Timeout-Ms) always shed with 504 / DEADLINE_EXCEEDED before packing.",
+                },
+                "interactive_max_tuples": {
+                    "type": "integer",
+                    "default": 16,
+                    "description": "Priority lanes: check requests with at most this many tuples (and no explicit X-Keto-Priority / x-keto-priority hint) classify into the interactive lane, which is packed into the next dispatch round ahead of all queued batch-lane work. Larger requests ride the batch lane.",
+                },
+                "batch_sub_slice": {
+                    "type": "integer",
+                    "default": 1024,
+                    "description": "Priority lanes: at most this many batch-lane tuples join one dispatch round, so a monster batch request is served in bounded sub-slices that interleave with interactive checks instead of owning the device for its full width. An interactive check arriving mid-burst waits at most one sub-slice, not the whole batch.",
+                },
+                "admission_enabled": {
+                    "type": "boolean",
+                    "default": True,
+                    "description": "Adaptive admission control: an AIMD window over the batch check lane, keyed off the slice service-time histogram the stream width controller records plus the batcher's queue-delay estimate. Past the latency budget the admitted window shrinks multiplicatively and excess batch-lane load sheds 429 + Retry-After before it queues; interactive checks are never admission-limited.",
+                },
+                "admission_latency_budget_ms": {
+                    "type": "number",
+                    "default": 0.0,
+                    "description": "The latency estimate (slice p99 or queued-delay) past which the admission controller judges the server overloaded. 0 derives 4x serve.stream_slice_target_ms.",
+                },
+                "admission_min_window": {
+                    "type": "integer",
+                    "default": 64,
+                    "description": "Floor of the AIMD admission window (queued batch-lane tuples): even in deep overload this much batch work stays admitted, so the lane drains and recovery is observable.",
                 },
                 "idempotency_ttl_s": {
                     "type": "number",

@@ -1,29 +1,49 @@
-"""Request-coalescing check batcher.
+"""Request-coalescing check batcher with priority lanes.
 
 The reference serves one goroutine per request, each paying its own
 traversal (reference internal/driver/daemon.go:62-69). On TPU the economics
 invert: one device program answers thousands of checks, so concurrent
 single-check requests are *coalesced* — a caller enqueues its tuple and
-blocks on a future; a collector thread drains the queue up to
+blocks on a future; a collector thread drains the queues up to
 ``batch_size`` or ``window_ms`` (whichever first) and dispatches one
 ``batch_check``. This is the serving-plane analog of the data-parallel axis
 (SURVEY §2.3: request concurrency → batch parallelism).
 
-Against the TPU engine the dispatch is STREAMING: the coalesced batch goes
+PRIORITY LANES. A single FIFO convoys: one interactive check behind a
+64k-wide batch request waits the whole batch's service time, which is
+exactly the p50≈100 ms / p99≈2 s shape every bench round shows. The
+batcher therefore keeps TWO lanes:
+
+- ``interactive`` — single checks and small batches (≤
+  ``interactive_max_tuples``): packed into the **next** dispatch round
+  ahead of all queued batch work.
+- ``batch`` — pre-batched chunks: dispatched in bounded **sub-slices**
+  (≤ ``batch_sub_slice`` tuples per round), so a monster request
+  interleaves with the interactive lane instead of owning the device
+  for its full width. A small reserve (``batch_reserve_share`` of the
+  round) keeps the batch lane from starving when interactive traffic
+  alone can fill every round.
+
+Lane choice: explicit (``lane=``, from the REST ``X-Keto-Priority``
+header / gRPC ``x-keto-priority`` metadata) or by size. ADMISSION
+CONTROL: when an ``AdmissionController`` (keto_tpu/driver/admission.py)
+is attached, batch-lane arrivals beyond its AIMD window shed 429 +
+``Retry-After`` at the door — overload converts to explicit backpressure
+before it becomes queue delay, and interactive p99 stays flat through
+bursts.
+
+Against the TPU engine the dispatch is STREAMING: each round goes
 through ``batch_check_stream_with_token(ordered=False)`` — the engine's
 latency-adaptive ready-order pipeline — and each caller's future resolves
-the moment its slice lands, re-associated by query offset. Production
-``/check`` traffic (REST async/threading backends and gRPC all route
-through this batcher) therefore sees per-slice serving latency, not
-whole-batch latency, when the device splits a large batch.
+the moment its slice lands, re-associated by query offset.
 """
 
 from __future__ import annotations
 
 import logging
-import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Optional, Sequence
@@ -34,6 +54,36 @@ from keto_tpu.x.errors import ErrDeadlineExceeded, ErrTooManyRequests, KetoError
 
 _log = logging.getLogger("keto_tpu.batch")
 
+INTERACTIVE = "interactive"
+BATCH = "batch"
+LANES = (INTERACTIVE, BATCH)
+
+
+class _Item:
+    """One queued request: a single tuple (the common case) or a
+    pre-batched chunk. Chunks are consumed in bounded sub-slices across
+    dispatch rounds; the future resolves once every tuple has a result."""
+
+    __slots__ = (
+        "tuples", "fut", "at_least", "latest", "deadline", "lane",
+        "results", "taken", "remaining",
+    )
+
+    def __init__(self, tuples, fut, at_least, latest, deadline, lane):
+        self.tuples = tuples
+        self.fut = fut
+        self.at_least = at_least
+        self.latest = latest
+        self.deadline = deadline
+        self.lane = lane
+        self.results: list = [None] * len(tuples)
+        self.taken = 0  # tuples already handed to a dispatch round
+        self.remaining = len(tuples)  # results not yet filled in
+
+    @property
+    def n(self) -> int:
+        return len(self.tuples)
+
 
 class CheckBatcher:
     def __init__(
@@ -43,25 +93,44 @@ class CheckBatcher:
         window_ms: float = 1.0,
         max_pending: Optional[int] = None,
         shed_on_full: bool = False,
+        interactive_max_tuples: int = 16,
+        batch_sub_slice: Optional[int] = None,
+        batch_reserve_share: float = 0.125,
+        admission=None,
     ):
         """``engine`` needs ``batch_check(list[RelationTuple]) -> list[bool]``.
 
-        ``max_pending`` bounds the queue (default 8×batch_size): when the
-        device can't keep up, callers block in ``check`` up to their own
-        timeout instead of growing an unbounded backlog — backpressure
-        propagates to the accepting sockets rather than to memory. With
-        ``shed_on_full`` (what the registry configures for serving
-        processes), a full queue instead *sheds immediately* with
-        ``ErrTooManyRequests`` (REST 429 / gRPC RESOURCE_EXHAUSTED) — the
-        client learns it should back off *now*, seconds ahead of the
-        future timeout it would otherwise burn."""
+        ``max_pending`` bounds each lane's queued tuples (default
+        8×batch_size): when the device can't keep up, callers block in
+        ``check`` up to their own deadline instead of growing an unbounded
+        backlog — backpressure propagates to the accepting sockets rather
+        than to memory. With ``shed_on_full`` (what the registry
+        configures for serving processes), a full lane instead *sheds
+        immediately* with ``ErrTooManyRequests`` (REST 429 + Retry-After /
+        gRPC RESOURCE_EXHAUSTED) — the client learns it should back off
+        *now*, seconds ahead of the future timeout it would otherwise
+        burn. ``admission`` (an AdmissionController) additionally sheds
+        batch-lane arrivals beyond its adaptive window."""
         self._engine = engine
         self._batch_size = batch_size
         self._window_s = window_ms / 1e3
-        self._queue: queue.Queue = queue.Queue(maxsize=max_pending or 8 * batch_size)
+        self._max_pending = max_pending or 8 * batch_size
         self._shed_on_full = shed_on_full
-        #: requests refused at the door (queue full)
+        self._interactive_max_tuples = max(1, interactive_max_tuples)
+        self._sub_slice = max(1, batch_sub_slice or max(1, batch_size // 4))
+        self._batch_reserve = max(1, int(batch_size * batch_reserve_share))
+        self.admission = admission
+        self._cond = threading.Condition()
+        self._lanes: dict[str, deque] = {lane: deque() for lane in LANES}
+        self._lane_tuples: dict[str, int] = {lane: 0 for lane in LANES}
+        #: items taken into the current dispatch round (failed promptly
+        #: by ``stop`` so no caller ever hangs on a dead collector)
+        self._current_round: list[_Item] = []
+        #: requests refused at the door (lane full or admission window)
         self.shed_count = 0
+        self.shed_by_lane: dict[str, int] = {lane: 0 for lane in LANES}
+        #: the admission-window subset of ``shed_count``
+        self.admission_shed_count = 0
         #: requests dropped at dispatch because their deadline had passed
         self.deadline_drop_count = 0
         self._stop = threading.Event()
@@ -83,22 +152,28 @@ class CheckBatcher:
 
     def stop(self) -> None:
         self._stop.set()
-        try:
-            self._queue.put_nowait(None)  # fast wake when the queue is idle
-        except queue.Full:
-            pass  # collector is mid-drain; it polls the stop flag
+        with self._cond:
+            self._cond.notify_all()
         if self._thread:
             self._thread.join(timeout=5)
             self._thread = None
-        # requests still queued would otherwise block their callers for the
-        # full future timeout — fail them promptly instead
-        while True:
-            try:
-                item = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if item is not None and not item[1].done():
-                item[1].set_exception(RuntimeError("check batcher stopped"))
+        # requests still queued (or stranded in a wedged dispatch round)
+        # would otherwise block their callers for the full future timeout
+        # — fail them promptly with a definitive error instead
+        with self._cond:
+            leftovers = []
+            for lane in LANES:
+                leftovers.extend(self._lanes[lane])
+                self._lanes[lane].clear()
+                self._lane_tuples[lane] = 0
+            leftovers.extend(self._current_round)
+            self._cond.notify_all()
+        for item in leftovers:
+            if not item.fut.done():
+                try:
+                    item.fut.set_exception(RuntimeError("check batcher stopped"))
+                except InvalidStateError:
+                    pass
 
     # -- API -----------------------------------------------------------------
 
@@ -110,13 +185,15 @@ class CheckBatcher:
         at_least: Optional[int] = None,
         latest: bool = False,
         deadline: Optional[float] = None,
+        lane: Optional[str] = None,
     ) -> bool:
         """Blocking single check, transparently batched with concurrent
         callers. Default consistency is the serving mode (bounded
         staleness, never stalled by a rebuild); ``at_least`` pins a
         caller's snaptoken, ``latest`` forces read-your-writes."""
         return self.check_with_token(
-            tuple_, timeout, at_least=at_least, latest=latest, deadline=deadline
+            tuple_, timeout, at_least=at_least, latest=latest, deadline=deadline,
+            lane=lane,
         )[0]
 
     def check_with_token(
@@ -127,6 +204,7 @@ class CheckBatcher:
         at_least: Optional[int] = None,
         latest: bool = False,
         deadline: Optional[float] = None,
+        lane: Optional[str] = None,
     ) -> tuple[bool, Optional[int]]:
         """``check`` plus the id of the snapshot that decided it (None when
         the engine has no snapshot concept — e.g. the recursive oracle,
@@ -137,64 +215,168 @@ class CheckBatcher:
         request so the collector sheds it *before packing* if it expires
         waiting, and the caller gets ``ErrDeadlineExceeded`` (504 /
         DEADLINE_EXCEEDED) instead of an answer nobody is waiting for.
-        ``timeout`` remains the relative cap; the earlier of the two
-        wins."""
+        ``timeout`` remains the relative cap; the earlier of the two wins.
+        ``lane`` pins the priority lane (single checks default to
+        interactive)."""
+        results, token = self._submit(
+            [tuple_], timeout, at_least, latest, deadline, lane or INTERACTIVE
+        )
+        return bool(results[0]), token
+
+    def check_batch(
+        self,
+        tuples: Sequence[RelationTuple],
+        timeout: Optional[float] = None,
+        *,
+        at_least: Optional[int] = None,
+        latest: bool = False,
+        deadline: Optional[float] = None,
+        lane: Optional[str] = None,
+    ) -> list[bool]:
+        """Pre-batched requests ride the lanes like everything else: big
+        chunks land in the batch lane and dispatch in bounded sub-slices
+        that interleave with interactive work."""
+        return self.check_batch_with_token(
+            tuples, timeout, at_least=at_least, latest=latest, deadline=deadline,
+            lane=lane,
+        )[0]
+
+    def check_batch_with_token(
+        self,
+        tuples: Sequence[RelationTuple],
+        timeout: Optional[float] = None,
+        *,
+        at_least: Optional[int] = None,
+        latest: bool = False,
+        deadline: Optional[float] = None,
+        lane: Optional[str] = None,
+    ) -> tuple[list[bool], Optional[int]]:
+        tuples = list(tuples)
+        if not tuples:
+            return [], None
+        if lane is None:
+            lane = self.classify_lane(len(tuples), None)
+        results, token = self._submit(tuples, timeout, at_least, latest, deadline, lane)
+        return [bool(r) for r in results], token
+
+    def classify_lane(self, n_tuples: int, hint: Optional[str]) -> str:
+        """The lane a request belongs to: an explicit hint wins, else
+        size decides (≤ ``interactive_max_tuples`` → interactive)."""
+        if hint in LANES:
+            return hint
+        return INTERACTIVE if n_tuples <= self._interactive_max_tuples else BATCH
+
+    def admission_precheck(self, lane: str = BATCH) -> None:
+        """Cheap early shed: raise ``ErrTooManyRequests`` when the batch
+        lane is already over its admitted window. Serving layers call
+        this BEFORE decoding a batch payload — during a brownout the
+        refusals must cost microseconds, not a 64k-tuple JSON parse, or
+        the parse work itself becomes the overload."""
+        if lane != BATCH or self.admission is None:
+            return
+        with self._cond:
+            self.admission.tick(backlog=self._lane_tuples[BATCH])
+            if self._lane_tuples[BATCH] >= self.admission.window:
+                raise self._shed(
+                    lane, True,
+                    "batch lane over the admitted window (server near its "
+                    "latency budget); retry after the advised backoff",
+                )
+
+    # -- enqueue -------------------------------------------------------------
+
+    def _submit(self, tuples, timeout, at_least, latest, deadline, lane):
         if self._stop.is_set():
             raise RuntimeError("check batcher stopped")
+        if lane not in LANES:
+            raise ValueError(f"unknown priority lane {lane!r} (expected {LANES})")
         if timeout is not None:
             t_deadline = time.monotonic() + timeout
             deadline = t_deadline if deadline is None else min(deadline, t_deadline)
         if deadline is not None and time.monotonic() >= deadline:
             raise ErrDeadlineExceeded("deadline expired before the check was queued")
-        fut: Future = Future()
-        item = (tuple_, fut, at_least, latest, deadline)
-        if self._shed_on_full:
-            # serving mode: a full queue answers 429 NOW — the device is
-            # backlogged and queueing deeper only converts the client's
-            # timeout budget into server memory
-            try:
-                self._queue.put_nowait(item)
-            except queue.Full:
-                self.shed_count += 1
-                raise ErrTooManyRequests(
-                    "check queue full (device backlogged); retry with backoff"
-                ) from None
-        else:
-            try:
-                # a full queue blocks the caller — the backpressure seam
-                # between accepts and the device — against the SAME
-                # deadline the result wait uses, so the total never
-                # exceeds ``timeout``
-                block = None
-                if deadline is not None:
-                    block = max(0.0, deadline - time.monotonic())
-                self._queue.put(item, timeout=block)
-            except queue.Full:
-                raise TimeoutError("check queue full (device backlogged)") from None
-        with self._inflight_lock:
-            self._inflight += 1
-            self._idle.clear()
-        fut.add_done_callback(self._note_done)
-        if self._stop.is_set() and not fut.done():
-            # raced with stop()'s drain: nobody will serve the queue
-            # anymore — unless the collector's final batch got there first
-            try:
-                fut.set_exception(RuntimeError("check batcher stopped"))
-            except InvalidStateError:
-                pass  # the collector resolved it; return that result
+        item = _Item(tuples, Future(), at_least, latest, deadline, lane)
+        self._enqueue(item)
         remaining = None
         if deadline is not None:
             remaining = max(0.0, deadline - time.monotonic())
         try:
-            return fut.result(timeout=remaining)
+            return item.fut.result(timeout=remaining)
         except FutureTimeout:
             raise ErrDeadlineExceeded(
                 "deadline expired waiting for the check result"
             ) from None
 
-    def check_batch(self, tuples: Sequence[RelationTuple]) -> list[bool]:
-        """Pre-batched requests skip the queue entirely."""
-        return self._engine.batch_check(list(tuples))
+    def _shed(self, lane: str, admission: bool, message: str) -> ErrTooManyRequests:
+        self.shed_count += 1
+        self.shed_by_lane[lane] += 1
+        if admission:
+            self.admission_shed_count += 1
+        retry_after = (
+            self.admission.retry_after_s() if self.admission is not None else 1.0
+        )
+        return ErrTooManyRequests(message, retry_after_s=retry_after)
+
+    def _enqueue(self, item: _Item) -> None:
+        lane, n = item.lane, item.n
+        with self._cond:
+            if self._stop.is_set():
+                raise RuntimeError("check batcher stopped")
+            if lane == BATCH and self.admission is not None:
+                self.admission.tick(backlog=self._lane_tuples[BATCH])
+                if self._lane_tuples[BATCH] + n > self.admission.window:
+                    raise self._shed(
+                        lane, True,
+                        "batch lane over the admitted window (server near its "
+                        "latency budget); retry after the advised backoff",
+                    )
+            cap = self._max_pending
+            if self._shed_on_full:
+                # serving mode: a full lane answers 429 NOW — the device
+                # is backlogged and queueing deeper only converts the
+                # client's timeout budget into server memory. An
+                # oversized chunk is still admitted into an EMPTY lane
+                # (the sub-slice split serves it in bounded rounds).
+                if self._lane_tuples[lane] + n > cap and self._lane_tuples[lane] > 0:
+                    raise self._shed(
+                        lane, False,
+                        "check queue full (device backlogged); retry with backoff",
+                    )
+            else:
+                # library mode: a full lane blocks the caller — the
+                # backpressure seam between accepts and the device —
+                # against the SAME deadline the result wait uses. A
+                # deadline that expires while blocked here is a 504
+                # (ErrDeadlineExceeded), NOT a queue-full error: the
+                # caller ran out of time, the server did not refuse it.
+                while (
+                    self._lane_tuples[lane] + n > cap and self._lane_tuples[lane] > 0
+                ):
+                    if self._stop.is_set():
+                        raise RuntimeError("check batcher stopped")
+                    if item.deadline is not None:
+                        remaining = item.deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise ErrDeadlineExceeded(
+                                "deadline expired while blocked on a full check queue"
+                            )
+                        self._cond.wait(timeout=min(remaining, 0.25))
+                    else:
+                        self._cond.wait(timeout=0.25)
+            self._lanes[lane].append(item)
+            self._lane_tuples[lane] += n
+            self._cond.notify_all()
+        with self._inflight_lock:
+            self._inflight += 1
+            self._idle.clear()
+        item.fut.add_done_callback(self._note_done)
+        if self._stop.is_set() and not item.fut.done():
+            # raced with stop()'s drain: nobody will serve the queue
+            # anymore — unless the collector's final round got there first
+            try:
+                item.fut.set_exception(RuntimeError("check batcher stopped"))
+            except InvalidStateError:
+                pass  # the collector resolved it; return that result
 
     # -- graceful drain ------------------------------------------------------
 
@@ -212,9 +394,16 @@ class CheckBatcher:
 
     @property
     def queue_depth(self) -> int:
-        """Requests queued but not yet packed into a device batch (the
-        /metrics pressure gauge; approximate by nature)."""
-        return self._queue.qsize()
+        """Tuples queued across both lanes, not yet packed into a device
+        batch (the /metrics pressure gauge; approximate by nature)."""
+        with self._cond:
+            return sum(self._lane_tuples.values())
+
+    @property
+    def lane_depths(self) -> dict[str, int]:
+        """Queued tuples per lane (the /metrics per-lane gauge)."""
+        with self._cond:
+            return dict(self._lane_tuples)
 
     def drain(self, timeout_s: float) -> bool:
         """Wait until every in-flight request has been answered (the
@@ -222,6 +411,8 @@ class CheckBatcher:
         override before this runs). True when the batcher went idle
         within ``timeout_s``."""
         return self._idle.wait(timeout=max(0.0, timeout_s))
+
+    # -- dispatch ------------------------------------------------------------
 
     @staticmethod
     def _consistency_kw(at_leasts, latests) -> dict:
@@ -235,7 +426,7 @@ class CheckBatcher:
         return {"at_least": max(floors) if floors else None, "mode": "serving"}
 
     def _dispatch(self, tuples, at_leasts, latests):
-        """One engine call for a coalesced batch."""
+        """One engine call for a coalesced round."""
         if hasattr(self._engine, "batch_check_with_token"):
             return self._engine.batch_check_with_token(
                 tuples, **self._consistency_kw(at_leasts, latests)
@@ -246,35 +437,61 @@ class CheckBatcher:
             return self._engine.batch_check(tuples), None
         return [self._engine.subject_is_allowed(t) for t in tuples], None
 
-    def _expire(self, fut: Future) -> None:
+    def _expire(self, item: _Item) -> None:
         self.deadline_drop_count += 1
-        if not fut.done():
-            fut.set_exception(
-                ErrDeadlineExceeded("deadline expired before dispatch")
-            )
+        if not item.fut.done():
+            try:
+                item.fut.set_exception(
+                    ErrDeadlineExceeded("deadline expired before dispatch")
+                )
+            except InvalidStateError:
+                pass
 
-    def _dispatch_stream(self, batch, at_leasts, latests) -> None:
+    def _fill(self, item: _Item, idx: int, allowed: bool, token) -> None:
+        if item.results[idx] is None:
+            item.results[idx] = allowed
+            item.remaining -= 1
+        if item.remaining == 0 and not item.fut.done():
+            try:
+                item.fut.set_result((item.results, token))
+            except InvalidStateError:
+                pass  # expired/failed concurrently; caller already has an answer
+
+    def _emit_live(self, segments):
+        """Flatten this round's segments into (item, idx) → tuple pairs,
+        shedding items whose deadline has passed: they never occupy a
+        device slice (an expired request in a slice would displace a live
+        one), and their callers hear 504 immediately."""
+        emitted: list = []
+        now = time.monotonic()
+        for item, start, count in segments:
+            if item.fut.done():
+                continue
+            if item.deadline is not None and now >= item.deadline:
+                self._expire(item)
+                continue
+            for idx in range(start, start + count):
+                emitted.append((item, idx))
+        return emitted
+
+    def _dispatch_stream(self, segments, at_leasts, latests) -> None:
         """Streaming dispatch for engines with the ready-order stream API:
         each caller's future resolves the moment ITS slice lands (the
         ``ordered=False`` fast path — re-association is by query offset),
-        so early-finishing slices of a large coalesced batch don't wait
-        behind stragglers. Mid-stream failures propagate to the caller
-        (``_loop`` retries unresolved futures once, then fails them).
-
-        Deadlines are enforced at PACK time: the tuple iterator the
-        stream slices from skips requests whose deadline has passed —
-        they get ``ErrDeadlineExceeded`` and never occupy a device slice
-        (an expired request in a slice would displace a live one)."""
-        emitted: list = []  # stream offset -> batch item, built at pull time
+        so early-finishing slices don't wait behind stragglers, and the
+        interactive tuples at the head of the round land first."""
+        emitted: list = []  # stream offset -> (item, idx), built at pull time
 
         def live_tuples():
-            for item in batch:
-                dl = item[4]
-                if dl is not None and time.monotonic() >= dl:
-                    self._expire(item[1])
+            for item, start, count in segments:
+                if item.fut.done():
                     continue
-                emitted.append(item)
-                yield item[0]
+                if item.deadline is not None and time.monotonic() >= item.deadline:
+                    self._expire(item)
+                    continue
+                for idx in range(start, start + count):
+                    emitted.append((item, idx))
+                    yield item.tuples[idx]
 
         gen, token = self._engine.batch_check_stream_with_token(
             live_tuples(), ordered=False,
@@ -282,94 +499,142 @@ class CheckBatcher:
         )
         for off, out in gen:
             for j, allowed in enumerate(out.tolist()):
-                fut = emitted[off + j][1]
-                if not fut.done():
-                    fut.set_result((bool(allowed), token))
+                item, idx = emitted[off + j]
+                self._fill(item, idx, bool(allowed), token)
 
     # -- collector -----------------------------------------------------------
 
+    def _queued(self) -> int:
+        return self._lane_tuples[INTERACTIVE] + self._lane_tuples[BATCH]
+
+    def _take_locked(self) -> list:
+        """Pack one dispatch round (called under ``_cond``): interactive
+        items first — every one of them rides the NEXT round — then batch
+        lane work up to ``batch_sub_slice``, taking *partial* chunks so a
+        monster batch request interleaves instead of convoying. A reserve
+        keeps the batch lane moving when interactive traffic alone could
+        fill every round. Returns ``[(item, start, count), ...]``."""
+        segments = []
+        n = 0
+        cap = self._batch_size
+        inter, batchq = self._lanes[INTERACTIVE], self._lanes[BATCH]
+        reserve = self._batch_reserve if batchq else 0
+        inter_cap = max(1, cap - reserve)
+        while inter and n < inter_cap:
+            item = inter.popleft()
+            self._lane_tuples[INTERACTIVE] -= item.n
+            if item.fut.done():
+                continue  # expired/failed while queued
+            segments.append((item, 0, item.n))
+            item.taken = item.n
+            n += item.n
+        batch_cap = min(cap - n, self._sub_slice)
+        while batchq and batch_cap > 0:
+            head = batchq[0]
+            if head.fut.done():
+                batchq.popleft()
+                self._lane_tuples[BATCH] -= head.n - head.taken
+                continue
+            take = min(batch_cap, head.n - head.taken)
+            segments.append((head, head.taken, take))
+            head.taken += take
+            self._lane_tuples[BATCH] -= take
+            batch_cap -= take
+            n += take
+            if head.taken == head.n:
+                batchq.popleft()
+        return segments
+
     def _loop(self) -> None:
         while not self._stop.is_set():
-            try:
-                # bounded wait so a stop() against a FULL queue (whose
-                # sentinel could not be enqueued) still terminates the loop
-                item = self._queue.get(timeout=0.25)
-            except queue.Empty:
+            with self._cond:
+                if not self._queued():
+                    # bounded wait so stop() always terminates the loop
+                    self._cond.wait(timeout=0.25)
+                    if not self._queued():
+                        continue
+                # coalescing window: wait for more arrivals up to
+                # window_ms or a full round — each wait blocks on the
+                # condition for exactly the remaining window, no polling
+                window_end = time.monotonic() + self._window_s
+                while self._queued() < self._batch_size and not self._stop.is_set():
+                    remaining = window_end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                segments = self._take_locked()
+                self._current_round = [item for item, _, _ in segments]
+                backlog = self._lane_tuples[BATCH]
+                # space freed: wake producers blocked on a full lane
+                self._cond.notify_all()
+            if not segments:
                 continue
-            if item is None:
-                continue
-            batch = [item]
-            # drain whatever arrives within the window, up to batch_size —
-            # each wait blocks on the queue's condition for exactly the
-            # remaining window, no polling
-            deadline = time.monotonic() + self._window_s
-            while len(batch) < self._batch_size:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    nxt = self._queue.get(timeout=remaining)
-                except queue.Empty:
-                    break
-                if nxt is None:
-                    break
-                batch.append(nxt)
-
-            # shed expired requests before any engine work: they never
-            # occupy a slice, and their callers hear 504 immediately
-            now = time.monotonic()
-            live = []
-            for item in batch:
-                if item[4] is not None and now >= item[4]:
-                    self._expire(item[1])
-                else:
-                    live.append(item)
-            batch = live
-            if not batch:
-                continue
-            at_leasts = [a for _, _, a, _, _ in batch]
-            latests = [l for _, _, _, l, _ in batch]
+            if self.admission is not None:
+                self.admission.tick(backlog=backlog)
+            n_tuples = sum(count for _, _, count in segments)
+            t0 = time.monotonic()
             try:
                 faults.check("check-dispatch")
+                at_leasts = [item.at_least for item, _, _ in segments]
+                latests = [item.latest for item, _, _ in segments]
                 if hasattr(self._engine, "batch_check_stream_with_token"):
-                    self._dispatch_stream(batch, at_leasts, latests)
-                    continue
-                tuples = [t for t, _, _, _, _ in batch]
-                results, token = self._dispatch(tuples, at_leasts, latests)
+                    self._dispatch_stream(segments, at_leasts, latests)
+                else:
+                    emitted = self._emit_live(segments)
+                    if emitted:
+                        results, token = self._dispatch(
+                            [item.tuples[idx] for item, idx in emitted],
+                            at_leasts, latests,
+                        )
+                        for (item, idx), allowed in zip(emitted, results):
+                            self._fill(item, idx, bool(allowed), token)
             except Exception as e:
-                self._fail_or_retry(batch, e)
-                continue
-            for (_, fut, _, _, _), allowed in zip(batch, results):
-                if not fut.done():
-                    fut.set_result((allowed, token))
+                self._fail_or_retry(segments, e)
+            finally:
+                if self.admission is not None:
+                    self.admission.observe_round(n_tuples, time.monotonic() - t0)
+                with self._cond:
+                    self._current_round = []
 
-    def _fail_or_retry(self, batch, exc: Exception) -> None:
+    def _fail_or_retry(self, segments, exc: Exception) -> None:
         """A failed dispatch retries its unresolved requests ONCE through
         the engine's plain batch path — a device fault mid-stream flips
         the engine into its CPU degraded mode, so the retry lands on the
         fallback and callers never see the fault. Client errors
         (KetoError) and a failed retry propagate to every waiting
         future."""
-        pending = [item for item in batch if not item[1].done()]
+        pending = []
+        for item, start, count in segments:
+            if item.fut.done():
+                continue
+            idxs = [i for i in range(start, start + count) if item.results[i] is None]
+            if idxs:
+                pending.append((item, idxs))
         if pending and not isinstance(exc, KetoError):
+            n = sum(len(idxs) for _, idxs in pending)
             _log.warning(
                 "batch dispatch failed (%s: %s); retrying %d unresolved "
                 "checks on the engine's recovery path",
-                type(exc).__name__, exc, len(pending),
+                type(exc).__name__, exc, n,
             )
             try:
                 results, token = self._dispatch(
-                    [t for t, _, _, _, _ in pending],
-                    [a for _, _, a, _, _ in pending],
-                    [l for _, _, _, l, _ in pending],
+                    [item.tuples[i] for item, idxs in pending for i in idxs],
+                    [item.at_least for item, _ in pending],
+                    [item.latest for item, _ in pending],
                 )
             except Exception as e2:
                 exc = e2
             else:
-                for (_, fut, _, _, _), allowed in zip(pending, results):
-                    if not fut.done():
-                        fut.set_result((bool(allowed), token))
+                k = 0
+                for item, idxs in pending:
+                    for i in idxs:
+                        self._fill(item, i, bool(results[k]), token)
+                        k += 1
                 return
-        for item in batch:
-            if not item[1].done():
-                item[1].set_exception(exc)
+        for item, _, _ in segments:
+            if not item.fut.done():
+                try:
+                    item.fut.set_exception(exc)
+                except InvalidStateError:
+                    pass

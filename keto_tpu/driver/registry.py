@@ -174,14 +174,45 @@ class Registry:
 
     def check_batcher(self) -> CheckBatcher:
         def build():
+            engine = self.permission_engine()
+            batch_size = int(self._config.get("engine.batch_size", 4096))
+            max_pending = 8 * batch_size
+            # adaptive admission control: AIMD over the batch lane, keyed
+            # off the SAME slice service-time stats the stream width
+            # controller steers by, plus the batcher's own queue-delay
+            # estimate (keto_tpu/driver/admission.py)
+            admission = None
+            if bool(self._config.get("serve.admission_enabled", True)):
+                from keto_tpu.driver.admission import AdmissionController
+
+                budget = float(
+                    self._config.get("serve.admission_latency_budget_ms", 0.0)
+                )
+                admission = AdmissionController(
+                    stats=getattr(engine, "stream_slice_stats", None),
+                    target_ms=float(
+                        self._config.get("serve.stream_slice_target_ms", 40.0)
+                    ),
+                    budget_ms=budget or None,
+                    min_window=int(
+                        self._config.get("serve.admission_min_window", 64)
+                    ),
+                    max_window=max_pending,
+                )
             b = CheckBatcher(
-                self.permission_engine(),
-                batch_size=int(self._config.get("engine.batch_size", 4096)),
+                engine,
+                batch_size=batch_size,
                 window_ms=float(self._config.get("engine.batch_window_ms", 1.0)),
+                max_pending=max_pending,
                 # serving processes shed on a full queue (429 /
                 # RESOURCE_EXHAUSTED) instead of letting callers block
                 # into their own timeouts — backpressure with an answer
                 shed_on_full=bool(self._config.get("serve.shed_on_full", True)),
+                interactive_max_tuples=int(
+                    self._config.get("serve.interactive_max_tuples", 16)
+                ),
+                batch_sub_slice=int(self._config.get("serve.batch_sub_slice", 1024)),
+                admission=admission,
             )
             b.start()
             return b
@@ -279,6 +310,65 @@ class Registry:
             "Check requests dropped before dispatch because their deadline "
             "expired (504/DEADLINE_EXCEEDED).",
             batcher_attr("deadline_drop_count"),
+        )
+
+        from keto_tpu.driver.batch import LANES
+
+        def lane_map(attr):
+            def read():
+                b = self.peek("check_batcher")
+                vals = getattr(b, attr, {}) if b is not None else {}
+                return [((lane,), float(vals.get(lane, 0))) for lane in LANES]
+
+            return read
+
+        m.register_callback(
+            "keto_lane_queue_depth", "gauge",
+            "Priority lanes: tuples queued per lane, not yet packed into a "
+            "dispatch round.",
+            lane_map("lane_depths"), ("lane",),
+        )
+        m.register_callback(
+            "keto_lane_shed_total", "counter",
+            "Requests refused at the door per lane (queue full or over the "
+            "admission window), 429/RESOURCE_EXHAUSTED + Retry-After.",
+            lane_map("shed_by_lane"), ("lane",),
+        )
+        m.register_callback(
+            "keto_admission_shed_total", "counter",
+            "Batch-lane requests shed by the AIMD admission window "
+            "specifically (subset of keto_check_shed_total).",
+            batcher_attr("admission_shed_count"),
+        )
+
+        def admission_attr(attr, scale=1.0):
+            def read():
+                b = self.peek("check_batcher")
+                a = getattr(b, "admission", None) if b is not None else None
+                v = getattr(a, attr, 0) if a is not None else 0
+                yield (), float(v or 0) * scale
+
+            return read
+
+        m.register_callback(
+            "keto_admission_window", "gauge",
+            "AIMD admission control: currently admitted batch-lane window "
+            "(queued tuples); shrinks multiplicatively past the latency "
+            "budget, recovers additively.",
+            admission_attr("window"),
+        )
+        m.register_callback(
+            "keto_admission_latency_budget_seconds", "gauge",
+            "The latency budget the admission controller sheds against "
+            "(serve.admission_latency_budget_ms, default 4x the slice "
+            "target).",
+            admission_attr("budget_ms", 1e-3),
+        )
+        m.register_callback(
+            "keto_admission_observed_p99_seconds", "gauge",
+            "Slice service-time p99 the admission controller last judged "
+            "(same DurationStats the stream width controller steers by).",
+            admission_attr("last_p99_ms", 1e-3),
         )
 
         def maintenance_raw():

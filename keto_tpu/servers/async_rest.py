@@ -10,6 +10,11 @@ backpressure lands in the pool's queue instead of in an unbounded thread
 count. Selected via ``serve.http_backend`` (default ``async``;
 ``threading`` keeps the stdlib backend).
 
+The handler pool is LANED by endpoint: ``/check/batch`` requests run on
+their own smaller pool, so batch POSTs blocked on chunk futures can
+never occupy every handler thread and convoy interactive checks at the
+HTTP layer — the server-side face of the batcher's priority lanes.
+
 Protocol scope matches the reference surface: Content-Length bodies
 (no chunked requests), small JSON responses, no upgrades.
 """
@@ -30,6 +35,15 @@ _MAX_HEAD = 64 * 1024
 _MAX_BODY = 64 * 1024 * 1024
 
 _REASONS = {s.value: s.phrase for s in HTTPStatus}
+
+#: the listener-level shed envelope (matches the x/errors 429 rendering)
+_SHED_BODY = {
+    "error": {
+        "code": 429,
+        "status": "Too Many Requests",
+        "message": "batch check backlog full (server overloaded); retry with backoff",
+    }
+}
 
 
 class AsyncRestServer:
@@ -62,6 +76,20 @@ class AsyncRestServer:
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix=f"rest-{role}"
         )
+        # batch-check requests block their handler thread for the whole
+        # chunk's latency AND burn real CPU decoding their payloads; a
+        # dedicated small pool keeps them from convoying interactive
+        # checks out of handler threads (or out of the GIL). The pool's
+        # waiting line is BOUNDED: past _batch_limit pending exchanges
+        # the listener sheds 429 + Retry-After straight from the event
+        # loop — every queue in the path is bounded and sheds
+        # explicitly, none hides unbounded latency
+        n_batch = max(4, workers // 8)
+        self._batch_pool = ThreadPoolExecutor(
+            max_workers=n_batch, thread_name_prefix=f"rest-{role}-batch"
+        )
+        self._batch_limit = 3 * n_batch
+        self._batch_pending = 0  # event-loop thread only
 
     @property
     def port(self) -> int:
@@ -123,6 +151,7 @@ class AsyncRestServer:
         loop = self._loop
         if loop is None or not loop.is_running():
             self._pool.shutdown(wait=False, cancel_futures=True)
+            self._batch_pool.shutdown(wait=False, cancel_futures=True)
             return
 
         async def teardown():
@@ -148,6 +177,7 @@ class AsyncRestServer:
             self._thread.join(timeout=5)
             self._thread = None
         self._pool.shutdown(wait=False, cancel_futures=True)
+        self._batch_pool.shutdown(wait=False, cancel_futures=True)
 
     # -- per-connection ------------------------------------------------------
 
@@ -187,19 +217,36 @@ class AsyncRestServer:
                 body = await reader.readexactly(length) if length else b""
                 parts = urlsplit(target)
                 query = parse_qs(parts.query, keep_blank_values=True)
-                self._active += 1
-                try:
-                    status, payload, extra = await asyncio.get_running_loop().run_in_executor(
-                        self._pool, self.app.handle, method, parts.path, query, body,
-                        headers,
+                close = (
+                    version == "HTTP/1.0"
+                    or headers.get("connection", "").lower() == "close"
+                )
+                is_batch = parts.path == "/check/batch"
+                if is_batch and self._batch_pending >= self._batch_limit:
+                    # listener-level shed: the batch pool's waiting line
+                    # is full — refuse for microseconds on the event loop
+                    # instead of queueing invisible seconds of latency
+                    self.app.note_listener_shed(method, parts.path)
+                    await self._write_response(
+                        writer, 429, _SHED_BODY, {"Retry-After": "1"}, close
                     )
-                    close = (
-                        version == "HTTP/1.0"
-                        or headers.get("connection", "").lower() == "close"
+                    if close:
+                        return
+                    continue
+                self._active += 1
+                if is_batch:
+                    self._batch_pending += 1
+                try:
+                    pool = self._batch_pool if is_batch else self._pool
+                    status, payload, extra = await asyncio.get_running_loop().run_in_executor(
+                        pool, self.app.handle, method, parts.path, query, body,
+                        headers,
                     )
                     await self._write_response(writer, status, payload, extra, close)
                 finally:
                     self._active -= 1
+                    if is_batch:
+                        self._batch_pending -= 1
                 if close:
                     return
         except (

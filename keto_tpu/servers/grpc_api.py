@@ -17,6 +17,7 @@ internal/check/handler.go:162).
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 import uuid
@@ -52,6 +53,17 @@ _CODE_BY_NUM = {c.value[0]: c for c in grpc.StatusCode}
 
 
 def _abort(context, err: KetoError):
+    # overload errors (RESOURCE_EXHAUSTED / UNAVAILABLE) carry the
+    # server's backoff advice as trailing metadata — the gRPC face of
+    # the REST Retry-After header
+    retry_after = getattr(err, "retry_after_s", None)
+    if retry_after:
+        try:
+            context.set_trailing_metadata(
+                (("retry-after", str(max(1, math.ceil(retry_after)))),)
+            )
+        except Exception:
+            pass  # stream torn down; the status still reaches the client
     context.abort(_CODE_BY_NUM.get(err.grpc_code, grpc.StatusCode.INTERNAL), err.message)
 
 
@@ -154,13 +166,25 @@ class CheckService:
                 ) from None
         # the client's gRPC deadline rides into the batcher: a request
         # that expires queued is shed with DEADLINE_EXCEEDED *before* it
-        # occupies a device slice; a full queue is RESOURCE_EXHAUSTED
+        # occupies a device slice; a full queue (or the admission window)
+        # is RESOURCE_EXHAUSTED with retry-after trailing metadata
         deadline = None
         remaining = context.time_remaining()
         if remaining is not None:
             deadline = time.monotonic() + max(0.0, remaining)
+        # optional priority-lane hint, the gRPC face of X-Keto-Priority
+        lane = None
+        for k, v in context.invocation_metadata() or ():
+            if k.lower() == "x-keto-priority" and v:
+                lane = v.strip().lower()
+                if lane not in ("interactive", "batch"):
+                    raise ErrBadRequest(
+                        f"invalid x-keto-priority {v!r} (expected interactive|batch)"
+                    )
+                break
         allowed, token = self.registry.check_batcher().check_with_token(
-            tuple_, at_least=at_least, latest=request.latest, deadline=deadline
+            tuple_, at_least=at_least, latest=request.latest, deadline=deadline,
+            lane=lane,
         )
         return check_service_pb2.CheckResponse(
             allowed=allowed, snaptoken="" if token is None else str(token)

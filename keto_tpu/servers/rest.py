@@ -7,6 +7,12 @@ Endpoint behavior is a 1:1 mapping of the reference REST surface:
   internal/check/handler.go:85-107); the *status code mirrors the
   decision*: 200 allowed / 403 denied, body ``{"allowed": bool}``.
 - ``POST /check`` takes the tuple as JSON (handler.go:128-146).
+- ``POST /check/batch`` takes ``{"tuples": [...]}`` and answers
+  ``{"results": [bool, ...]}`` in order — big payloads ride the
+  batcher's BATCH priority lane and dispatch in bounded sub-slices that
+  interleave with interactive checks. An ``X-Keto-Priority`` header
+  (``interactive`` | ``batch``) pins the lane on any check route;
+  without it, request size classifies.
 - ``GET /expand`` requires ``max-depth`` plus a subject-set query and
   returns the tree JSON (reference internal/expand/handler.go:79-92).
 - ``GET /relation-tuples`` decodes a RelationQuery + ``page_token`` /
@@ -32,8 +38,11 @@ Endpoint behavior is a 1:1 mapping of the reference REST surface:
 Deadline propagation: an ``X-Request-Timeout-Ms`` header (or
 ``timeout_ms`` query parameter) on ``/check`` rides into the batcher as
 an absolute deadline — expired requests shed with **504** before they
-occupy a device slice, and a full check queue sheds with **429**
-(keto_tpu/driver/batch.py).
+occupy a device slice, and a full check queue (or the adaptive
+admission window, keto_tpu/driver/admission.py) sheds with **429 +
+Retry-After** (keto_tpu/driver/batch.py). Every overload response (429,
+and 503 while NOT_SERVING) carries a ``Retry-After`` header with the
+server's backoff advice.
 
 Request correlation: every non-health request gets (or echoes) an
 ``X-Request-Id``, joins the caller's trace when a W3C ``traceparent``
@@ -49,6 +58,7 @@ Errors render the herodot-style envelope from keto_tpu/x/errors.py.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 import uuid
@@ -71,6 +81,21 @@ from keto_tpu.x.tracing import parse_traceparent
 
 READ = "read"
 WRITE = "write"
+
+
+#: upper bound on one /check/batch payload — bigger requests should page
+#: (the batcher would serve it, but a single response holding >64k bools
+#: is a client bug more often than a workload)
+MAX_BATCH_CHECK = 65536
+
+
+def _retry_after_headers(err: KetoError) -> dict[str, str]:
+    """Overload errors carry the server's backoff advice: a Retry-After
+    header (integer seconds) on 429/503 responses."""
+    ra = getattr(err, "retry_after_s", None)
+    if not ra:
+        return {}
+    return {"Retry-After": str(max(1, math.ceil(ra)))}
 
 
 @dataclass
@@ -157,6 +182,12 @@ class RestApp:
         resp_headers.setdefault("X-Request-Id", req_id)
         return status, payload, resp_headers
 
+    def note_listener_shed(self, method: str, path: str) -> None:
+        """Record a listener-level 429 (shed on the event loop before any
+        handler ran) into the request metrics, so overload refusals stay
+        visible per route."""
+        self._req_count.inc((self.role, method, normalize_route(path), "429"))
+
     def _route(
         self,
         method: str,
@@ -181,6 +212,8 @@ class RestApp:
                     return self._get_check(query, headers)
                 if route == ("POST", "/check"):
                     return self._post_check(body, query, headers)
+                if route == ("POST", "/check/batch"):
+                    return self._post_check_batch(body, query, headers)
                 if route == ("GET", "/expand"):
                     return self._get_expand(query)
                 if route == ("GET", "/relation-tuples"):
@@ -197,7 +230,7 @@ class RestApp:
             err.status_code = 404
             return 404, err.to_json(), {}
         except KetoError as e:
-            return e.status_code, e.to_json(), {}
+            return e.status_code, e.to_json(), _retry_after_headers(e)
         except Exception as e:  # unexpected → 500 envelope
             err = KetoError(str(e) or "internal server error")
             return 500, err.to_json(), {}
@@ -237,7 +270,10 @@ class RestApp:
         state, reason = self.registry.health_monitor().status()
         if state not in READY_STATES:
             body = {"status": "unavailable", "reason": reason or state.value}
-            return 503, body, {}
+            # backoff advice rides the 503: probes already poll on their
+            # own period, but ad-hoc clients should not hammer a server
+            # that just told them its snapshot is stale
+            return 503, body, {"Retry-After": "1"}
         if state is HealthState.SERVING:
             return 200, {"status": "ok"}, {}
         body = {"status": state.value}
@@ -265,11 +301,25 @@ class RestApp:
             raise ErrBadRequest(f"timeout_ms must be > 0, got {raw!r}")
         return time.monotonic() + ms / 1e3
 
-    def _check(self, tuple_: RelationTuple, query, headers=None):
-        # per-request consistency (the REST face of the gRPC
-        # snaptoken/latest fields): ?snaptoken=<token from a write or a
-        # previous check> serves at-least-that-fresh; ?latest=true forces
-        # read-your-writes; default is the never-stalling serving mode
+    @staticmethod
+    def _lane_from(headers) -> Optional[str]:
+        """The optional ``X-Keto-Priority`` lane hint (``interactive`` |
+        ``batch``); absent → None (the batcher classifies by size),
+        anything else is a 400."""
+        raw = (headers or {}).get("x-keto-priority", "").strip().lower()
+        if not raw:
+            return None
+        if raw in ("interactive", "batch"):
+            return raw
+        raise ErrBadRequest(
+            f"invalid X-Keto-Priority {raw!r} (expected interactive|batch)"
+        )
+
+    @staticmethod
+    def _consistency_from(query):
+        """(at_least, latest) from ``?snaptoken=`` / ``?latest=`` — the
+        REST face of the gRPC snaptoken/latest fields; default is the
+        never-stalling serving mode."""
         raw_token = (query.get("snaptoken") or [""])[0]
         at_least = None
         if raw_token:
@@ -278,9 +328,14 @@ class RestApp:
             except ValueError:
                 raise ErrBadRequest(f"malformed snaptoken {raw_token!r}") from None
         latest = (query.get("latest") or [""])[0].lower() in ("1", "true")
+        return at_least, latest
+
+    def _check(self, tuple_: RelationTuple, query, headers=None):
+        at_least, latest = self._consistency_from(query)
         allowed, token = self.registry.check_batcher().check_with_token(
             tuple_, at_least=at_least, latest=latest,
             deadline=self._deadline_from(query, headers),
+            lane=self._lane_from(headers),
         )
         resp_headers = {} if token is None else {"X-Keto-Snaptoken": str(token)}
         return (200 if allowed else 403), {"allowed": allowed}, resp_headers
@@ -298,6 +353,42 @@ class RestApp:
         except json.JSONDecodeError as e:
             raise ErrBadRequest(f"Unable to decode JSON payload: {e}") from None
         return self._check(RelationTuple.from_json(obj), query, headers)
+
+    def _post_check_batch(self, body: bytes, query, headers=None):
+        """Many checks in one request: ``{"tuples": [...]}`` →
+        ``{"results": [bool, ...]}`` in order. Large payloads classify
+        into the batcher's BATCH lane (override with ``X-Keto-Priority``)
+        and dispatch in bounded sub-slices, so they never convoy
+        interactive checks; shed with 429 + Retry-After past the
+        admission window."""
+        lane_hint = self._lane_from(headers)
+        batcher = self.registry.check_batcher()
+        if lane_hint != "interactive":
+            # pre-parse shed: an over-window batch lane refuses BEFORE
+            # paying the JSON decode — during a brownout the 429s must
+            # cost microseconds or the parsing itself becomes the load
+            batcher.admission_precheck()
+        try:
+            obj = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            raise ErrBadRequest(f"Unable to decode JSON payload: {e}") from None
+        raw = obj.get("tuples") if isinstance(obj, dict) else None
+        if not isinstance(raw, list) or not raw:
+            raise ErrBadRequest('expected a non-empty "tuples" array')
+        if len(raw) > MAX_BATCH_CHECK:
+            raise ErrBadRequest(
+                f"too many tuples in one batch check ({len(raw)} > "
+                f"{MAX_BATCH_CHECK}); split the request"
+            )
+        tuples = [RelationTuple.from_json(t) for t in raw]
+        at_least, latest = self._consistency_from(query)
+        results, token = batcher.check_batch_with_token(
+            tuples, at_least=at_least, latest=latest,
+            deadline=self._deadline_from(query, headers),
+            lane=lane_hint,
+        )
+        resp_headers = {} if token is None else {"X-Keto-Snaptoken": str(token)}
+        return 200, {"results": [bool(r) for r in results]}, resp_headers
 
     def _get_expand(self, query):
         # the reference parses max-depth unconditionally — absent/invalid

@@ -16,16 +16,29 @@ from typing import Any, Optional
 
 
 class KetoError(Exception):
-    """Base error with an HTTP status code and a gRPC status code."""
+    """Base error with an HTTP status code and a gRPC status code.
+
+    ``retry_after_s`` is the server's backoff advice for retryable
+    overload errors (429/503): REST renders it as a ``Retry-After``
+    header, gRPC as ``retry-after`` trailing metadata, and the SDK's
+    retry policy sleeps it instead of its own backoff draw."""
 
     status_code: int = 500
     grpc_code: int = 13  # INTERNAL
 
-    def __init__(self, message: str = "", *, reason: str = "", details: Optional[dict] = None):
+    def __init__(
+        self,
+        message: str = "",
+        *,
+        reason: str = "",
+        details: Optional[dict] = None,
+        retry_after_s: Optional[float] = None,
+    ):
         super().__init__(message or self.__class__.__name__)
         self.message = message or self.default_message()
         self.reason = reason
         self.details = details or {}
+        self.retry_after_s = retry_after_s
 
     @classmethod
     def default_message(cls) -> str:

@@ -59,6 +59,7 @@ DEFAULT_LATENCY_BUCKETS = (
 KNOWN_ROUTES = frozenset(
     {
         "/check",
+        "/check/batch",
         "/expand",
         "/relation-tuples",
         "/version",

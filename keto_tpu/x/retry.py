@@ -59,12 +59,16 @@ def retry_call(
     jitter: float = 0.25,
     retryable: Optional[Callable[[BaseException], bool]] = None,
     on_retry: Optional[Callable[[BaseException, float], None]] = None,
+    delay_hint: Optional[Callable[[BaseException], Optional[float]]] = None,
 ):
     """Call ``fn()`` until it succeeds, raises a non-retryable error, or
     the next sleep would cross ``max_wait_s`` from now — then the last
     error propagates. ``retryable(exc)`` filters which failures retry
     (default: every ``Exception``); ``on_retry(exc, delay)`` observes each
-    scheduled retry (logging, counters)."""
+    scheduled retry (logging, counters). ``delay_hint(exc)`` may return
+    the server's own backoff advice (a 429/503 ``Retry-After``), which
+    replaces the backoff draw for that retry — an overloaded server's
+    explicit schedule beats a client-side guess."""
     deadline = time.monotonic() + max_wait_s
     backoff = Backoff(base_s=base_s, max_s=max_s, jitter=jitter)
     while True:
@@ -74,6 +78,10 @@ def retry_call(
             if retryable is not None and not retryable(e):
                 raise
             delay = backoff.next()
+            if delay_hint is not None:
+                hinted = delay_hint(e)
+                if hinted is not None:
+                    delay = max(0.0, float(hinted))
             if time.monotonic() + delay > deadline:
                 raise
             if on_retry is not None:

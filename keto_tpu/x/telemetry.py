@@ -53,6 +53,19 @@ class DurationStats:
             self._window.clear()
             self._count = 0
 
+    def tail(self, n: int) -> tuple[list[float], int]:
+        """``(last ≤n observations, total observation count)`` — the
+        admission controller reads the slice service times recorded since
+        its previous tick (by count delta) without resetting the window
+        other consumers (slice controller, bench) share."""
+        with self._lock:
+            count = self._count
+            if n <= 0:
+                return [], count
+            w = self._window
+            vals = list(w)
+            return (vals[-n:] if n < len(vals) else vals), count
+
     def snapshot(self) -> dict:
         """``{count, p50_ms, p99_ms, mean_ms, max_ms}`` over the window
         (zeros when nothing was observed)."""

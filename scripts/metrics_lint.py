@@ -58,6 +58,19 @@ def drive_traffic(read_port: int, write_port: int) -> None:
     urllib.request.urlopen(req, timeout=10)  # idempotent replay
     base = f"http://127.0.0.1:{read_port}"
     urllib.request.urlopen(f"{base}/check?namespace=files&object=o&relation=r&subject_id=u", timeout=10)
+    # batch-check: the priority-lane / admission-control path
+    batch = json.dumps(
+        {"tuples": [
+            {"namespace": "files", "object": "o", "relation": "r", "subject_id": "u"}
+        ]}
+    ).encode()
+    urllib.request.urlopen(
+        urllib.request.Request(
+            f"{base}/check/batch", data=batch, method="POST",
+            headers={"Content-Type": "application/json", "X-Keto-Priority": "batch"},
+        ),
+        timeout=10,
+    )
     try:
         urllib.request.urlopen(f"{base}/check?namespace=files&object=o&relation=r&subject_id=nobody", timeout=10)
     except urllib.error.HTTPError:

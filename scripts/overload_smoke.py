@@ -1,0 +1,97 @@
+"""overload-smoke: the CI gate on overload resilience.
+
+Runs a short open-loop burst scenario (CPU, tiny config) through
+bench.py's coordinated-omission-free harness and asserts the properties
+the priority-lane + admission-control design promises:
+
+1. the interactive lane's p99 stays BELOW the batch lane's p99 under
+   synthetic 3× overload (lanes actually prioritize);
+2. the server sheds (nonzero 429s / admission sheds) instead of
+   queueing without bound — overload becomes explicit backpressure;
+3. every shed response carries Retry-After backoff advice;
+4. the generator never deadlocks (every worker joins), and the SIGTERM
+   drain mid-overload resolves every pre-drain request definitively.
+
+Exit 0 when all hold; 1 with the violations listed.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+# small CPU shapes unless the caller already pinned them. Wide chunks
+# keep the batch lane's service-time floor (chunk/capacity) well above
+# interactive latency, and a light probe rate keeps the interactive
+# generator from contending with the server for CPU on small hosts —
+# both matter for a stable p99 comparison on 1–2 core runners.
+os.environ.setdefault("BENCH_OVERLOAD_S", "4.0")
+os.environ.setdefault("BENCH_OVERLOAD_OBJS", "500")
+os.environ.setdefault("BENCH_OVERLOAD_WORKERS", "32")
+os.environ.setdefault("BENCH_OVERLOAD_CHUNK", "2048")
+os.environ.setdefault("BENCH_OVERLOAD_BATCH", "256")
+os.environ.setdefault("BENCH_OVERLOAD_INTER_RATE", "60")
+
+
+def main() -> int:
+    from bench import log, run_overload
+
+    out = run_overload(random.Random(7042))
+    problems: list[str] = []
+
+    over = out.get("overload_3x") or {}
+    inter = over.get("interactive") or {}
+    batch = over.get("batch") or {}
+    if not inter.get("ok"):
+        problems.append("no successful interactive requests under overload")
+    if inter.get("p99_ms") is None or batch.get("p99_ms") is None:
+        problems.append("missing per-lane p99 under overload")
+    elif not inter["p99_ms"] < batch["p99_ms"]:
+        problems.append(
+            f"interactive p99 ({inter['p99_ms']} ms) not below batch p99 "
+            f"({batch['p99_ms']} ms) — lanes are not prioritizing"
+        )
+    shed = (over.get("server_shed_total") or 0) + (inter.get("shed_429") or 0) + (
+        batch.get("shed_429") or 0
+    )
+    if shed == 0:
+        problems.append("zero sheds at 3x capacity — admission control never engaged")
+    if batch.get("retry_after_on_sheds") is False:
+        problems.append("a 429 shed was missing its Retry-After header")
+    if inter.get("retry_after_on_sheds") is False:
+        problems.append("an interactive 429 was missing its Retry-After header")
+    for phase in ("uncontended", "overload_3x", "slow_device"):
+        section = out.get(phase) or {}
+        if section and section.get("all_workers_joined") is False:
+            problems.append(f"{phase}: load-generator workers failed to join (hang)")
+    drain = out.get("drain_mid_overload") or {}
+    if drain:
+        if not drain.get("all_workers_joined"):
+            problems.append("drain_mid_overload: workers hung across SIGTERM drain")
+        if drain.get("pre_drain_definitive", 0) < drain.get("pre_drain_requests", 0):
+            problems.append(
+                f"drain_mid_overload: only {drain.get('pre_drain_definitive')}/"
+                f"{drain.get('pre_drain_requests')} pre-drain requests resolved "
+                "definitively"
+            )
+
+    if problems:
+        log("overload-smoke FAILED:")
+        for p in problems:
+            log(f"  - {p}")
+        return 1
+    log(
+        "overload-smoke OK: interactive p99 "
+        f"{inter.get('p99_ms')} ms < batch p99 {batch.get('p99_ms')} ms at 3x, "
+        f"{shed} sheds with Retry-After, drain clean"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

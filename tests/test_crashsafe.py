@@ -410,6 +410,120 @@ def test_rolling_restart_drains_in_flight_checks():
         d.shutdown()  # idempotent
 
 
+def test_drain_resolves_both_priority_lanes():
+    """SIGTERM drain while BOTH batcher lanes are non-empty: every
+    accepted request — the monster batch-lane chunk mid-sub-slicing AND
+    the interactive checks queued around it — resolves definitively
+    (served, or shed with a real status), and nothing hangs."""
+    import urllib.error
+    import urllib.request
+
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "files"}],
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "serve.drain_timeout_s": 10.0,
+            # a wide coalescing window + small sub-slices keep the batch
+            # chunk spanning several dispatch rounds when the drain hits
+            "engine.batch_window_ms": 100.0,
+            "engine.batch_size": 256,
+            "serve.batch_sub_slice": 64,
+        }
+    )
+    d = Daemon(Registry(cfg))
+    d.serve_all(block=False)
+    try:
+        body = json.dumps(
+            {"namespace": "files", "object": "f", "relation": "view",
+             "subject_id": "alice"}
+        ).encode()
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{d.write_port}/relation-tuples",
+                data=body, method="PUT",
+            ),
+            timeout=10,
+        ).read()
+        url = (
+            f"http://127.0.0.1:{d.read_port}/check?namespace=files&object=f"
+            f"&relation=view&subject_id=alice"
+        )
+        with urllib.request.urlopen(url, timeout=10) as r:  # warm engine
+            assert r.status == 200
+
+        results: list = []
+        lock = threading.Lock()
+
+        def record(kind, outcome):
+            with lock:
+                results.append((kind, outcome))
+
+        def one_interactive(_):
+            try:
+                with urllib.request.urlopen(url, timeout=20) as r:
+                    record("interactive", r.status)
+            except urllib.error.HTTPError as e:
+                record("interactive", e.code)
+            except Exception as e:
+                record("interactive", e)
+
+        def one_batch():
+            payload = json.dumps(
+                {"tuples": [
+                    {"namespace": "files", "object": "f", "relation": "view",
+                     "subject_id": "alice"}
+                ] * 512}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{d.read_port}/check/batch", data=payload,
+                method="POST", headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=20) as r:
+                    n = len(json.loads(r.read())["results"])
+                    record("batch", r.status if n == 512 else f"short: {n}")
+            except urllib.error.HTTPError as e:
+                record("batch", e.code)
+            except Exception as e:
+                record("batch", e)
+
+        threads = [threading.Thread(target=one_batch, daemon=True)]
+        threads += [
+            threading.Thread(target=one_interactive, args=(i,), daemon=True)
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        # drain only once both lanes actually hold queued work
+        batcher = d.registry.check_batcher()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            depths = batcher.lane_depths
+            if depths["interactive"] > 0 and depths["batch"] > 0:
+                break
+            time.sleep(0.005)
+        d.drain_and_shutdown()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), (
+            f"drain left lane callers hanging: {results!r}"
+        )
+        assert len(results) == 9
+        # every outcome is a definitive HTTP status — served (200) or
+        # shed with an explicit overload/unavailable answer — never an
+        # exception, a short batch, or a hang
+        bad = [r for r in results if r[1] not in (200, 403, 429, 503, 504)]
+        assert not bad, f"non-definitive outcomes across drain: {bad!r}"
+    finally:
+        d.shutdown()  # idempotent
+
+
 def test_shutdown_signal_event_unblocks_serve_all():
     from keto_tpu.config.provider import Config
     from keto_tpu.driver.daemon import Daemon

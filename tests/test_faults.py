@@ -375,10 +375,15 @@ def test_queue_full_sheds_429():
         # one slot in the queue, then the door closes with 429
         filler = threading.Thread(target=quiet_check, daemon=True)
         filler.start()
-        wait_for(lambda: b._queue.full(), timeout=5.0, msg="queue full")
-        with pytest.raises(ErrTooManyRequests):
+        wait_for(
+            lambda: b.lane_depths["interactive"] >= 1, timeout=5.0, msg="queue full"
+        )
+        with pytest.raises(ErrTooManyRequests) as exc:
             b.check(q, timeout=10)
         assert b.shed_count == 1
+        # the shed carries backoff advice (REST Retry-After / gRPC
+        # retry-after trailing metadata)
+        assert exc.value.retry_after_s >= 1.0
     finally:
         release.set()
         b.stop()
