@@ -6,11 +6,16 @@
 CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++20 -fPIC -Wall -Wextra
 
-.PHONY: all native proto schemas docs test bench clean
+.PHONY: all native proto schemas docs test bench clean analyze
 
 # render the public JSON schemas into .schema/
 schemas:
 	python scripts/render_schemas.py
+
+# repo-native static analysis (+ ruff/mypy when installed) — the CI
+# static-analysis job runs the same entrypoint
+analyze:
+	python scripts/static_checks.py
 
 all: native proto
 

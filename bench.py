@@ -841,7 +841,7 @@ def run_scrape_overhead():
                     try:
                         urllib.request.urlopen(murl, timeout=5).read()
                         scrapes += 1
-                    except Exception:
+                    except Exception:  # keto-analyze: ignore[KTA401] scraper races daemon shutdown at measurement end; successful-scrape count is the signal
                         pass
 
             if metrics_enabled:

@@ -12,6 +12,17 @@ resident in TPU HBM and batches of check queries are answered by a vectorized
 JAX frontier-closure kernel (keto_tpu/graph/).
 """
 
+import os as _os
+
+if _os.environ.get("KETO_TPU_SANITIZE") == "1":
+    # concurrency sanitizer: instrumented Lock/RLock/Condition recording
+    # acquisition order, hold times, and inversions, plus a deadlock
+    # watchdog (keto_tpu/x/lockwatch.py). Installed BEFORE anything else
+    # imports so every lock the package allocates is covered.
+    from keto_tpu.x import lockwatch as _lockwatch
+
+    _lockwatch.install()
+
 from keto_tpu.version import __version__
 
 __all__ = ["__version__"]

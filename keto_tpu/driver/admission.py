@@ -64,7 +64,7 @@ class AdmissionController:
         self._increase = int(increase) if increase else max(16, self.max_window // 64)
         self._interval_s = float(interval_s)
         self._time = time_fn
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: window, _last_tick, _seen, _rate, _consec_over, last_p99_ms, last_queue_delay_ms, decreases, increases
         #: admitted batch-lane window (tuples queued); starts open — the
         #: first overloaded tick shrinks it, idle ticks recover it
         self.window = self.max_window

@@ -46,11 +46,14 @@ import time
 from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from concurrent.futures import TimeoutError as FutureTimeout
-from typing import Optional, Sequence
+from typing import TYPE_CHECKING, Optional, Sequence
 
 from keto_tpu.relationtuple.model import RelationTuple
 from keto_tpu.x import faults
 from keto_tpu.x.errors import ErrDeadlineExceeded, ErrTooManyRequests, KetoError
+
+if TYPE_CHECKING:
+    from keto_tpu.driver.admission import AdmissionController
 
 _log = logging.getLogger("keto_tpu.batch")
 
@@ -96,7 +99,7 @@ class CheckBatcher:
         interactive_max_tuples: int = 16,
         batch_sub_slice: Optional[int] = None,
         batch_reserve_share: float = 0.125,
-        admission=None,
+        admission: Optional["AdmissionController"] = None,
     ):
         """``engine`` needs ``batch_check(list[RelationTuple]) -> list[bool]``.
 
@@ -120,7 +123,7 @@ class CheckBatcher:
         self._sub_slice = max(1, batch_sub_slice or max(1, batch_size // 4))
         self._batch_reserve = max(1, int(batch_size * batch_reserve_share))
         self.admission = admission
-        self._cond = threading.Condition()
+        self._cond = threading.Condition()  # guards: _lanes, _lane_tuples, _current_round, shed_count, shed_by_lane, admission_shed_count
         self._lanes: dict[str, deque] = {lane: deque() for lane in LANES}
         self._lane_tuples: dict[str, int] = {lane: 0 for lane in LANES}
         #: items taken into the current dispatch round (failed promptly
@@ -138,7 +141,7 @@ class CheckBatcher:
         # in-flight accounting for graceful drain: accepted requests whose
         # futures have not resolved yet (queued OR dispatched)
         self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = threading.Lock()  # guards: _inflight
         self._idle = threading.Event()
         self._idle.set()
 
@@ -307,7 +310,7 @@ class CheckBatcher:
                 "deadline expired waiting for the check result"
             ) from None
 
-    def _shed(self, lane: str, admission: bool, message: str) -> ErrTooManyRequests:
+    def _shed(self, lane: str, admission: bool, message: str) -> ErrTooManyRequests:  # holds: _cond
         self.shed_count += 1
         self.shed_by_lane[lane] += 1
         if admission:
@@ -507,7 +510,7 @@ class CheckBatcher:
     def _queued(self) -> int:
         return self._lane_tuples[INTERACTIVE] + self._lane_tuples[BATCH]
 
-    def _take_locked(self) -> list:
+    def _take_locked(self) -> list:  # holds: _cond
         """Pack one dispatch round (called under ``_cond``): interactive
         items first — every one of them rides the NEXT round — then batch
         lane work up to ``batch_sub_slice``, taking *partial* chunks so a

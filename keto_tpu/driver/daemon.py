@@ -20,14 +20,19 @@ import signal
 import threading
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import TYPE_CHECKING, Any
 
 from keto_tpu.servers.grpc_api import build_grpc_server
 from keto_tpu.servers.native_mux import make_port_mux
 from keto_tpu.servers.rest import READ, WRITE, RestServer
 
+if TYPE_CHECKING:
+    from keto_tpu.driver.registry import Registry
 
-def make_rest_server(registry, role: str, host: str = "127.0.0.1", port: int = 0):
+
+def make_rest_server(
+    registry: "Registry", role: str, host: str = "127.0.0.1", port: int = 0
+) -> Any:
     """REST backend per ``serve.http_backend``: the asyncio reactor
     (default — one event loop, bounded handler pool) or the stdlib
     thread-per-connection server."""
@@ -41,9 +46,9 @@ def make_rest_server(registry, role: str, host: str = "127.0.0.1", port: int = 0
 
 @dataclass
 class _RoleServers:
-    rest: object  # RestServer or AsyncRestServer
-    grpc_server: object
-    mux: object  # NativePortMux or PortMux
+    rest: Any  # RestServer or AsyncRestServer
+    grpc_server: Any
+    mux: Any  # NativePortMux or PortMux
 
     @property
     def port(self) -> int:
@@ -53,7 +58,7 @@ class _RoleServers:
 class Daemon:
     """Owns both roles' server stacks."""
 
-    def __init__(self, registry):
+    def __init__(self, registry: "Registry"):
         self.registry = registry
         self._roles: dict[str, _RoleServers] = {}
         # set by a shutdown signal (or shutdown_soon()); serve_all's
@@ -91,10 +96,21 @@ class Daemon:
         self._roles[WRITE] = self._start_role(WRITE, write_host, write_port)
         if block:
             try:
-                self._stop_requested.wait()
+                self.wait_for_shutdown()
             except KeyboardInterrupt:
                 pass
             self.drain_and_shutdown()
+
+    def wait_for_shutdown(self, poll_s: float = 1.0) -> None:
+        """Block until a shutdown signal (or ``shutdown_soon``). The wait
+        is BOUNDED and looped rather than a bare ``Event.wait()``: an
+        unbounded wait in the main thread delays signal-handler delivery
+        on some platforms (CPython runs handlers between bytecodes, and a
+        C-level lock wait can absorb the wakeup), which is exactly the
+        shutdown-hang class the KTA204 lint flags — a SIGTERM must always
+        terminate this wait within ``poll_s``."""
+        while not self._stop_requested.wait(timeout=poll_s):
+            pass
 
     # -- graceful shutdown ---------------------------------------------------
 
@@ -136,7 +152,14 @@ class Daemon:
                 HealthState.NOT_SERVING, "draining: shutdown requested"
             )
         except Exception:
-            pass  # health never blocks shutdown
+            # health never blocks shutdown — but the failure is a finding,
+            # not a non-event: log it and count it where maintenance
+            # counters already surface (keto_maintenance_events_total)
+            self._count_shutdown_failure("drain_health_override_failures")
+            self.registry.logger().warning(
+                "health override failed during drain; continuing shutdown",
+                exc_info=True,
+            )
         deadline = time.monotonic() + drain_s
         batcher = self.registry.peek("check_batcher")
         if batcher is not None and hasattr(batcher, "drain"):
@@ -162,8 +185,24 @@ class Daemon:
             try:
                 tracer.close()
             except Exception:
-                pass  # telemetry never blocks shutdown
+                # telemetry never blocks shutdown; log + count instead of
+                # dropping the one signal that says spans were lost
+                self._count_shutdown_failure("drain_tracer_close_failures")
+                self.registry.logger().warning(
+                    "tracer flush failed during drain; spans may be lost",
+                    exc_info=True,
+                )
         self.shutdown()
+
+    def _count_shutdown_failure(self, event: str) -> None:
+        """Count a swallowed shutdown-path failure into the engine's
+        maintenance stats (scraped as keto_maintenance_events_total) —
+        best-effort by nature: failing to count must not block shutdown
+        either."""
+        engine = self.registry.peek("permission_engine")
+        stats = getattr(engine, "maintenance", None)
+        if stats is not None:
+            stats.incr(event)
 
     def _warm_snapshot(self) -> None:
         """Kick the first snapshot build/reload off the request path: with

@@ -47,7 +47,7 @@ import enum
 import logging
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 _log = logging.getLogger("keto_tpu.health")
 
@@ -80,7 +80,7 @@ class HealthMonitor:
     ):
         self._engine = engine
         self._budget = float(staleness_budget_s)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _last_state, _last_reason, _override, _transitions
         self._last_state: Optional[HealthState] = None
         self._last_reason = ""
         self._override: Optional[tuple[HealthState, str]] = None
@@ -168,7 +168,7 @@ class HealthMonitor:
 
     # -- streaming (gRPC Watch) ----------------------------------------------
 
-    def watch(self, poll_s: float = 0.2, should_stop=None):
+    def watch(self, poll_s: float = 0.2, should_stop: Optional[Callable[[], bool]] = None):
         """Yield ``(state, reason)`` — the current state immediately, then
         one entry per transition. ``should_stop()`` (e.g. a gRPC
         context-active probe, negated) ends the stream."""

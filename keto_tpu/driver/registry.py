@@ -27,7 +27,7 @@ class Registry:
     def __init__(self, config: Config, network_id: str = "default"):
         self._config = config
         self._network_id = network_id
-        self._lock = threading.RLock()
+        self._lock = threading.RLock()  # guards: _singletons
         self._singletons: dict[str, Any] = {}
         # engines see namespace hot-reloads through this indirection
         config.on_namespace_change(self._on_namespace_change)

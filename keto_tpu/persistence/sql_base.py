@@ -23,6 +23,7 @@ postgres code path line for line.
 
 from __future__ import annotations
 
+import logging
 import threading
 import uuid
 from typing import Callable, Optional, Sequence
@@ -39,6 +40,8 @@ from keto_tpu.x.pagination import (
     get_pagination_options,
 )
 from keto_tpu.x.retry import retry_call
+
+_log = logging.getLogger("keto_tpu.persistence")
 
 #: versioned migrations; the DDL is intentionally dialect-portable (the
 #: reference keeps per-dialect files; this schema stays in the common
@@ -315,6 +318,8 @@ class SQLPersisterBase(Manager):
         #: /metrics retry counter; distinct from re-dials — an unkeyed
         #: write re-dials without re-running)
         self.reconnect_retries = 0
+        #: post-failure ROLLBACKs that themselves failed (connection gone)
+        self.rollback_failures = 0
         #: keyed write retries answered from the dedup table instead of
         #: re-applying (the /metrics replay counter)
         self.idempotent_replays = 0
@@ -378,14 +383,19 @@ class SQLPersisterBase(Manager):
         try:
             self._box.conn.close()
         except Exception:
-            pass
+            # the old connection is being replaced anyway; a close failure
+            # is expected after a drop — log it, don't hide it
+            _log.debug("old connection close failed during reconnect", exc_info=True)
         self._box.conn = self._connect(self._dsn)
 
     def _safe_rollback(self) -> None:
         try:
             self._exec("ROLLBACK")
         except Exception:
-            pass  # connection gone — the server already discarded the txn
+            # connection gone — the server already discarded the txn; count
+            # it (introspection, next to .reconnects) and keep the trace
+            self.rollback_failures += 1
+            _log.debug("rollback after failure itself failed", exc_info=True)
 
     def _with_reconnect(self, fn: Callable, *, retry: bool):
         """Run ``fn`` (which takes the lock itself); on a

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from http import HTTPStatus
@@ -30,6 +31,8 @@ from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
 from keto_tpu.servers.rest import RawBody, RestApp
+
+_log = logging.getLogger("keto_tpu.rest")
 
 _MAX_HEAD = 64 * 1024
 _MAX_BODY = 64 * 1024 * 1024
@@ -90,6 +93,10 @@ class AsyncRestServer:
         )
         self._batch_limit = 3 * n_batch
         self._batch_pending = 0  # event-loop thread only
+        #: swallowed-with-a-trace counters (keto-analyze KTA401 seam):
+        #: connection teardown races and protocol-level failures
+        self.teardown_errors = 0
+        self.protocol_errors = 0
 
     @property
     def port(self) -> int:
@@ -165,7 +172,10 @@ class AsyncRestServer:
                     try:
                         w.transport.abort()
                     except Exception:
-                        pass
+                        # a connection torn down concurrently by its peer;
+                        # nothing to abort, but keep the trace visible
+                        self.teardown_errors += 1
+                        _log.debug("transport abort raced teardown", exc_info=True)
                 try:
                     await asyncio.wait_for(self._server.wait_closed(), timeout=3)
                 except (TimeoutError, asyncio.TimeoutError):
@@ -259,14 +269,18 @@ class AsyncRestServer:
         except Exception:
             # handler exceptions are already mapped to 500 envelopes inside
             # RestApp; anything surfacing here is a protocol-level failure
-            pass
+            # — counted, and traced at debug (malformed client bytes must
+            # not let a scanner spam the operator log at warning level)
+            self.protocol_errors += 1
+            _log.debug("protocol-level connection failure", exc_info=True)
         finally:
             self._conns.discard(writer)
             try:
                 writer.close()
                 await writer.wait_closed()
             except Exception:
-                pass
+                self.teardown_errors += 1
+                _log.debug("connection close raced teardown", exc_info=True)
 
     @staticmethod
     async def _read_head(reader: asyncio.StreamReader):

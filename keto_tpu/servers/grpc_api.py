@@ -17,6 +17,7 @@ internal/check/handler.go:162).
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 import time
@@ -48,6 +49,7 @@ from keto_tpu.x.tracing import parse_traceparent
 READ = "read"
 WRITE = "write"
 
+_log = logging.getLogger("keto_tpu.grpc")
 
 _CODE_BY_NUM = {c.value[0]: c for c in grpc.StatusCode}
 
@@ -63,7 +65,8 @@ def _abort(context, err: KetoError):
                 (("retry-after", str(max(1, math.ceil(retry_after)))),)
             )
         except Exception:
-            pass  # stream torn down; the status still reaches the client
+            # stream torn down; the status still reaches the client
+            _log.debug("trailing metadata raced stream teardown", exc_info=True)
     context.abort(_CODE_BY_NUM.get(err.grpc_code, grpc.StatusCode.INTERNAL), err.message)
 
 
@@ -118,7 +121,11 @@ def _wrap(fn, registry=None, name: str = ""):
                     try:
                         context.send_initial_metadata((("x-request-id", req_id),))
                     except Exception:
-                        pass  # already sent / stream torn down
+                        # already sent / stream torn down
+                        _log.debug(
+                            "initial metadata raced stream teardown",
+                            exc_info=True,
+                        )
                     try:
                         return fn(request, context)
                     except KetoError as e:

@@ -1,0 +1,51 @@
+"""keto-analyze: repo-native static analysis for keto-tpu.
+
+See :mod:`keto_tpu.x.analysis.core` for the framework and
+``docs/concepts/static-analysis.md`` for the checker catalog, the
+``# guards:`` / ``# holds:`` annotation conventions, and the
+baseline/suppression workflow. CLI: ``scripts/keto_analyze.py``.
+"""
+
+from __future__ import annotations
+
+from keto_tpu.x.analysis import hygiene, locks, surface, trace_safety
+from keto_tpu.x.analysis.core import (
+    FRAMEWORK_RULES,
+    Finding,
+    Project,
+    SourceFile,
+    apply_baseline,
+    load_baseline,
+    load_project,
+    run_checkers,
+    write_baseline,
+)
+
+#: the checker modules a default run executes, in order
+CHECKERS = (trace_safety, locks, surface, hygiene)
+
+
+def all_rules() -> dict[str, str]:
+    rules = dict(FRAMEWORK_RULES)
+    for checker in CHECKERS:
+        rules.update(checker.RULES)
+    return rules
+
+
+def analyze(project: Project) -> list[Finding]:
+    """Run every checker over ``project`` (suppressions applied)."""
+    return run_checkers(project, CHECKERS)
+
+
+__all__ = [
+    "CHECKERS",
+    "Finding",
+    "Project",
+    "SourceFile",
+    "all_rules",
+    "analyze",
+    "apply_baseline",
+    "load_baseline",
+    "load_project",
+    "write_baseline",
+]

@@ -1,0 +1,341 @@
+"""keto-analyze core: the repo-native static-analysis framework.
+
+This is a *repo-specific* analyzer, not a general linter: the checkers
+(keto_tpu/x/analysis/{trace_safety,locks,surface,hygiene}.py) encode the
+invariants this codebase's correctness actually depends on — no host
+syncs inside jit-reachable code, lock discipline across the
+batcher/admission/registry/health components, declared surfaces
+(config schema, metric families, REST routes) consistent with their
+documentation, and no silent exception swallows. Generic style is left
+to ruff; type shapes to mypy (both wired in CI next to this).
+
+The moving parts:
+
+- :class:`SourceFile` — one parsed module: AST + per-line comments
+  (``tokenize``-extracted, so annotation conventions like ``# guards:``
+  and suppressions survive formatting) + the suppression index.
+- :class:`Project` — the file set a run analyzes. Checkers are
+  project-scoped so cross-module analyses (the lock-acquisition-order
+  graph, the surface cross-checks) see everything at once.
+- :class:`Finding` — one violation, keyed by a line-independent
+  fingerprint so baselines survive unrelated edits.
+- Suppressions: ``# keto-analyze: ignore[KTA201] <justification>`` on
+  the flagged line. A suppression **must** carry a justification — an
+  empty one is itself reported (KTA002).
+- Baseline: a JSON file of fingerprints for pre-existing debt. Runs
+  fail only on findings outside the baseline; fixed entries are
+  reported as stale so the baseline ratchets down, never up silently.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+#: framework-level rules (checker modules own their KTA1xx..KTA4xx bands)
+FRAMEWORK_RULES = {
+    "KTA001": "file failed to parse (syntax error or undecodable source)",
+    "KTA002": "keto-analyze suppression without a justification",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*keto-analyze:\s*ignore\[([A-Z0-9*,\s]+)\]\s*(.*)$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation. ``scope`` is the enclosing ``Class.method`` (or
+    function) qualname — part of the fingerprint so baselines survive
+    line drift from unrelated edits above the finding."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    scope: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.scope}|{self.message}"
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}"
+        scope = f" [{self.scope}]" if self.scope else ""
+        return f"{where}: {self.rule}{scope}: {self.message}"
+
+
+@dataclass
+class Suppression:
+    rules: tuple[str, ...]  # ("*",) suppresses every rule on the line
+    justification: str
+
+    def covers(self, rule: str) -> bool:
+        return "*" in self.rules or rule in self.rules
+
+
+@dataclass
+class SourceFile:
+    rel: str
+    text: str
+    tree: Optional[ast.AST]
+    #: line -> full comment text (without leading '#'), for annotation
+    #: conventions (``guards:``, ``holds:``) and suppressions
+    comments: dict[int, str] = field(default_factory=dict)
+    suppressions: dict[int, Suppression] = field(default_factory=dict)
+    parse_error: Optional[str] = None
+
+    @classmethod
+    def from_source(cls, rel: str, text: str) -> "SourceFile":
+        tree: Optional[ast.AST] = None
+        err: Optional[str] = None
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as e:
+            err = f"line {e.lineno}: {e.msg}"
+        sf = cls(rel=rel, text=text, tree=tree, parse_error=err)
+        sf._scan_comments()
+        return sf
+
+    @classmethod
+    def from_path(cls, path: Path, root: Path) -> "SourceFile":
+        rel = path.relative_to(root).as_posix()
+        try:
+            text = path.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            return cls(rel=rel, text="", tree=None, parse_error=str(e))
+        return cls.from_source(rel, text)
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string.lstrip("#").strip()
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass  # parse_error already reports the broken file
+        for line, comment in self.comments.items():
+            m = _SUPPRESS_RE.search("#" + comment)
+            if m:
+                rules = tuple(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                self.suppressions[line] = Suppression(
+                    rules=rules, justification=m.group(2).strip()
+                )
+
+    def comment_on(self, line: int) -> str:
+        """The comment on ``line`` (or the empty string)."""
+        return self.comments.get(line, "")
+
+    @property
+    def lines(self) -> list[str]:
+        return self.text.splitlines()
+
+
+@dataclass
+class Project:
+    """The analyzed file set plus the repo root (surface checks read
+    non-Python inputs — spec/api.json, .schema/, docs tables — relative
+    to it)."""
+
+    root: Path
+    files: list[SourceFile] = field(default_factory=list)
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.rel == rel:
+                return f
+        return None
+
+    def under(self, *prefixes: str) -> list[SourceFile]:
+        """Files whose repo-relative path starts with any prefix. When
+        NOTHING matches (fixture projects in tests), every file is in
+        scope — fixtures should not need to reproduce the repo layout."""
+        got = [f for f in self.files if f.rel.startswith(prefixes)]
+        return got if got else list(self.files)
+
+
+def load_project(root: Path, paths: Iterable[str]) -> Project:
+    """Collect ``*.py`` under each of ``paths`` (files or directories,
+    relative to ``root``), skipping caches."""
+    root = root.resolve()
+    files: list[SourceFile] = []
+    seen: set[Path] = set()
+    for p in paths:
+        target = (root / p).resolve()
+        if target.is_file():
+            candidates = [target]
+        else:
+            candidates = sorted(target.rglob("*.py"))
+        for c in candidates:
+            if "__pycache__" in c.parts or c in seen:
+                continue
+            seen.add(c)
+            files.append(SourceFile.from_path(c, root))
+    return Project(root=root, files=files)
+
+
+# -- running checkers ----------------------------------------------------------
+
+
+def run_checkers(project: Project, checkers: Iterable) -> list[Finding]:
+    """Run each checker module's ``check(project)``, add framework
+    findings (parse failures, justification-less suppressions), and
+    apply inline suppressions. Deterministic order."""
+    findings: list[Finding] = []
+    for f in project.files:
+        if f.parse_error is not None:
+            findings.append(
+                Finding("KTA001", f.rel, 1, f"unparseable: {f.parse_error}")
+            )
+        for line, sup in f.suppressions.items():
+            if not sup.justification:
+                findings.append(
+                    Finding(
+                        "KTA002", f.rel, line,
+                        "suppression without a justification — say WHY "
+                        f"{','.join(sup.rules)} is acceptable here",
+                    )
+                )
+    for checker in checkers:
+        findings.extend(checker.check(project))
+    kept: list[Finding] = []
+    emitted: set[tuple[str, int]] = set()
+    for finding in findings:
+        key = (finding.fingerprint, finding.line)
+        if key in emitted:
+            continue  # e.g. a nested def reached along two call paths
+        emitted.add(key)
+        sf = project.file(finding.path)
+        sup = sf.suppressions.get(finding.line) if sf is not None else None
+        if (
+            sup is not None
+            and sup.covers(finding.rule)
+            and sup.justification
+            and finding.rule not in ("KTA001", "KTA002")
+        ):
+            continue
+        kept.append(finding)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept
+
+
+# -- baseline ------------------------------------------------------------------
+
+
+def load_baseline(path: Path) -> dict[str, str]:
+    """``{fingerprint: justification}`` from a baseline file; missing
+    file means an empty baseline."""
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    out: dict[str, str] = {}
+    for entry in data.get("findings", []):
+        out[entry["fingerprint"]] = entry.get("justification", "")
+    return out
+
+
+def write_baseline(path: Path, findings: Iterable[Finding]) -> None:
+    data = {
+        "comment": (
+            "keto-analyze baseline: pre-existing findings that do not fail "
+            "the build. Entries must carry a justification; fixing the "
+            "finding makes the entry stale (reported on every run). "
+            "Regenerate with scripts/keto_analyze.py --write-baseline."
+        ),
+        "findings": [
+            {
+                "fingerprint": f.fingerprint,
+                "rule": f.rule,
+                "path": f.path,
+                "justification": "pre-existing at baseline creation",
+            }
+            for f in sorted(findings, key=lambda f: f.fingerprint)
+        ],
+    }
+    path.write_text(json.dumps(data, indent=2) + "\n")
+
+
+@dataclass
+class BaselineResult:
+    new: list[Finding]
+    suppressed: list[Finding]
+    stale: list[str]  # baseline fingerprints no longer observed
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[str, str]
+) -> BaselineResult:
+    new: list[Finding] = []
+    suppressed: list[Finding] = []
+    seen: set[str] = set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            suppressed.append(f)
+            seen.add(f.fingerprint)
+        else:
+            new.append(f)
+    stale = sorted(fp for fp in baseline if fp not in seen)
+    return BaselineResult(new=new, suppressed=suppressed, stale=stale)
+
+
+# -- shared AST helpers --------------------------------------------------------
+
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name for Name/Attribute chains (``a.b.c``), else None."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def iter_scopes(tree: ast.AST):
+    """Yield ``(qualname, FunctionDef)`` for every function/method,
+    with methods qualified ``Class.method``."""
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield qual, child
+                yield from walk(child, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+def scope_of(tree: ast.AST, target: ast.AST) -> str:
+    """Qualname of the innermost function/method containing ``target``
+    (by line span), or "" at module level."""
+    best = ""
+    best_span = None
+    t_line = getattr(target, "lineno", None)
+    if t_line is None:
+        return ""
+    for qual, fn in iter_scopes(tree):
+        end = getattr(fn, "end_lineno", fn.lineno)
+        if fn.lineno <= t_line <= end:
+            span = end - fn.lineno
+            if best_span is None or span <= best_span:
+                best, best_span = qual, span
+    return best

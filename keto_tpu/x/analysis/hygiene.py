@@ -1,0 +1,81 @@
+"""Silent-failure hygiene: no exception swallowed without a trace.
+
+The failure mode this guards against is the expensive kind: a broad
+``except Exception: pass`` around a maintenance step, a teardown, or a
+telemetry write turns a real fault into *nothing* — no log line, no
+counter, no health signal — and the system serves quietly wrong or
+quietly stale. Every broad handler must either log, count, re-raise, or
+carry an inline suppression explaining why dropping the exception is
+correct.
+
+Rules
+-----
+KTA401  broad exception handler (``except Exception``/``BaseException``/
+        bare ``except``) whose body does nothing (``pass``/``...``) —
+        the exception vanishes without a trace.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from keto_tpu.x.analysis.core import Finding, Project, scope_of
+
+RULES = {
+    "KTA401": "bare `except Exception: pass` swallows failures silently",
+}
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    if isinstance(t, (ast.Name, ast.Attribute)):
+        name = t.attr if isinstance(t, ast.Attribute) else t.id
+        return name in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, (ast.Name, ast.Attribute))
+            and (e.attr if isinstance(e, ast.Attribute) else e.id) in _BROAD
+            for e in t.elts
+        )
+    return False
+
+
+def _is_noop(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring or `...`
+        return False
+    return True
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node) and _is_noop(node.body):
+                kind = (
+                    "bare except"
+                    if node.type is None
+                    else f"except {ast.unparse(node.type)}"
+                )
+                findings.append(
+                    Finding(
+                        "KTA401",
+                        sf.rel,
+                        node.lineno,
+                        f"`{kind}: pass` swallows the failure silently — "
+                        "log it, count it, or suppress with a justification",
+                        scope=scope_of(sf.tree, node),
+                    )
+                )
+    return findings

@@ -1,0 +1,588 @@
+"""Lock discipline: annotated guards, blocking calls, acquisition order.
+
+The serving core is a small zoo of cooperating locks — the batcher's
+condition + inflight lock, the admission controller's lock, the
+registry's memo lock, the health monitor's lock, the stats sinks — and
+nothing used to check that the fields a lock protects are only mutated
+while it is held, that nothing *blocks* while holding one, or that two
+locks are never taken in opposite orders on different paths. Those bugs
+don't fail unit tests; they fail at p99 under load.
+
+The ``# guards:`` convention
+----------------------------
+A lock field declares what it protects with a comment on its
+assignment line::
+
+    self._cond = threading.Condition()  # guards: _lanes, _lane_tuples
+
+Module-level locks work the same way::
+
+    _lock = threading.Lock()  # guards: _faults, _hits
+
+A method whose *caller* must hold the lock (the ``_locked`` suffix
+idiom) declares it on its ``def`` line::
+
+    def _take_locked(self):  # holds: _cond
+
+Annotation is opt-in: an unannotated lock gets no KTA201 mutation
+checking (and no KTA202 blocking-call checking) — e.g. a lock that
+exists to serialize a *blocking resource* (the SQL connection lock)
+stays unannotated by design. KTA203/KTA204 apply to every lock-shaped
+object the checker can see.
+
+Rules
+-----
+KTA201  guarded attribute mutated outside a ``with`` block of its
+        owning lock (``__init__`` and ``# holds:`` methods exempt)
+KTA202  blocking call (sleep, subprocess, network, SQL execute/commit,
+        device sync, thread join, foreign ``.wait()``) while holding an
+        annotated lock
+KTA203  cycle in the cross-module lock-acquisition-order graph
+KTA204  unbounded ``.wait()`` — no timeout means a shutdown signal or a
+        dead peer can park the thread forever
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from keto_tpu.x.analysis.core import (
+    Finding,
+    Project,
+    SourceFile,
+    attr_chain,
+    scope_of,
+)
+
+RULES = {
+    "KTA201": "guarded attribute mutated outside the owning lock",
+    "KTA202": "blocking call while holding a lock",
+    "KTA203": "lock-acquisition-order cycle",
+    "KTA204": "unbounded .wait() (shutdown-hang risk)",
+}
+
+_GUARDS_RE = re.compile(r"guards:\s*(.+)$")
+_HOLDS_RE = re.compile(r"holds:\s*(.+)$")
+
+_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "Lock",
+    "RLock",
+    "Condition",
+}
+
+#: attribute-chain suffixes that block the calling thread
+_BLOCKING_CALLS = {
+    "time.sleep": "sleeps",
+    "sleep": "sleeps",
+    "urllib.request.urlopen": "does network I/O",
+    "urlopen": "does network I/O",
+    "subprocess.run": "runs a subprocess",
+    "subprocess.call": "runs a subprocess",
+    "subprocess.check_call": "runs a subprocess",
+    "subprocess.check_output": "runs a subprocess",
+    "subprocess.Popen": "runs a subprocess",
+}
+
+#: method names that block when called on *any* receiver
+_BLOCKING_METHODS = {
+    "block_until_ready": "synchronizes with the device",
+    "execute": "runs SQL",
+    "executemany": "runs SQL",
+    "executescript": "runs SQL",
+    "commit": "commits SQL",
+    "recv": "does socket I/O",
+    "accept": "does socket I/O",
+    "connect": "dials a connection",
+}
+
+#: mutating container methods — calling these on a guarded attribute is
+#: a mutation of that attribute
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "add", "insert",
+    "update", "setdefault", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "sort",
+}
+
+
+@dataclass
+class _LockInfo:
+    key: str  # graph node: "module.Class._lock" or "module._lock"
+    attr: str  # "_lock" (self attr or module global)
+    guards: tuple[str, ...] = ()
+    line: int = 0
+    annotated: bool = False
+
+
+@dataclass
+class _ClassLocks:
+    sf: SourceFile
+    cls: Optional[ast.ClassDef]  # None = module level
+    locks: dict[str, _LockInfo] = field(default_factory=dict)
+    #: method name -> lock attrs it acquires anywhere in its body
+    acquires: dict[str, set[str]] = field(default_factory=dict)
+
+
+def _module_of(sf: SourceFile) -> str:
+    return sf.rel[:-3].replace("/", ".") if sf.rel.endswith(".py") else sf.rel
+
+
+def _parse_guards(comment: str) -> Optional[tuple[str, ...]]:
+    m = _GUARDS_RE.search(comment)
+    if not m:
+        return None
+    return tuple(a.strip().rstrip(",") for a in m.group(1).split(",") if a.strip())
+
+
+def _lock_assignments(body_owner: ast.AST, self_attr: bool):
+    """Yield ``(attr_name, lineno)`` for lock-factory assignments:
+    ``self.X = threading.Lock()`` inside methods (``self_attr``) or
+    ``X = threading.Lock()`` at module level."""
+    for node in ast.walk(body_owner):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        chain = attr_chain(node.value.func)
+        if chain not in _LOCK_FACTORIES:
+            continue
+        for target in node.targets:
+            if self_attr:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    yield target.attr, node.lineno
+            elif isinstance(target, ast.Name):
+                yield target.id, node.lineno
+
+
+def _collect(sf: SourceFile) -> list[_ClassLocks]:
+    """Lock declarations (+ guards annotations) per class and at module
+    level, and which locks each method acquires."""
+    out: list[_ClassLocks] = []
+    if sf.tree is None:
+        return out
+    module = _module_of(sf)
+
+    mod_cl = _ClassLocks(sf=sf, cls=None)
+    for stmt in sf.tree.body:
+        for attr, line in _lock_assignments(stmt, self_attr=False):
+            guards = _parse_guards(sf.comment_on(line))
+            mod_cl.locks[attr] = _LockInfo(
+                key=f"{module}.{attr}", attr=attr,
+                guards=guards or (), line=line, annotated=guards is not None,
+            )
+    if mod_cl.locks:
+        for stmt in sf.tree.body:
+            if isinstance(stmt, ast.FunctionDef):
+                mod_cl.acquires[stmt.name] = set(
+                    _with_lock_attrs(stmt, mod_cl.locks)
+                )
+        out.append(mod_cl)
+
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cl = _ClassLocks(sf=sf, cls=node)
+        for attr, line in _lock_assignments(node, self_attr=True):
+            guards = _parse_guards(sf.comment_on(line))
+            cl.locks.setdefault(
+                attr,
+                _LockInfo(
+                    key=f"{module}.{node.name}.{attr}", attr=attr,
+                    guards=guards or (), line=line, annotated=guards is not None,
+                ),
+            )
+            if guards is not None:
+                info = cl.locks[attr]
+                info.guards = guards
+                info.annotated = True
+        if cl.locks:
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef):
+                    cl.acquires[item.name] = {
+                        w for w in _with_lock_attrs(item, cl.locks)
+                    }
+            out.append(cl)
+    return out
+
+
+def _with_lock_attrs(fn: ast.FunctionDef, locks: dict[str, _LockInfo]):
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            expr = item.context_expr
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr in locks
+            ):
+                yield expr.attr
+            elif isinstance(expr, ast.Name) and expr.id in locks:
+                yield expr.id
+
+
+def _holds_annotation(sf: SourceFile, fn: ast.FunctionDef) -> tuple[str, ...]:
+    """Locks the ``# holds:`` comment on the def line declares held."""
+    for line in range(fn.lineno, min(fn.body[0].lineno + 1, fn.lineno + 8)):
+        m = _HOLDS_RE.search(sf.comment_on(line))
+        if m:
+            return tuple(a.strip() for a in m.group(1).split(",") if a.strip())
+    return ()
+
+
+def _mutation_target_attr(node: ast.stmt) -> list[tuple[str, int]]:
+    """``self.<attr>`` roots mutated by this statement."""
+    out: list[tuple[str, int]] = []
+
+    def root_self_attr(expr: ast.AST) -> Optional[str]:
+        # peel subscripts: self._lanes[k] -> _lanes
+        while isinstance(expr, ast.Subscript):
+            expr = expr.value
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            return expr.attr
+        return None
+
+    targets: list[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = list(node.targets)
+    for t in targets:
+        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+        for e in elts:
+            attr = root_self_attr(e)
+            if attr is not None:
+                out.append((attr, node.lineno))
+    if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+        f = node.value.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            attr = root_self_attr(f.value)
+            if attr is not None:
+                out.append((attr, node.lineno))
+    return out
+
+
+class _MethodWalker:
+    """Walk one method tracking the set of held (syntactically
+    ``with``-ed) locks, emitting KTA201/202/203-edge/204 events."""
+
+    def __init__(self, cl: _ClassLocks, fn: ast.FunctionDef, findings, edges):
+        self.cl = cl
+        self.sf = cl.sf
+        self.fn = fn
+        self.findings = findings
+        self.edges = edges  # dict[(key_a, key_b)] = (path, line)
+        self.guard_of: dict[str, str] = {}
+        for info in cl.locks.values():
+            for attr in info.guards:
+                self.guard_of[attr] = info.attr
+        self.exempt_mutations = fn.name == "__init__"
+        self.held: list[str] = list(_holds_annotation(cl.sf, fn))
+        self.scope = (
+            f"{cl.cls.name}.{fn.name}" if cl.cls is not None else fn.name
+        )
+
+    # -- helpers ---------------------------------------------------------------
+
+    def _lock_expr_attr(self, expr: ast.AST) -> Optional[str]:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and expr.attr in self.cl.locks
+        ):
+            return expr.attr
+        if isinstance(expr, ast.Name) and self.cl.cls is None and expr.id in self.cl.locks:
+            return expr.id
+        return None
+
+    def _note_acquire(self, attr: str, line: int) -> None:
+        for held in self.held:
+            if held != attr:
+                a = self.cl.locks[held].key if held in self.cl.locks else held
+                b = self.cl.locks[attr].key
+                self.edges.setdefault((a, b), (self.sf.rel, line))
+        self.held.append(attr)
+
+    # -- walk ------------------------------------------------------------------
+
+    def walk(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.With):
+            acquired: list[str] = []
+            for item in stmt.items:
+                attr = self._lock_expr_attr(item.context_expr)
+                if attr is not None:
+                    self._note_acquire(attr, stmt.lineno)
+                    acquired.append(attr)
+                else:
+                    self._calls_in(item.context_expr)
+            self.walk(stmt.body)
+            for attr in reversed(acquired):
+                self.held.remove(attr)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs execute later, under unknown locks
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._calls_in(stmt.test)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self._calls_in(stmt.iter)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk(stmt.body)
+            for handler in stmt.handlers:
+                self.walk(handler.body)
+            self.walk(stmt.orelse)
+            self.walk(stmt.finalbody)
+            return
+        # simple statement: check mutations, then every call it makes
+        if not self.exempt_mutations:
+            for attr, line in self._mutations(stmt):
+                owner = self.guard_of.get(attr)
+                if owner is not None and owner not in self.held:
+                    key = (
+                        self.cl.locks[owner].key
+                        if owner in self.cl.locks
+                        else owner
+                    )
+                    findable = attr if self.cl.cls is None else f"self.{attr}"
+                    self.findings.append(
+                        Finding(
+                            "KTA201", self.sf.rel, line,
+                            f"`{findable}` is guarded by `{owner}` "
+                            f"(# guards: on {key}) but mutated without "
+                            "holding it",
+                            scope=self.scope,
+                        )
+                    )
+        self._calls_in(stmt)
+
+    def _mutations(self, stmt: ast.stmt) -> list[tuple[str, int]]:
+        out = _mutation_target_attr(stmt)
+        if self.cl.cls is None:
+            # module-level guards protect module globals
+            targets: list[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                targets = list(stmt.targets)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            for t in targets:
+                expr: ast.AST = t
+                while isinstance(expr, ast.Subscript):
+                    expr = expr.value
+                if isinstance(expr, ast.Name) and expr.id in self.guard_of:
+                    out.append((expr.id, stmt.lineno))
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                f = stmt.value.func
+                if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                    expr = f.value
+                    while isinstance(expr, ast.Subscript):
+                        expr = expr.value
+                    if isinstance(expr, ast.Name) and expr.id in self.guard_of:
+                        out.append((expr.id, stmt.lineno))
+        return out
+
+    def _calls_in(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._call(sub)
+
+    def _call(self, node: ast.Call) -> None:
+        chain = attr_chain(node.func)
+        line = node.lineno
+        if not self.held:
+            return
+        holders = ", ".join(
+            self.cl.locks[h].key if h in self.cl.locks else h for h in self.held
+        )
+        annotated_held = any(
+            h in self.cl.locks and self.cl.locks[h].annotated for h in self.held
+        )
+        why: Optional[str] = None
+        if chain is not None:
+            for suffix, reason in _BLOCKING_CALLS.items():
+                if chain == suffix or chain.endswith("." + suffix):
+                    why = reason
+                    break
+        if why is None and isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            if meth in _BLOCKING_METHODS:
+                why = _BLOCKING_METHODS[meth]
+            elif meth == "join" and not node.args:
+                # str.join always takes a positional iterable; a no-arg
+                # join is a thread/process join
+                why = "joins a thread"
+            elif meth == "wait":
+                # waiting on a FOREIGN condition/event while holding a
+                # lock blocks it; waiting on the held condition itself
+                # releases it (that is what conditions are for)
+                receiver = node.func.value
+                recv_attr = self._lock_expr_attr(receiver)
+                if recv_attr is None or recv_attr not in self.held:
+                    why = "waits on a foreign event/condition"
+        if why is not None and annotated_held:
+            self.findings.append(
+                Finding(
+                    "KTA202", self.sf.rel, line,
+                    f"`{chain or ast.unparse(node.func)}` {why} while "
+                    f"holding {holders} — move it outside the lock",
+                    scope=self.scope,
+                )
+            )
+        # KTA203 interprocedural edge: calling a function/method known
+        # to acquire a lock
+        if isinstance(node.func, (ast.Attribute, ast.Name)):
+            self._edge_via_call(node)
+
+    def _edge_via_call(self, node: ast.Call) -> None:
+        """While holding a lock, a call to a function/method that itself
+        acquires one adds an order edge. Resolution: ``self.m()`` to this
+        class; bare ``f()`` or ``<expr>.m()`` to the unique project
+        scope defining a lock-acquiring callable of that name (ambiguous
+        names are skipped — conservatively, no edge)."""
+        if isinstance(node.func, ast.Name):
+            meth = node.func.id
+            recv = None
+        else:
+            meth = node.func.attr
+            recv = node.func.value
+        targets = _ACQUIRING_METHODS.get(meth)
+        if not targets:
+            return
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            resolved = [t for t in targets if t[0] is self.cl]
+        elif len(targets) == 1:
+            resolved = targets
+        else:
+            return
+        for cl, lock_attrs in resolved:
+            for attr in lock_attrs:
+                b_key = cl.locks[attr].key
+                for held in self.held:
+                    a_key = (
+                        self.cl.locks[held].key if held in self.cl.locks else held
+                    )
+                    if a_key != b_key:
+                        self.edges.setdefault(
+                            (a_key, b_key), (self.sf.rel, node.lineno)
+                        )
+
+
+#: method name -> [(class-locks, lock attrs it acquires)] — rebuilt per run
+_ACQUIRING_METHODS: dict[str, list[tuple[_ClassLocks, set[str]]]] = {}
+
+
+def _find_cycles(edges: dict) -> list[list[str]]:
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: list[list[str]] = []
+    seen_cycles: set[frozenset] = set()
+    state: dict[str, int] = {}
+
+    def dfs(node: str, path: list[str]):
+        state[node] = 1
+        path.append(node)
+        for nxt in sorted(graph[node]):
+            if state.get(nxt, 0) == 1:
+                cyc = path[path.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cyc)
+            elif state.get(nxt, 0) == 0:
+                dfs(nxt, path)
+        path.pop()
+        state[node] = 2
+
+    for node in sorted(graph):
+        if state.get(node, 0) == 0:
+            dfs(node, [])
+    return cycles
+
+
+def _check_unbounded_waits(sf: SourceFile, findings: list[Finding]) -> None:
+    """KTA204, repo-wide: ``<x>.wait()`` with neither a positional nor a
+    ``timeout=`` argument parks the calling thread until a peer notifies
+    — a peer that died, wedged, or already notified before the wait
+    leaves it parked forever (the shutdown-hang class). Bound it and
+    loop, or suppress with the reason the wait provably terminates."""
+    if sf.tree is None:
+        return
+    for node in ast.walk(sf.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "wait"
+            and not node.args
+            and not any(kw.arg == "timeout" for kw in node.keywords)
+        ):
+            findings.append(
+                Finding(
+                    "KTA204", sf.rel, node.lineno,
+                    f"unbounded `{ast.unparse(node.func)}()` — a missed or "
+                    "dead notifier parks this thread forever; pass a "
+                    "timeout and loop",
+                    scope=scope_of(sf.tree, node),
+                )
+            )
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+    all_classes: list[_ClassLocks] = []
+    for sf in project.files:
+        all_classes.extend(_collect(sf))
+        _check_unbounded_waits(sf, findings)
+
+    _ACQUIRING_METHODS.clear()
+    for cl in all_classes:
+        for meth, attrs in cl.acquires.items():
+            if attrs:
+                _ACQUIRING_METHODS.setdefault(meth, []).append((cl, attrs))
+
+    for cl in all_classes:
+        body = cl.cls.body if cl.cls is not None else (
+            cl.sf.tree.body if cl.sf.tree is not None else []
+        )
+        for item in body:
+            if isinstance(item, ast.FunctionDef):
+                _MethodWalker(cl, item, findings, edges).walk(item.body)
+
+    for cycle in _find_cycles(edges):
+        sites = []
+        for a, b in zip(cycle, cycle[1:]):
+            path, line = edges.get((a, b), ("?", 0))
+            sites.append(f"{a}->{b} at {path}:{line}")
+        first_path, first_line = edges.get((cycle[0], cycle[1]), ("?", 1))
+        findings.append(
+            Finding(
+                "KTA203", first_path, first_line,
+                "lock-acquisition-order cycle: " + "; ".join(sites),
+            )
+        )
+    return findings

@@ -1,0 +1,339 @@
+"""Surface consistency: code ↔ schema ↔ spec ↔ docs, without a daemon.
+
+Three public surfaces are declared twice (code + artifact) and drift
+silently:
+
+- the config schema (``keto_tpu/config/schema.py`` vs the rendered
+  ``.schema/*.schema.json`` the docs and clients consume), plus every
+  dotted config key the code actually *reads* via ``config.get(...)``;
+- the metric families (instrument declarations in code vs the family
+  table in ``docs/concepts/observability.md``). ``scripts/metrics_lint.py``
+  checks the same pairing *dynamically* against a live scrape; this is
+  the static half, shared with it (``documented_families`` /
+  ``declared_families`` live here);
+- the REST surface (``spec/api.json`` routes vs the handler dispatch in
+  ``keto_tpu/servers/rest.py`` and the bounded-cardinality route set in
+  ``keto_tpu/x/metrics.KNOWN_ROUTES``).
+
+Rules
+-----
+KTA301  ``.schema/*.schema.json`` out of sync with ``config/schema.py``
+KTA302  metric family declared-but-undocumented, documented-but-
+        undeclared, or kind mismatch vs observability.md
+KTA303  spec route without a handler / handler without a spec entry /
+        KNOWN_ROUTES drift
+KTA304  code reads a dotted config key the schema does not declare
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from pathlib import Path
+from typing import Optional
+
+from keto_tpu.x.analysis.core import Finding, Project, attr_chain, scope_of
+
+RULES = {
+    "KTA301": "rendered JSON schema out of sync with config/schema.py",
+    "KTA302": "metric family drift between code and observability.md",
+    "KTA303": "REST route drift between spec/api.json and handlers",
+    "KTA304": "config key read in code but absent from the schema",
+}
+
+#: a documented family row in observability.md:
+#: | `keto_...` | type | labels | meaning |
+_DOC_ROW_RE = re.compile(r"^\|\s*`(keto_[a-z0-9_]+)`\s*\|\s*(\w+)\s*\|")
+
+
+# -- metric families (shared with scripts/metrics_lint.py) ---------------------
+
+
+def documented_families(doc_path: Path) -> dict[str, str]:
+    """``{family: type}`` parsed from the markdown family table."""
+    families: dict[str, str] = {}
+    for line in doc_path.read_text().splitlines():
+        m = _DOC_ROW_RE.match(line)
+        if m:
+            families[m.group(1)] = m.group(2)
+    return families
+
+
+def declared_families(project: Project) -> dict[str, tuple[str, str, int]]:
+    """``{family: (kind, path, line)}`` statically extracted from every
+    instrument declaration in the analyzed sources: ``.counter("keto_…")``,
+    ``.gauge(…)``, ``.histogram(…)``, and
+    ``.register_callback("keto_…", "<kind>", …)``."""
+    out: dict[str, tuple[str, str, int]] = {}
+    for sf in project.under("keto_tpu/"):
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            meth = node.func.attr
+            if meth in ("counter", "gauge", "histogram"):
+                kind = meth
+            elif meth == "register_callback":
+                kind = None  # from the 2nd positional arg
+            else:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant):
+                continue
+            name = node.args[0].value
+            if not isinstance(name, str) or not name.startswith("keto_"):
+                continue
+            if kind is None:
+                if len(node.args) < 2 or not isinstance(
+                    node.args[1], ast.Constant
+                ):
+                    continue
+                kind = str(node.args[1].value)
+            out.setdefault(name, (kind, sf.rel, node.lineno))
+    return out
+
+
+def _check_metrics(project: Project, findings: list[Finding]) -> None:
+    doc = project.root / "docs" / "concepts" / "observability.md"
+    if not doc.exists():
+        return
+    documented = documented_families(doc)
+    declared = declared_families(project)
+    doc_rel = doc.relative_to(project.root).as_posix()
+    for name in sorted(set(declared) - set(documented)):
+        kind, path, line = declared[name]
+        findings.append(
+            Finding(
+                "KTA302", path, line,
+                f"metric family `{name}` ({kind}) is declared here but "
+                f"missing from the table in {doc_rel}",
+            )
+        )
+    for name in sorted(set(documented) - set(declared)):
+        findings.append(
+            Finding(
+                "KTA302", doc_rel, 1,
+                f"metric family `{name}` is documented but never declared "
+                "in keto_tpu/ — stale docs or a lost instrument",
+            )
+        )
+    for name in sorted(set(documented) & set(declared)):
+        kind, path, line = declared[name]
+        if documented[name] != kind:
+            findings.append(
+                Finding(
+                    "KTA302", path, line,
+                    f"metric family `{name}`: declared as {kind}, "
+                    f"documented as {documented[name]} in {doc_rel}",
+                )
+            )
+
+
+# -- config schema -------------------------------------------------------------
+
+
+def _exec_schema_module(project: Project) -> Optional[dict]:
+    """Evaluate ``keto_tpu/config/schema.py`` (pure data, no imports) in
+    an empty namespace — static in the sense that no daemon, device, or
+    package import happens."""
+    sf = project.file("keto_tpu/config/schema.py")
+    if sf is None or sf.tree is None:
+        return None
+    ns: dict = {}
+    try:
+        exec(compile(sf.tree, sf.rel, "exec"), ns)  # noqa: S102 — pure-data module
+    except Exception:
+        return None
+    return ns
+
+
+def _check_config_schema(project: Project, findings: list[Finding]) -> None:
+    ns = _exec_schema_module(project)
+    if ns is None:
+        return
+    for var, artifact in (
+        ("CONFIG_SCHEMA", ".schema/config.schema.json"),
+        ("NAMESPACE_SCHEMA", ".schema/namespace.schema.json"),
+    ):
+        schema = ns.get(var)
+        disk_path = project.root / artifact
+        if schema is None or not disk_path.exists():
+            continue
+        disk = json.loads(disk_path.read_text())
+        # a JSON round-trip normalizes tuples/True-vs-true etc.
+        if json.loads(json.dumps(schema)) != disk:
+            findings.append(
+                Finding(
+                    "KTA301", "keto_tpu/config/schema.py", 1,
+                    f"{var} differs from {artifact} — regenerate with "
+                    "`python scripts/render_schemas.py` (make schemas)",
+                )
+            )
+
+    config_schema = ns.get("CONFIG_SCHEMA")
+    if isinstance(config_schema, dict):
+        _check_config_reads(project, config_schema, findings)
+
+
+def _schema_has_key(schema: dict, dotted: str) -> bool:
+    node = schema
+    for part in dotted.split("."):
+        props = node.get("properties")
+        if not isinstance(props, dict) or part not in props:
+            return False
+        node = props[part]
+    return True
+
+
+def _check_config_reads(
+    project: Project, schema: dict, findings: list[Finding]
+) -> None:
+    """Every ``<config-ish>.get("a.b.c", …)`` read must name a declared
+    key — the typo'd read silently returns its default forever."""
+    for sf in project.under("keto_tpu/", "scripts/", "bench.py"):
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if (
+                not isinstance(node, ast.Call)
+                or not isinstance(node.func, ast.Attribute)
+                or node.func.attr != "get"
+                or not node.args
+                or not isinstance(node.args[0], ast.Constant)
+                or not isinstance(node.args[0].value, str)
+            ):
+                continue
+            key = node.args[0].value
+            if "." not in key or not re.fullmatch(r"[a-z0-9_.]+", key):
+                continue
+            receiver = ast.unparse(node.func.value)
+            if not ("config" in receiver.lower() or receiver in ("cfg", "c")):
+                continue
+            if not _schema_has_key(schema, key):
+                findings.append(
+                    Finding(
+                        "KTA304", sf.rel, node.lineno,
+                        f"config read of `{key}` — not declared in "
+                        "config/schema.py (typo'd keys silently return "
+                        "their default forever)",
+                        scope=scope_of(sf.tree, node),
+                    )
+                )
+
+
+# -- REST routes ---------------------------------------------------------------
+
+
+def _handled_routes(project: Project):
+    """(method, path) tuples compared in the REST dispatcher, plus paths
+    compared method-agnostically (``path == "/health/alive"``)."""
+    tuples: set[tuple[str, str]] = set()
+    wildcard: set[str] = set()
+    sf = project.file("keto_tpu/servers/rest.py")
+    if sf is None or sf.tree is None:
+        return tuples, wildcard, sf
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Compare) or len(node.comparators) != 1:
+            continue
+        left, right = node.left, node.comparators[0]
+        for a, b in ((left, right), (right, left)):
+            chain = attr_chain(a)
+            if chain is None:
+                continue
+            if isinstance(b, ast.Tuple) and len(b.elts) == 2:
+                try:
+                    method, path = ast.literal_eval(b)
+                except ValueError:
+                    continue
+                if isinstance(path, str) and path.startswith("/"):
+                    tuples.add((str(method).upper(), path))
+            elif isinstance(b, ast.Constant) and isinstance(b.value, str):
+                if b.value.startswith("/") and chain.endswith("path"):
+                    wildcard.add(b.value)
+    return tuples, wildcard, sf
+
+
+def _known_routes(project: Project) -> Optional[tuple[set[str], int]]:
+    sf = project.file("keto_tpu/x/metrics.py")
+    if sf is None or sf.tree is None:
+        return None
+    for node in ast.walk(sf.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "KNOWN_ROUTES"
+                for t in node.targets
+            )
+        ):
+            consts = {
+                c.value
+                for c in ast.walk(node.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str)
+            }
+            return {c for c in consts if c.startswith("/")}, node.lineno
+    return None
+
+
+def _check_routes(project: Project, findings: list[Finding]) -> None:
+    spec_path = project.root / "spec" / "api.json"
+    if not spec_path.exists():
+        return
+    spec = json.loads(spec_path.read_text())
+    spec_routes = {
+        (method.upper(), path)
+        for path, methods in spec.get("paths", {}).items()
+        for method in methods
+        if method.lower() in ("get", "post", "put", "delete", "patch", "head")
+    }
+    handled, wildcard, rest_sf = _handled_routes(project)
+    if rest_sf is None:
+        return
+    for method, path in sorted(spec_routes):
+        if path in wildcard or (method, path) in handled:
+            continue
+        findings.append(
+            Finding(
+                "KTA303", "spec/api.json", 1,
+                f"spec declares {method} {path} but "
+                "keto_tpu/servers/rest.py has no dispatch arm for it",
+            )
+        )
+    spec_paths = {p for _, p in spec_routes}
+    for method, path in sorted(handled):
+        if (method, path) not in spec_routes:
+            findings.append(
+                Finding(
+                    "KTA303", rest_sf.rel, 1,
+                    f"handler dispatches {method} {path} but spec/api.json "
+                    "does not declare it",
+                )
+            )
+    known = _known_routes(project)
+    if known is not None:
+        routes, line = known
+        for path in sorted(routes - spec_paths):
+            findings.append(
+                Finding(
+                    "KTA303", "keto_tpu/x/metrics.py", line,
+                    f"KNOWN_ROUTES contains {path}, absent from spec/api.json",
+                )
+            )
+        for path in sorted(spec_paths - routes):
+            findings.append(
+                Finding(
+                    "KTA303", "keto_tpu/x/metrics.py", line,
+                    f"spec path {path} missing from KNOWN_ROUTES — its "
+                    "request metrics will fold into 'other'",
+                )
+            )
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    _check_metrics(project, findings)
+    _check_config_schema(project, findings)
+    _check_routes(project, findings)
+    return findings

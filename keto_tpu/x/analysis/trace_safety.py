@@ -1,0 +1,397 @@
+"""Trace-safety: no host syncs or recompile traps in jit-reachable code.
+
+The TPU hot path (keto_tpu/graph/, keto_tpu/check/, keto_tpu/parallel/)
+is JAX-traced: a stray ``.item()`` or ``np.asarray`` on a traced value
+forces a device→host sync in the middle of a pipelined batch, a Python
+branch on a traced value raises ``TracerBoolConversionError`` only on
+the code path that hits it, and data-dependent-shape ops retrigger
+compilation per shape. None of this shows up in CPU-backed unit tests
+at small shapes — which is exactly why it is checked statically.
+
+Mechanics: jit *entry points* are functions decorated with ``jax.jit``
+(directly or through ``partial(jax.jit, ...)``) or wrapped at
+assignment (``f = jax.jit(g)`` / ``f = partial(jax.jit, ...)(g)``).
+From the entries, a same-module + same-class call-graph closure marks
+everything *jit-reachable*. Within an entry, parameters named by
+``static_argnames``/``static_argnums`` are NOT traced (branching on
+them is specialization, not an error); every other parameter — and any
+local assigned from one — is treated as traced.
+
+Rules
+-----
+KTA101  host-sync call inside jit-reachable code (``.item()``,
+        ``.tolist()``, ``.block_until_ready()``, ``np.asarray``/
+        ``np.array`` of a traced value, ``float()``/``int()``/
+        ``bool()`` of a traced value)
+KTA102  Python control flow (``if``/``while``/``assert``) on a traced
+        value (``is None`` checks are exempt — pytree structure, not
+        data)
+KTA103  data-dependent-shape op inside jit-reachable code
+        (``jnp.nonzero``/``jnp.unique``/``jnp.flatnonzero``,
+        one-argument ``jnp.where``, ``for ... in range(<traced>)``) —
+        recompiles per shape or fails to trace
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from keto_tpu.x.analysis.core import (
+    Finding,
+    Project,
+    SourceFile,
+    attr_chain,
+    names_in,
+    scope_of,
+)
+
+RULES = {
+    "KTA101": "host-sync call inside jit-reachable code",
+    "KTA102": "Python control flow on a traced value",
+    "KTA103": "data-dependent-shape op inside jit-reachable code",
+}
+
+#: the jit-reachable surface of this repo (fixture projects that match
+#: none of these analyze every file — see Project.under)
+SCOPE = ("keto_tpu/graph/", "keto_tpu/check/", "keto_tpu/parallel/")
+
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready", "copy_to_host_async"}
+_NUMPY_ROOTS = {"np", "numpy", "onp"}
+_NUMPY_SYNC_FUNCS = {"asarray", "array", "frombuffer", "ascontiguousarray"}
+_CAST_FUNCS = {"float", "int", "bool", "complex"}
+_SHAPE_DEP_FUNCS = {"nonzero", "unique", "flatnonzero", "argwhere"}
+_JNP_ROOTS = {"jnp", "jax.numpy", "np", "numpy"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` as an expression."""
+    chain = attr_chain(node)
+    return chain in ("jax.jit", "jit")
+
+
+def _static_names_from_call(call: ast.Call) -> set[str]:
+    """Literal ``static_argnames=(...)`` values on a jit/partial call."""
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            try:
+                val = ast.literal_eval(kw.value)
+            except ValueError:
+                continue
+            if isinstance(val, str):
+                names.add(val)
+            else:
+                names.update(v for v in val if isinstance(v, str))
+    return names
+
+
+@dataclass
+class _Func:
+    qual: str
+    node: ast.FunctionDef
+    sf: SourceFile
+    jitted: bool = False
+    static_names: set[str] = field(default_factory=set)
+    static_nums: set[int] = field(default_factory=set)
+
+
+def _collect_functions(sf: SourceFile) -> dict[str, _Func]:
+    funcs: dict[str, _Func] = {}
+    if sf.tree is None:
+        return funcs
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef):
+                qual = f"{prefix}{child.name}"
+                funcs[qual] = _Func(qual, child, sf)
+                walk(child, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+
+    walk(sf.tree, "")
+    return funcs
+
+
+def _mark_entries(sf: SourceFile, funcs: dict[str, _Func]) -> None:
+    """Mark jit entry points: decorators and wrap-at-assignment forms."""
+    by_name: dict[str, list[_Func]] = {}
+    for fn in funcs.values():
+        by_name.setdefault(fn.node.name, []).append(fn)
+
+    def mark(name: str, static_names: set[str], static_nums: set[int]):
+        for fn in by_name.get(name, []):
+            fn.jitted = True
+            fn.static_names |= static_names
+            fn.static_nums |= static_nums
+
+    for fn in funcs.values():
+        for dec in fn.node.decorator_list:
+            if _is_jit_expr(dec):
+                fn.jitted = True
+            elif isinstance(dec, ast.Call):
+                if _is_jit_expr(dec.func):
+                    fn.jitted = True
+                    fn.static_names |= _static_names_from_call(dec)
+                elif (
+                    attr_chain(dec.func) in ("partial", "functools.partial")
+                    and dec.args
+                    and _is_jit_expr(dec.args[0])
+                ):
+                    fn.jitted = True
+                    fn.static_names |= _static_names_from_call(dec)
+    if sf.tree is None:
+        return
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # jax.jit(f, static_argnames=...)
+        if _is_jit_expr(node.func) and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                mark(target.id, _static_names_from_call(node), set())
+        # partial(jax.jit, static_argnames=...)(f)
+        if (
+            isinstance(node.func, ast.Call)
+            and attr_chain(node.func.func) in ("partial", "functools.partial")
+            and node.func.args
+            and _is_jit_expr(node.func.args[0])
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+        ):
+            mark(node.args[0].id, _static_names_from_call(node.func), set())
+
+
+def _callees(fn: _Func, funcs: dict[str, _Func]) -> set[str]:
+    """Same-module call resolution: bare names to module-level functions,
+    ``self.m()`` to methods of the same class."""
+    out: set[str] = set()
+    cls_prefix = ""
+    if "." in fn.qual:
+        cls_prefix = fn.qual.rsplit(".", 1)[0] + "."
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in funcs:
+            out.add(f.id)
+        elif (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+            and cls_prefix
+            and f"{cls_prefix}{f.attr}" in funcs
+        ):
+            out.add(f"{cls_prefix}{f.attr}")
+    return out
+
+
+def _traced_names(fn: _Func) -> set[str]:
+    """Parameters (minus statics) plus locals assigned from them — a
+    single forward taint pass in statement order."""
+    args = fn.node.args
+    all_params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    traced = {
+        p
+        for i, p in enumerate(all_params)
+        if p not in fn.static_names and i not in fn.static_nums and p != "self"
+    }
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and names_in(node.value) & traced:
+            for target in node.targets:
+                for name in ast.walk(target):
+                    if isinstance(name, ast.Name):
+                        traced.add(name.id)
+    return traced
+
+
+def _compare_is_none_only(node: ast.AST) -> bool:
+    """True for ``x is None`` / ``x is not None`` (and `and`/`or`/`not`
+    combinations of those) — pytree-structure checks, not traced data."""
+    if isinstance(node, ast.BoolOp):
+        return all(_compare_is_none_only(v) for v in node.values)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        return _compare_is_none_only(node.operand)
+    if isinstance(node, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops)
+    return False
+
+
+def _offending_names(test: ast.AST, traced: set[str], strict: bool) -> set[str]:
+    """Traced names used as *data* in a condition: inside comparisons
+    (other than ``is``/``is not``), arithmetic, subscripts of compares,
+    or call arguments. Bare-name truthiness (``if xs`` / ``if not xs``)
+    is exempt unless ``strict`` — on pytrees it asks Python about
+    *structure* (an empty tuple of arrays), which traces fine; ``while``
+    conditions get ``strict`` because looping on truthiness of anything
+    traced is the classic convergence-check trap."""
+    if strict:
+        return names_in(test) & traced
+    out: set[str] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare):
+            if not all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                out |= names_in(node) & traced
+        elif isinstance(node, (ast.BinOp, ast.Call)):
+            out |= names_in(node) & traced
+    return out
+
+
+def _check_body(fn: _Func, findings: list[Finding]) -> None:
+    sf = fn.sf
+    traced = _traced_names(fn)
+    tree = sf.tree
+    assert tree is not None
+
+    def scope(node: ast.AST) -> str:
+        return scope_of(tree, node)
+
+    # skip nested lambdas/defs handed to lax control-flow combinators?
+    # No — they run traced too; the whole body is fair game.
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Call):
+            f = node.func
+            chain = attr_chain(f)
+            # .item() / .tolist() / .block_until_ready(): host syncs by
+            # nature — flagged regardless of receiver taint
+            if isinstance(f, ast.Attribute) and f.attr in _SYNC_ATTRS:
+                findings.append(
+                    Finding(
+                        "KTA101", sf.rel, node.lineno,
+                        f"`.{f.attr}()` forces a device->host sync inside "
+                        f"jit-reachable `{fn.qual}`",
+                        scope=scope(node),
+                    )
+                )
+            # np.asarray(traced) etc.
+            elif (
+                chain is not None
+                and "." in chain
+                and chain.rsplit(".", 1)[0] in _NUMPY_ROOTS
+                and chain.rsplit(".", 1)[1] in _NUMPY_SYNC_FUNCS
+                and any(names_in(a) & traced for a in node.args)
+            ):
+                findings.append(
+                    Finding(
+                        "KTA101", sf.rel, node.lineno,
+                        f"`{chain}` materializes a traced value on host "
+                        f"inside jit-reachable `{fn.qual}`",
+                        scope=scope(node),
+                    )
+                )
+            # float(traced) / int(traced) / bool(traced)
+            elif (
+                isinstance(f, ast.Name)
+                and f.id in _CAST_FUNCS
+                and node.args
+                and names_in(node.args[0]) & traced
+            ):
+                findings.append(
+                    Finding(
+                        "KTA101", sf.rel, node.lineno,
+                        f"`{f.id}()` of a traced value concretizes it "
+                        f"(host sync / trace error) in `{fn.qual}`",
+                        scope=scope(node),
+                    )
+                )
+            # shape-dependent ops
+            if chain is not None and "." in chain:
+                root, leaf = chain.rsplit(".", 1)
+                if root in _JNP_ROOTS and leaf in _SHAPE_DEP_FUNCS:
+                    findings.append(
+                        Finding(
+                            "KTA103", sf.rel, node.lineno,
+                            f"`{chain}` has a data-dependent output shape — "
+                            f"recompiles per shape inside `{fn.qual}`",
+                            scope=scope(node),
+                        )
+                    )
+                elif (
+                    root in _JNP_ROOTS
+                    and leaf == "where"
+                    and len(node.args) == 1
+                ):
+                    findings.append(
+                        Finding(
+                            "KTA103", sf.rel, node.lineno,
+                            f"one-argument `{chain}` has a data-dependent "
+                            f"output shape inside `{fn.qual}`",
+                            scope=scope(node),
+                        )
+                    )
+        elif isinstance(node, (ast.If, ast.While)):
+            test = node.test
+            strict = isinstance(node, ast.While)
+            bad = _offending_names(test, traced, strict)
+            if bad and not _compare_is_none_only(test):
+                kw = "while" if strict else "if"
+                findings.append(
+                    Finding(
+                        "KTA102", sf.rel, node.lineno,
+                        f"Python `{kw}` on traced value(s) {sorted(bad)} "
+                        f"in `{fn.qual}` — use lax.cond/lax.select, or "
+                        "mark the argument static",
+                        scope=scope(node),
+                    )
+                )
+        elif isinstance(node, ast.Assert):
+            if _offending_names(
+                node.test, traced, strict=False
+            ) and not _compare_is_none_only(node.test):
+                findings.append(
+                    Finding(
+                        "KTA102", sf.rel, node.lineno,
+                        f"`assert` on a traced value in `{fn.qual}` — "
+                        "asserts vanish under tracing or fail to trace",
+                        scope=scope(node),
+                    )
+                )
+        elif isinstance(node, ast.For):
+            it = node.iter
+            if (
+                isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Name)
+                and it.func.id == "range"
+                and any(names_in(a) & traced for a in it.args)
+            ):
+                findings.append(
+                    Finding(
+                        "KTA103", sf.rel, node.lineno,
+                        f"`for ... in range(<traced>)` in `{fn.qual}` "
+                        "unrolls per value (recompile) or fails to trace — "
+                        "use lax.fori_loop",
+                        scope=scope(node),
+                    )
+                )
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.under(*SCOPE):
+        if sf.tree is None:
+            continue
+        funcs = _collect_functions(sf)
+        if not funcs:
+            continue
+        _mark_entries(sf, funcs)
+        entries = [q for q, fn in funcs.items() if fn.jitted]
+        if not entries:
+            continue
+        # call-graph closure: everything reachable from a jit entry is
+        # traced. Callees inherit "every parameter is traced" (they see
+        # tracers for whatever the entry passed through).
+        reachable: set[str] = set()
+        frontier = list(entries)
+        while frontier:
+            qual = frontier.pop()
+            if qual in reachable:
+                continue
+            reachable.add(qual)
+            frontier.extend(_callees(funcs[qual], funcs))
+        for qual in sorted(reachable):
+            _check_body(funcs[qual], findings)
+    return findings

@@ -70,7 +70,7 @@ KILL_STATUS = 137
 #: this once per call and skip the locked dict entirely when clear.
 ACTIVE = False
 
-_lock = threading.Lock()
+_lock = threading.Lock()  # guards: _faults, _hits, ACTIVE
 _faults: dict[str, "_Fault"] = {}
 _hits: dict[str, int] = {}
 

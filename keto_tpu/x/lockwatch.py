@@ -1,0 +1,424 @@
+"""Runtime concurrency sanitizer: instrumented locks + deadlock watchdog.
+
+The static lock checker (keto_tpu/x/analysis/locks.py) sees the
+acquisition orders the *syntax* admits; this module observes the orders
+the *process* actually performs. With ``KETO_TPU_SANITIZE=1`` in the
+environment, importing keto_tpu swaps ``threading.Lock`` / ``RLock`` /
+``Condition`` for instrumented variants (only for locks allocated from
+this repo's own files) that record, per thread:
+
+- the **acquisition-order graph** over lock *allocation sites* (every
+  ``A held while acquiring B`` adds edge A→B). An edge whose reverse is
+  also observed is a **lock-order inversion** — two threads interleaving
+  those paths can deadlock, even if this run did not.
+- **hold times** (max per site) and contention (acquires that blocked).
+- a **deadlock watchdog**: a daemon thread that flags any acquisition
+  blocked longer than ``KETO_TPU_SANITIZE_WATCHDOG_S`` (default 30 s)
+  and dumps every thread's stack to stderr — the post-mortem for a
+  wedged smoke run, instead of a CI timeout with no evidence.
+
+Reports: :func:`report` (dict), :func:`violations` (list of strings —
+what CI gates on: empty means zero inversions and zero watchdog trips).
+At process exit, a report is written to ``$KETO_TPU_SANITIZE_REPORT``
+(JSON) when set — the chaos harness reads its daemon subprocesses'
+reports this way — and violations are printed to stderr.
+
+The overload and chaos smokes run under this sanitizer in CI; see
+docs/concepts/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import atexit
+import faulthandler
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Optional
+
+__all__ = [
+    "install",
+    "installed",
+    "maybe_install",
+    "report",
+    "violations",
+    "assert_clean",
+    "reset",
+]
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+_real_condition = threading.Condition
+
+#: paths a lock must be allocated under to be instrumented (bounds both
+#: overhead and noise to this repo's own locks)
+_SCOPE_MARKERS = ("keto_tpu", "tests", "scripts", "bench.py", "__graft_entry__")
+
+_state_lock = _real_lock()  # guards every _g_* structure below
+_g_edges: dict[tuple[str, str], int] = {}
+_g_edge_stacks: dict[tuple[str, str], str] = {}
+_g_inversions: list[dict[str, Any]] = []
+_g_inverted_pairs: set[frozenset] = set()
+_g_max_hold_s: dict[str, float] = {}
+_g_contended_acquires = 0
+_g_acquires = 0
+_g_watchdog_trips: list[dict[str, Any]] = []
+#: thread ident -> (site, started_monotonic) while blocked acquiring
+_g_waiting: dict[int, tuple[str, float]] = {}
+
+_tls = threading.local()
+_installed = False
+_watchdog_started = False
+
+
+def _held() -> list:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def _alloc_site() -> str:
+    """``file:line`` of the frame allocating the lock, skipping this
+    module; empty string when the allocation is out of scope."""
+    frame = sys._getframe(2)
+    while frame is not None:
+        fname = frame.f_code.co_filename
+        if not fname.endswith("lockwatch.py"):
+            break
+        frame = frame.f_back
+    if frame is None:
+        return ""
+    fname = frame.f_code.co_filename
+    norm = fname.replace("\\", "/")
+    if not any(m in norm for m in _SCOPE_MARKERS):
+        return ""
+    parts = norm.rsplit("/", 3)
+    short = "/".join(parts[-2:])
+    return f"{short}:{frame.f_lineno}"
+
+
+def _path_exists(graph: dict, src: str, dst: str) -> bool:
+    seen = {src}
+    frontier = [src]
+    while frontier:
+        node = frontier.pop()
+        for (a, b) in graph:
+            if a == node and b not in seen:
+                if b == dst:
+                    return True
+                seen.add(b)
+                frontier.append(b)
+    return False
+
+
+def _note_acquired(site: str, blocked_s: float, contended: bool) -> None:
+    global _g_contended_acquires, _g_acquires
+    held = _held()
+    with _state_lock:
+        _g_acquires += 1
+        if contended:
+            _g_contended_acquires += 1
+        for held_site, _t0, _obj in held:
+            if held_site == site:
+                continue  # same-site nesting (two instances); not orderable
+            edge = (held_site, site)
+            if edge not in _g_edges:
+                # reverse path already observed => inversion
+                if _path_exists(_g_edges, site, held_site):
+                    pair = frozenset((held_site, site))
+                    if pair not in _g_inverted_pairs:
+                        _g_inverted_pairs.add(pair)
+                        _g_inversions.append(
+                            {
+                                "locks": sorted(pair),
+                                "edge": list(edge),
+                                "thread": threading.current_thread().name,
+                                "stack": "".join(
+                                    traceback.format_stack(limit=12)
+                                ),
+                            }
+                        )
+                _g_edge_stacks[edge] = "".join(traceback.format_stack(limit=8))
+            _g_edges[edge] = _g_edges.get(edge, 0) + 1
+
+
+class _WatchedLockBase:
+    """Shared acquire/release bookkeeping for Lock and RLock wrappers."""
+
+    _reentrant = False
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._site = site
+
+    # -- core protocol ---------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = _held()
+        if self._reentrant and any(obj is self for _s, _t, obj in held):
+            # re-acquisition of an RLock by its owner: no ordering event
+            got = self._inner.acquire(blocking, timeout)
+            if got:
+                held.append((self._site, time.monotonic(), self))
+            return got
+        t0 = time.monotonic()
+        contended = False
+        got = self._inner.acquire(False)
+        if not got:
+            if not blocking:
+                return False
+            contended = True
+            ident = threading.get_ident()
+            with _state_lock:
+                _g_waiting[ident] = (self._site, t0)
+            try:
+                got = (
+                    self._inner.acquire(True, timeout)
+                    if timeout is not None and timeout >= 0
+                    else self._inner.acquire(True)
+                )
+            finally:
+                with _state_lock:
+                    _g_waiting.pop(ident, None)
+        if got:
+            _note_acquired(self._site, time.monotonic() - t0, contended)
+            held.append((self._site, time.monotonic(), self))
+        return got
+
+    def release(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            site, t0, obj = held[i]
+            if obj is self:
+                del held[i]
+                hold_s = time.monotonic() - t0
+                with _state_lock:
+                    if hold_s > _g_max_hold_s.get(site, 0.0):
+                        _g_max_hold_s[site] = hold_s
+                break
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __repr__(self) -> str:
+        return f"<lockwatch {type(self).__name__} {self._site} {self._inner!r}>"
+
+
+class _WatchedLock(_WatchedLockBase):
+    pass
+
+
+class _WatchedRLock(_WatchedLockBase):
+    _reentrant = True
+
+    # threading.Condition duck-types these when handed an RLock-like
+    # object; the bookkeeping must mirror the real release/reacquire or
+    # the held-stack (and therefore edge detection) drifts during waits.
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        held = _held()
+        count = 0
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][2] is self:
+                del held[i]
+                count += 1
+        return self._inner._release_save(), count
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, count = state
+        self._inner._acquire_restore(inner_state)
+        held = _held()
+        now = time.monotonic()
+        for _ in range(max(1, count)):
+            held.append((self._site, now, self))
+
+
+def _watched_lock_factory():
+    site = _alloc_site()
+    inner = _real_lock()
+    return _WatchedLock(inner, site) if site else inner
+
+
+def _watched_rlock_factory():
+    site = _alloc_site()
+    inner = _real_rlock()
+    return _WatchedRLock(inner, site) if site else inner
+
+
+def _watched_condition(lock: Optional[Any] = None) -> "threading.Condition":
+    if lock is None:
+        site = _alloc_site()
+        if site:
+            lock = _WatchedRLock(_real_rlock(), site)
+    return _real_condition(lock)
+
+
+# -- watchdog ------------------------------------------------------------------
+
+
+def _watchdog_threshold_s() -> float:
+    try:
+        return float(os.environ.get("KETO_TPU_SANITIZE_WATCHDOG_S", "30"))
+    except ValueError:
+        return 30.0
+
+
+def _watchdog_scan(
+    threshold: float, tripped: set, now: Optional[float] = None
+) -> int:
+    """One watchdog pass: record a trip (+ stack dump) for every thread
+    blocked on an acquisition longer than ``threshold``. Returns the
+    number of NEW trips. Factored out of the loop so tests can drive it
+    without waiting wall-clock minutes."""
+    now = time.monotonic() if now is None else now
+    with _state_lock:
+        stuck = [
+            (ident, site, now - t0)
+            for ident, (site, t0) in _g_waiting.items()
+            if now - t0 > threshold and ident not in tripped
+        ]
+    for ident, site, waited in stuck:
+        tripped.add(ident)
+        names = {t.ident: t.name for t in threading.enumerate()}
+        trip = {
+            "thread": names.get(ident, str(ident)),
+            "lock_site": site,
+            "waited_s": round(waited, 1),
+        }
+        with _state_lock:
+            _g_watchdog_trips.append(trip)
+        print(
+            f"lockwatch WATCHDOG: thread {trip['thread']} blocked "
+            f"{waited:.1f}s acquiring lock from {site}; all stacks follow",
+            file=sys.stderr,
+        )
+        faulthandler.dump_traceback(file=sys.stderr)
+    return len(stuck)
+
+
+def _watchdog_loop() -> None:
+    tripped: set[int] = set()
+    while True:
+        # threshold re-read each pass so long-lived processes honor an
+        # env change made before a specific phase (tests, rehearsals)
+        threshold = _watchdog_threshold_s()
+        time.sleep(min(1.0, threshold / 4))
+        _watchdog_scan(threshold, tripped)
+
+
+# -- public API ----------------------------------------------------------------
+
+
+def installed() -> bool:
+    return _installed
+
+
+def install() -> None:
+    """Swap threading's lock factories for instrumented ones and start
+    the watchdog. Idempotent. Locks created BEFORE install stay
+    uninstrumented — install early (keto_tpu/__init__ does, under
+    ``KETO_TPU_SANITIZE=1``)."""
+    global _installed, _watchdog_started
+    if _installed:
+        return
+    _installed = True
+    threading.Lock = _watched_lock_factory  # type: ignore[misc,assignment]
+    threading.RLock = _watched_rlock_factory  # type: ignore[misc,assignment]
+    threading.Condition = _watched_condition  # type: ignore[misc,assignment]
+    if not _watchdog_started:
+        _watchdog_started = True
+        t = threading.Thread(
+            target=_watchdog_loop, name="keto-tpu-lockwatch", daemon=True
+        )
+        t.start()
+    atexit.register(_at_exit)
+
+
+def maybe_install() -> bool:
+    if os.environ.get("KETO_TPU_SANITIZE") == "1":
+        install()
+        return True
+    return False
+
+
+def report() -> dict[str, Any]:
+    with _state_lock:
+        return {
+            "enabled": _installed,
+            "acquires": _g_acquires,
+            "contended_acquires": _g_contended_acquires,
+            "edges": {f"{a} -> {b}": n for (a, b), n in sorted(_g_edges.items())},
+            "max_hold_s": {
+                site: round(s, 4) for site, s in sorted(_g_max_hold_s.items())
+            },
+            "inversions": list(_g_inversions),
+            "watchdog_trips": list(_g_watchdog_trips),
+        }
+
+
+def violations() -> list[str]:
+    """What the smokes gate on: empty list == clean run."""
+    out: list[str] = []
+    with _state_lock:
+        for inv in _g_inversions:
+            out.append(
+                "lock-order inversion between "
+                + " and ".join(inv["locks"])
+                + f" (thread {inv['thread']})"
+            )
+        for trip in _g_watchdog_trips:
+            out.append(
+                f"deadlock-watchdog trip: {trip['thread']} blocked "
+                f"{trip['waited_s']}s on lock from {trip['lock_site']}"
+            )
+    return out
+
+
+def assert_clean() -> None:
+    v = violations()
+    if v:
+        raise AssertionError(
+            "lockwatch found concurrency violations:\n  " + "\n  ".join(v)
+        )
+
+
+def reset() -> None:
+    """Clear recorded state (tests)."""
+    global _g_contended_acquires, _g_acquires
+    with _state_lock:
+        _g_edges.clear()
+        _g_edge_stacks.clear()
+        _g_inversions.clear()
+        _g_inverted_pairs.clear()
+        _g_max_hold_s.clear()
+        _g_watchdog_trips.clear()
+        _g_waiting.clear()
+        _g_contended_acquires = 0
+        _g_acquires = 0
+
+
+def _at_exit() -> None:
+    path = os.environ.get("KETO_TPU_SANITIZE_REPORT")
+    if path:
+        try:
+            with open(path, "w") as f:
+                json.dump(report(), f, indent=2)
+        except OSError as e:
+            print(f"lockwatch: report write failed: {e}", file=sys.stderr)
+    for v in violations():
+        print(f"lockwatch: {v}", file=sys.stderr)

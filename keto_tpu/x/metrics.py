@@ -270,7 +270,7 @@ class MetricsRegistry:
     order."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _families
         self._families: dict[str, Any] = {}
         self._stripes = [threading.Lock() for _ in range(_N_STRIPES)]
         #: scrapes served (itself a family, registered lazily by render)

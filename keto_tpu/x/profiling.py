@@ -67,8 +67,11 @@ def attach(mode: str) -> None:
                 jax.profiler.stop_trace()
                 print(f"== jax profiler trace written to {trace_dir} ==",
                       file=sys.stderr)
-            except Exception:
-                pass
+            except Exception as e:
+                # mirror the start-path degradation: say WHY the trace is
+                # missing instead of exiting with no artifact and no hint
+                print(f"profiling: jax trace finalization failed ({e!r})",
+                      file=sys.stderr)
 
         atexit.register(dump)
     elif mode:

@@ -54,7 +54,7 @@ class SupervisedTask:
         self._stop = threading.Event()
         self._backoff = Backoff(base_s=base_backoff_s, max_s=max_backoff_s)
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _thread
         self._retry_at: Optional[float] = None
         self.crashes = 0
         self.consecutive_failures = 0

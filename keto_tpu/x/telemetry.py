@@ -26,7 +26,7 @@ class DurationStats:
     benchmark grades."""
 
     def __init__(self, capacity: int = 4096):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _window, _count
         self._window: collections.deque = collections.deque(maxlen=capacity)
         self._count = 0
         # optional /metrics bridge: a histogram (keto_tpu/x/metrics.py)
@@ -95,7 +95,7 @@ class MaintenanceStats:
     bench.py grades the same numbers the engine steers by."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _counters, _gauges, _durations
         self._counters: collections.Counter = collections.Counter()
         self._gauges: dict[str, float] = {}
         self._durations: dict[str, dict] = {}
@@ -154,7 +154,7 @@ class Telemetry:
     def __init__(self, enabled: bool = False, max_routes: int = 256):
         self.enabled = enabled
         self._max_routes = max_routes
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _counts
         self._counts: collections.Counter = collections.Counter()
 
     def record(self, route: str) -> None:

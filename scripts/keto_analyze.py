@@ -1,0 +1,102 @@
+"""keto-analyze CLI: the repo's static-analysis gate.
+
+Runs every checker in keto_tpu/x/analysis over the serving sources and
+fails (exit 1) on any finding outside the baseline. This is the CI
+``static-analysis`` job's first step; run it locally before pushing:
+
+    python scripts/keto_analyze.py                 # the gate
+    python scripts/keto_analyze.py --rules         # checker catalog
+    python scripts/keto_analyze.py keto_tpu/x      # narrower scope
+    python scripts/keto_analyze.py --write-baseline  # accept current debt
+
+Suppress a single finding inline WITH a justification::
+
+    risky_line()  # keto-analyze: ignore[KTA202] <why this is safe>
+
+Baseline entries and justification-less suppressions are themselves
+findings — debt stays visible, it never silently grows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+#: the default analyzed surface (tests are exercised code, not serving
+#: code — they may block/swallow freely)
+DEFAULT_PATHS = ("keto_tpu", "scripts", "bench.py")
+DEFAULT_BASELINE = ".keto-analyze-baseline.json"
+
+
+def main(argv=None) -> int:
+    from keto_tpu.x import analysis
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help="baseline file of accepted pre-existing findings",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept every current finding into the baseline and exit 0",
+    )
+    ap.add_argument(
+        "--rules", action="store_true", help="print the checker catalog"
+    )
+    ap.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also list baselined findings",
+    )
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rule, desc in sorted(analysis.all_rules().items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    project = analysis.load_project(ROOT, args.paths or DEFAULT_PATHS)
+    findings = analysis.analyze(project)
+
+    baseline_path = ROOT / args.baseline
+    if args.write_baseline:
+        analysis.write_baseline(baseline_path, findings)
+        print(
+            f"keto-analyze: baseline written with {len(findings)} "
+            f"finding(s) to {args.baseline}"
+        )
+        return 0
+
+    baseline = analysis.load_baseline(baseline_path)
+    result = analysis.apply_baseline(findings, baseline)
+
+    if args.show_suppressed and result.suppressed:
+        print(f"-- {len(result.suppressed)} baselined finding(s):")
+        for f in result.suppressed:
+            print(f"   {f.render()}")
+    for fp in result.stale:
+        print(f"keto-analyze: stale baseline entry (fixed? remove it): {fp}")
+
+    if result.new:
+        print(f"keto-analyze FAILED: {len(result.new)} new finding(s):")
+        for f in result.new:
+            print(f"  {f.render()}")
+        print(
+            "\nFix them, suppress inline with a justification "
+            "(# keto-analyze: ignore[RULE] why), or — for pre-existing "
+            "debt only — rerun with --write-baseline."
+        )
+        return 1
+
+    n_files = len(project.files)
+    extra = f", {len(result.suppressed)} baselined" if result.suppressed else ""
+    print(f"keto-analyze OK: {n_files} files, 0 new findings{extra}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

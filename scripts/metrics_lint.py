@@ -12,13 +12,18 @@ The CI seam keeping /metrics and its documentation honest:
    docs/concepts/observability.md — a family exposed but undocumented,
    or documented but missing from the scrape, fails the build.
 
+This is the **dynamic half** of the metric-surface check: the family
+table parser and the static declared-instrument extraction are shared
+with keto-analyze (keto_tpu/x/analysis/surface.py, rule KTA302), which
+cross-checks code↔docs without booting anything. This script proves the
+declared families actually make it onto the wire.
+
 Exit code 0 on a clean scrape; 1 with the violations listed.
 """
 
 from __future__ import annotations
 
 import json
-import re
 import sys
 import urllib.error
 import urllib.request
@@ -29,17 +34,24 @@ sys.path.insert(0, str(ROOT))
 
 DOC = ROOT / "docs" / "concepts" / "observability.md"
 
-#: a documented family row: | `keto_...` | type | labels | meaning |
-_DOC_ROW_RE = re.compile(r"^\|\s*`(keto_[a-z0-9_]+)`\s*\|\s*(\w+)\s*\|")
-
 
 def documented_families() -> dict[str, str]:
-    families = {}
-    for line in DOC.read_text().splitlines():
-        m = _DOC_ROW_RE.match(line)
-        if m:
-            families[m.group(1)] = m.group(2)
-    return families
+    """The family table — shared parser with the static checker."""
+    from keto_tpu.x.analysis.surface import documented_families as parse
+
+    return parse(DOC)
+
+
+def statically_declared() -> set[str]:
+    """Families declared in code per keto-analyze's static extraction —
+    the scrape must contain exactly this set (a family that renders but
+    is not statically visible means the extraction lost a declaration
+    site; fix the checker, not the build)."""
+    from keto_tpu.x.analysis import load_project
+    from keto_tpu.x.analysis.surface import declared_families
+
+    project = load_project(ROOT, ("keto_tpu",))
+    return set(declared_families(project))
 
 
 def drive_traffic(read_port: int, write_port: int) -> None:
@@ -109,6 +121,13 @@ def lint(text: str) -> list[str]:
         )
     for name in sorted(set(documented) - exposed):
         problems.append(f"family {name} is documented but absent from the scrape")
+    declared = statically_declared()
+    for name in sorted(exposed - declared):
+        problems.append(
+            f"family {name} is on the wire but invisible to the static "
+            "extraction (keto_tpu/x/analysis/surface.py) — declare it via "
+            "a literal-name instrument call"
+        )
     for name, fam in families.items():
         if name in documented and documented[name] != fam["type"]:
             problems.append(

@@ -44,6 +44,22 @@ def main() -> int:
     out = run_overload(random.Random(7042))
     problems: list[str] = []
 
+    # under KETO_TPU_SANITIZE=1 the whole burst ran on instrumented locks
+    # (keto_tpu/x/lockwatch.py): zero lock-order inversions and zero
+    # deadlock-watchdog trips are part of the gate
+    from keto_tpu.x import lockwatch
+
+    if lockwatch.installed():
+        problems.extend(lockwatch.violations())
+        rep = lockwatch.report()
+        log(
+            f"[overload] lockwatch: {rep['acquires']} acquires, "
+            f"{rep['contended_acquires']} contended, "
+            f"{len(rep['edges'])} order edges, "
+            f"{len(rep['inversions'])} inversions, "
+            f"{len(rep['watchdog_trips'])} watchdog trips"
+        )
+
     over = out.get("overload_3x") or {}
     inter = over.get("interactive") or {}
     batch = over.get("batch") or {}

@@ -1,0 +1,66 @@
+"""The full static-analysis gate: keto-analyze, then ruff, then mypy.
+
+One entrypoint for CI (`static-analysis` job) and local use:
+
+    python scripts/static_checks.py
+
+- **keto-analyze** (scripts/keto_analyze.py) always runs — it is
+  repo-native and dependency-free.
+- **ruff** and **mypy** run when importable (the CI job pip-installs
+  them; the runtime image does not ship them). Absent tools are
+  reported as SKIPPED, not failed, so the gate is usable everywhere —
+  but CI, which installs both, gets the full matrix.
+
+Exit 0 only when every check that ran passed.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def _have(module: str) -> bool:
+    return importlib.util.find_spec(module) is not None
+
+
+def main() -> int:
+    results: list[tuple[str, str]] = []
+    failed = False
+
+    rc = subprocess.call(
+        [sys.executable, str(ROOT / "scripts" / "keto_analyze.py")], cwd=ROOT
+    )
+    results.append(("keto-analyze", "ok" if rc == 0 else "FAILED"))
+    failed |= rc != 0
+
+    if _have("ruff"):
+        rc = subprocess.call(
+            [sys.executable, "-m", "ruff", "check", "keto_tpu", "scripts", "bench.py"],
+            cwd=ROOT,
+        )
+        results.append(("ruff", "ok" if rc == 0 else "FAILED"))
+        failed |= rc != 0
+    else:
+        results.append(("ruff", "SKIPPED (not installed)"))
+
+    if _have("mypy"):
+        # scope + strictness come from pyproject.toml [tool.mypy]
+        rc = subprocess.call([sys.executable, "-m", "mypy"], cwd=ROOT)
+        results.append(("mypy", "ok" if rc == 0 else "FAILED"))
+        failed |= rc != 0
+    else:
+        results.append(("mypy", "SKIPPED (not installed)"))
+
+    print("\nstatic-checks summary:")
+    for name, status in results:
+        print(f"  {name:14s} {status}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

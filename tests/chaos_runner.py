@@ -74,9 +74,10 @@ def main() -> int:
         json.dump(ports, f)
     os.replace(tmp, target)
 
-    # block until a shutdown signal, then leave through the drain path —
+    # block until a shutdown signal (bounded, looped — SIGTERM must
+    # always terminate the wait), then leave through the drain path —
     # every clean exit in the chaos loop also regression-tests SIGTERM
-    daemon._stop_requested.wait()
+    daemon.wait_for_shutdown()
     try:
         daemon.drain_and_shutdown()
     except BaseException:

@@ -77,6 +77,15 @@ class DaemonProc:
             env["KETO_TPU_FAULTS"] = faults
         else:
             env.pop("KETO_TPU_FAULTS", None)
+        # under the concurrency sanitizer (KETO_TPU_SANITIZE=1 in the
+        # caller's env, e.g. the chaos-smoke CI job) every daemon writes
+        # a lockwatch report at clean exit; sanitize_violations() reads
+        # it so each drained daemon also proves zero lock-order
+        # inversions and zero deadlock-watchdog trips
+        self.sanitize_report = None
+        if env.get("KETO_TPU_SANITIZE") == "1":
+            self.sanitize_report = workdir / f"lockwatch-{os.urandom(4).hex()}.json"
+            env["KETO_TPU_SANITIZE_REPORT"] = str(self.sanitize_report)
         # daemon output lands in a per-process log for post-mortems
         self.log = open(workdir / f"daemon-{os.urandom(4).hex()}.log", "wb")
         self.proc = subprocess.Popen(
@@ -148,6 +157,17 @@ class DaemonProc:
     def terminate_gracefully(self, timeout=30.0) -> int:
         self.proc.send_signal(signal.SIGTERM)
         return self.proc.wait(timeout=timeout)
+
+    def sanitize_violations(self):
+        """Lock-order inversions + watchdog trips from the subprocess's
+        lockwatch report (clean exits only — a SIGKILLed daemon never
+        writes one). Empty list when the sanitizer was off."""
+        if self.sanitize_report is None or not self.sanitize_report.is_file():
+            return []
+        report = json.loads(self.sanitize_report.read_text())
+        return list(report.get("inversions", [])) + list(
+            report.get("watchdog_trips", [])
+        )
 
     def log_tail(self, nbytes=4000) -> str:
         try:
@@ -342,6 +362,11 @@ def test_chaos_kill_and_recover(tmp_path):
             assert code == 0, (
                 f"cycle {cycle}: graceful shutdown exited {code}; "
                 f"daemon log tail:\n{survivor.log_tail()}"
+            )
+            bad = survivor.sanitize_violations()
+            assert not bad, (
+                f"cycle {cycle}: concurrency sanitizer found violations "
+                f"in the drained daemon: {bad}"
             )
         finally:
             survivor.kill()
