@@ -456,6 +456,145 @@ def incremental_pass(engine, store, burst, sample_queries, tag, ingest_s, snapsh
     return out
 
 
+def run_depth_sweep(rng):
+    """Depth tax sweep: chained-group graphs at depth 2/4/8/16, measuring
+    the 2-hop label fast path against the BFS loop it replaces. Per
+    depth: checks/s with labels on vs off, label hit rate over the timed
+    window, ``label_build_s``, and the BFS engine's per-slice frontier
+    hops (``bfs_steps_p50/p99``) — the number the label win kills.
+
+    Each chain carries a back-edge (bottom level → top) so its interior
+    rows stay active instead of peeling into the host walk: the sweep
+    must measure the ITERATED depth the 10M depth-8 config pays, not the
+    host-propagated kind. Knobs: BENCH_DEPTH_TUPLES / BENCH_DEPTH_CHECKS
+    / BENCH_DEPTHS; BENCH_DEPTH_ASSERT=1 (CI bench-smoke) additionally
+    asserts a nonzero label hit rate and zero mismatches vs the CPU
+    oracle at every depth."""
+    from keto_tpu import namespace as namespace_pkg
+    from keto_tpu.check import CheckEngine
+    from keto_tpu.check.tpu_engine import TpuCheckEngine
+    from keto_tpu.persistence.memory import MemoryPersister
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    def T(ns, obj, rel, sub):
+        return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+    base_tuples = int(os.environ.get("BENCH_TUPLES", 1_000_000))
+    n_tuples = int(os.environ.get("BENCH_DEPTH_TUPLES", max(20_000, base_tuples // 10)))
+    n_checks = int(os.environ.get("BENCH_DEPTH_CHECKS", 20_000))
+    depths = [int(d) for d in os.environ.get("BENCH_DEPTHS", "2,4,8,16").split(",")]
+    oracle_sample = int(os.environ.get("BENCH_DEPTH_ORACLE_SAMPLE", 300))
+    must_assert = os.environ.get("BENCH_DEPTH_ASSERT", "0") == "1"
+    reps = int(os.environ.get("BENCH_REPS", 3))
+    users_per_chain = 4
+
+    out = {}
+    for D in depths:
+        nm = namespace_pkg.MemoryManager(
+            [namespace_pkg.Namespace(id=1, name="g"), namespace_pkg.Namespace(id=2, name="d")]
+        )
+        store = MemoryPersister(nm)
+        per_chain = D + 1 + users_per_chain  # nesting + cycle edge + doc + users
+        n_chains = max(4, n_tuples // per_chain)
+        tuples = []
+        for c in range(n_chains):
+            for lv in range(D - 1):
+                tuples.append(
+                    T("g", f"c{c}-l{lv}", "m", SubjectSet("g", f"c{c}-l{lv+1}", "m"))
+                )
+            # back-edge: keeps every level active-interior (no peel)
+            tuples.append(
+                T("g", f"c{c}-l{D-1}", "m", SubjectSet("g", f"c{c}-l0", "m"))
+            )
+            tuples.append(T("d", f"doc-{c}", "view", SubjectSet("g", f"c{c}-l0", "m")))
+            for u in range(users_per_chain):
+                tuples.append(
+                    T("g", f"c{c}-l{D-1}", "m", SubjectID(f"u-{c}-{u}"))
+                )
+        store.write_relation_tuples(*tuples)
+
+        queries, expected = [], []
+        for i in range(n_checks):
+            c = rng.randrange(n_chains)
+            if i % 2 == 0:
+                cu, grant = c, True
+            else:
+                cu = rng.randrange(n_chains)
+                grant = cu == c
+            queries.append(
+                T("d", f"doc-{c}", "view",
+                  SubjectID(f"u-{cu}-{rng.randrange(users_per_chain)}"))
+            )
+            expected.append(grant)
+
+        def timed_pass(engine):
+            engine.batch_check(queries)  # warmup/compile
+            engine.bfs_steps_stats.reset()
+            times = []
+            got = None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                got = engine.batch_check(queries)
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            return got, n_checks / times[len(times) // 2]
+
+        eng_on = TpuCheckEngine(store, store.namespaces)
+        t0 = time.perf_counter()
+        snap = eng_on.snapshot()
+        build_s = time.perf_counter() - t0
+        maint0 = eng_on.maintenance.snapshot()
+        got_on, qps_on = timed_pass(eng_on)
+        maint1 = eng_on.maintenance.snapshot()
+        served = maint1.get("label_checks", 0) - maint0.get("label_checks", 0)
+        fell = maint1.get("label_fallbacks", 0) - maint0.get("label_fallbacks", 0)
+        hit_rate = served / max(1, served + fell)
+
+        eng_off = TpuCheckEngine(store, store.namespaces, labels_enabled=False)
+        eng_off.snapshot()
+        got_off, qps_off = timed_pass(eng_off)
+        steps = eng_off.bfs_steps_stats.snapshot()
+
+        oracle = CheckEngine(store)
+        sample = queries[:oracle_sample]
+        og = [oracle.subject_is_allowed(q) for q in sample]
+        mism_on = sum(g != o for g, o in zip(got_on[: len(og)], og))
+        mism_off = sum(g != o for g, o in zip(got_off[: len(og)], og))
+        wrong_on = sum(g != e for g, e in zip(got_on, expected))
+        rec = {
+            "tuples": len(tuples),
+            "interior_rows": snap.num_int,
+            "checks": n_checks,
+            "checks_per_s_labels": round(qps_on, 1),
+            "checks_per_s_bfs": round(qps_off, 1),
+            "label_speedup": round(qps_on / qps_off, 2) if qps_off else None,
+            "label_hit_rate": round(hit_rate, 4),
+            "label_build_s": round(
+                eng_on.maintenance.snapshot().get("label_build_last_ms", 0.0) / 1e3, 3
+            ),
+            "label_coverage": eng_on.maintenance.snapshot().get("label_coverage"),
+            "snapshot_build_s": round(build_s, 2),
+            "bfs_steps_p50": steps["p50_ms"],
+            "bfs_steps_p99": steps["p99_ms"],
+            "wrong_vs_expected": wrong_on,
+            "label_oracle_mismatches": mism_on,
+            "bfs_oracle_mismatches": mism_off,
+        }
+        out[f"depth_{D}"] = rec
+        log(
+            f"[depth] D={D}: labels {qps_on:,.0f} checks/s vs bfs "
+            f"{qps_off:,.0f} ({rec['label_speedup']}x), hit rate "
+            f"{hit_rate:.1%}, build {rec['label_build_s']}s, bfs steps "
+            f"p50={steps['p50_ms']:.0f} p99={steps['p99_ms']:.0f}, "
+            f"mismatches on={mism_on} off={mism_off}"
+        )
+        if must_assert:
+            assert hit_rate > 0, f"depth {D}: label path never engaged"
+            assert mism_on == 0, f"depth {D}: label path diverged from oracle"
+            assert wrong_on == 0, f"depth {D}: wrong decisions vs analytic expectation"
+    return out
+
+
 def run_config2(rng):
     """BASELINE config 2: synthetic flat ACL — 100k direct
     (object#relation@user) tuples, 10k batched checks, depth 1. The
@@ -592,6 +731,8 @@ def run_config4(rng):
     log(f"[c4] warmup/compile: {time.perf_counter()-t0:.1f}s")
 
     reps = int(os.environ.get("BENCH_REPS", 3))
+    engine.bfs_steps_stats.reset()
+    maint0 = engine.maintenance.snapshot()
     times = []
     got = None
     for _ in range(reps):
@@ -602,6 +743,20 @@ def run_config4(rng):
     tpu_s = times[len(times) // 2]
     tpu_qps = n_checks / tpu_s
     log(f"[c4] batch reps: {['%.0f ms' % (t*1e3) for t in times]}")
+    # frontier-hop count per dispatched slice across the timed window —
+    # the depth tax the label path removes must be attributable, not
+    # inferred from interior_rows (BENCH_r04's gap)
+    bfs_steps = engine.bfs_steps_stats.snapshot()
+    maint1 = engine.maintenance.snapshot()
+    lab_served = maint1.get("label_checks", 0) - maint0.get("label_checks", 0)
+    lab_fell = maint1.get("label_fallbacks", 0) - maint0.get("label_fallbacks", 0)
+    label_hit_rate = round(lab_served / max(1, lab_served + lab_fell), 4)
+    label_build_s = round(maint1.get("label_build_last_ms", 0.0) / 1e3, 3)
+    log(
+        f"[c4] label hit rate {label_hit_rate:.1%}, build {label_build_s}s; "
+        f"bfs steps p50={bfs_steps['p50_ms']:.0f} p99={bfs_steps['p99_ms']:.0f} "
+        f"over {bfs_steps['count']} BFS slices"
+    )
 
     # adaptive streamed per-slice latency (p50/p99)
     stream_got, stream_metrics = stream_pass(engine, snap, queries, "c4")
@@ -648,6 +803,11 @@ def run_config4(rng):
         "interior_rows": snap.num_int,
         "checks_per_s": round(tpu_qps, 1),
         "tpu_batch_ms_all_reps": [round(t * 1e3, 1) for t in times],
+        "bfs_steps_p50": bfs_steps["p50_ms"],
+        "bfs_steps_p99": bfs_steps["p99_ms"],
+        "bfs_slices": bfs_steps["count"],
+        "label_hit_rate": label_hit_rate,
+        "label_build_s": label_build_s,
         **stream_metrics,
         "stream_wrong": stream_wrong,
         "ingest_s": round(ingest_s, 2),
@@ -1455,6 +1615,18 @@ def main():
             log(f"[overload] FAILED: {e!r}")
             overload = {"error": repr(e)}
 
+    # depth tax sweep: the 2-hop label fast path vs the BFS loop at
+    # depth 2/4/8/16 (failures degrade to an error field)
+    depth_sweep = None
+    if os.environ.get("BENCH_DEPTH", "1") != "0":
+        try:
+            depth_sweep = run_depth_sweep(random.Random(4042))
+        except Exception as e:  # pragma: no cover - diagnostic path
+            log(f"[depth] FAILED: {e!r}")
+            depth_sweep = {"error": repr(e)}
+            if os.environ.get("BENCH_DEPTH_ASSERT", "0") == "1":
+                raise
+
     # BASELINE configs 2/4/5 — failures must not lose the headline JSON line
     config2 = None
     if os.environ.get("BENCH_CONFIG2", "1") != "0":
@@ -1514,6 +1686,7 @@ def main():
                     "device": str(jax.devices()[0]),
                     "scrape_overhead": scrape_overhead,
                     "overload": overload,
+                    "depth_sweep": depth_sweep,
                     "config2_flat_acl": config2,
                     "config4_10m_depth8": config4,
                     "config5_50m_stream": config5,

@@ -257,6 +257,85 @@ _check_kernel = partial(
     ),
 )(check_step)
 
+#: cap on the [pairs, W_out, W_in] compare intermediate per chunk
+_LABEL_PAIR_CHUNK = 2048
+
+
+def label_step(
+    out_lab: jnp.ndarray,  # int32 [n_int+1, Wo], OUT_PAD-padded (row n_int all pad)
+    in_lab: jnp.ndarray,  # int32 [n_int+1, Wi], IN_PAD-padded
+    entries: jnp.ndarray,  # int32 [3·P]: pair a-rows, pair b-rows, owning query
+    *,
+    n_pairs: int,
+    B: int,
+) -> jnp.ndarray:
+    """2-hop label-intersection check: ONE device step at any depth.
+
+    Each pair (a, b) asks reach0(a, b) over the interior subgraph — does
+    ``OUT(a)`` share a landmark with ``IN(b)``? The two sides pad with
+    distinct sentinels (labels.OUT_PAD / IN_PAD), so padded slots (and
+    the all-pad row ``n_int`` the pair padding gathers) can never
+    witness an intersection. Pair hits OR into their owning query and
+    the decisions pack to 1 bit per query, same transfer shape as
+    ``check_step`` minus the iteration tail — there is no iteration.
+
+    This is the O(1)-step fast path the BFS kernel's depth tax motivates
+    (keto_tpu/graph/labels.py); the engine routes only label-certifiable
+    queries here and everything else to ``check_step`` bit-identically.
+    """
+    P = n_pairs
+    pa = entries[:P]
+    pb = entries[P : 2 * P]
+    pq = entries[2 * P : 3 * P]
+    hits = []
+    for c0 in range(0, P, _LABEL_PAIR_CHUNK):
+        oa = out_lab[pa[c0 : c0 + _LABEL_PAIR_CHUNK]]  # [chunk, Wo]
+        ib = in_lab[pb[c0 : c0 + _LABEL_PAIR_CHUNK]]  # [chunk, Wi]
+        hits.append(jnp.any(oa[:, :, None] == ib[:, None, :], axis=(1, 2)))
+    hit = jnp.concatenate(hits) if len(hits) > 1 else hits[0]
+    W = B // 32
+    q = jnp.arange(B)
+    bits = (q % 32).astype(jnp.uint32)
+    # pair hits from one query land on the same bit — max, never add
+    ans = jnp.zeros(B, jnp.uint32).at[pq].max(hit.astype(jnp.uint32))
+    return lax.reduce(
+        (ans << bits).reshape(W, 32), np.uint32(0), lax.bitwise_or, (1,)
+    )
+
+
+_label_kernel = partial(jax.jit, static_argnames=("n_pairs", "B"))(label_step)
+
+
+class _HybridSlice:
+    """Device output(s) of one label-routed slice: the label kernel's
+    packed bits for the whole slice, plus — when some queries fell back —
+    a BFS sub-batch output and the slice positions it answers. Quacks
+    like a device array where the streaming pipeline needs it
+    (``copy_to_host_async`` / ``is_ready``)."""
+
+    __slots__ = ("label_dev", "bfs_dev", "bfs_pos")
+
+    def __init__(self, label_dev, bfs_dev=None, bfs_pos=None):
+        self.label_dev = label_dev
+        self.bfs_dev = bfs_dev
+        self.bfs_pos = bfs_pos
+
+    def parts(self) -> list:
+        out = [self.label_dev]
+        if self.bfs_dev is not None:
+            out.append(self.bfs_dev)
+        return out
+
+    def copy_to_host_async(self) -> None:
+        for p in self.parts():
+            p.copy_to_host_async()
+
+    def is_ready(self) -> bool:
+        return all(
+            bool(r()) for p in self.parts()
+            for r in (getattr(p, "is_ready", None),) if r is not None
+        )
+
 
 def pack_entries(packed) -> tuple[np.ndarray, tuple[int, int, int, int]]:
     """Concatenate pack_chunk's seven arrays into check_step's single
@@ -589,6 +668,9 @@ class TpuCheckEngine:
         degraded_probe_s: float = 5.0,
         device_error_threshold: int = 3,
         refresh_retry_max_wait_s: float = 2.0,
+        labels_enabled: bool = True,
+        labels_max_width: int = 64,
+        labels_landmarks: int = 0,
     ):
         if it_cap < 1:
             raise ValueError("it_cap must be >= 1 (the answer pull needs one step)")
@@ -614,6 +696,21 @@ class TpuCheckEngine:
         # controller, bench.py, and operators all read the same numbers
         self.stream_ctrl = StreamSliceController(target_ms=stream_slice_target_ms)
         self.stream_slice_stats = DurationStats()
+        #: device BFS iteration counts per dispatched slice (values are
+        #: step counts, not ms) — bench reports bfs_steps_p50/p99 from
+        #: here so the label win is attributable to killed frontier hops
+        self.bfs_steps_stats = DurationStats()
+        # 2-hop reachability labels (keto_tpu/graph/labels.py): built at
+        # snapshot-build time, served as the O(1)-step fast path for
+        # deep checks; BFS stays the fallback for everything the labels
+        # can't certify (wildcards, self-queries, overlay-dirtied
+        # interior edges, width/landmark coverage gaps)
+        self._labels_enabled = bool(labels_enabled)
+        self._labels_max_width = int(labels_max_width)
+        self._labels_landmarks = int(labels_landmarks)
+        # snapshot id last counted as a label invalidation (overlay
+        # mutated the interior subgraph) — one count per transition
+        self._label_blocked_snap: Optional[int] = None
         self._mesh = mesh
         self._shard_rows = shard_rows
         self._multiprocess = mesh is not None and jax.process_count() > 1
@@ -665,6 +762,9 @@ class TpuCheckEngine:
         # to refresh_retry_max_wait_s before the pass counts as failed
         self._refresh_retry_max_wait_s = refresh_retry_max_wait_s
         self._refresh_force_full = False
+        # close() flips this; long cooperative loops (warm_compile) check
+        # it between kernels so teardown never races an in-flight compile
+        self._closing = False
         self._refresh_task = SupervisedTask(
             "refresh", self._refresh_pass, stats=self.maintenance
         )
@@ -869,7 +969,9 @@ class TpuCheckEngine:
 
     def close(self) -> None:
         """Stop the supervised maintenance workers (daemon threads — this
-        is shutdown hygiene, not a liveness requirement)."""
+        is shutdown hygiene, not a liveness requirement) and abort any
+        cooperative warmup loop."""
+        self._closing = True
         self._refresh_task.stop()
         self._cache_task.stop()
 
@@ -1075,6 +1177,7 @@ class TpuCheckEngine:
                 columns=cols_fn(wm) if cols_fn is not None else None,
             )
             self._upload_buckets(new)
+            self._ensure_labels(new)
             self._last_full_build_s = time.monotonic() - t0
             self.maintenance.incr("full_rebuilds")
             self.maintenance.observe_ms(
@@ -1174,6 +1277,16 @@ class TpuCheckEngine:
                 for bi in got.touched_buckets:
                     bufs[bi] = self._put_bucket(new.buckets[bi].nbrs, new.num_int)
                 new.device_buckets = tuple(bufs)
+        # label index maintenance: compaction patched incrementally,
+        # kept the index, or left it for a rebuild here (folded ELL
+        # deletions / patch budget) — either way the compacted snapshot
+        # serves with labels matching its interior subgraph exactly
+        if got.labels == "patched":
+            self.maintenance.incr("label_patches")
+            self.maintenance.observe_ms("label_patch", new.labels.build_ms)
+        elif got.labels == "rebuild":
+            self.maintenance.incr("label_rebuilds")
+        self._ensure_labels(new)
         ms = (time.monotonic() - t0) * 1e3
         self.maintenance.incr("compactions")
         self.maintenance.observe_ms("compaction", ms)
@@ -1214,6 +1327,9 @@ class TpuCheckEngine:
         if snap.wild_ns_ids != wild_now:
             return None  # namespace config changed — expansion differs
         self._upload_buckets(snap)
+        if snap.labels is not None and not self._labels_enabled:
+            snap.labels = None  # cached labels ignored when disabled
+        self._ensure_labels(snap)
         self._snapshot = snap
         ms = (time.monotonic() - t0) * 1e3
         self.maintenance.incr("cache_loads")
@@ -1347,6 +1463,131 @@ class TpuCheckEngine:
                 jax.device_put(nbrs, self._bucket_sharding),
                 jax.device_put(dst_pad, self._ov_dst_sharding),
             )
+
+    # -- 2-hop labels (keto_tpu/graph/labels.py) -----------------------------
+
+    #: landmark auto-cap: with ``labels_landmarks == 0`` the engine
+    #: processes min(num_int, this) nodes — full coverage on every graph
+    #: the depth tax actually hurts, bounded build time on huge shallow
+    #: ones (coverage misses just fall back to BFS, bit-identically)
+    LABELS_AUTO_CAP = 131072
+
+    def _ensure_labels(self, snap: GraphSnapshot) -> None:
+        """Build (or rebuild) the label index for ``snap`` when enabled
+        and missing, and place it on device. Called wherever a fresh
+        base layout appears: full rebuild, cache load without labels,
+        compaction that couldn't patch."""
+        if not self._labels_enabled:
+            return
+        if snap.labels is None:
+            from keto_tpu.graph.labels import build_labels
+
+            landmarks = self._labels_landmarks
+            if landmarks == 0:
+                landmarks = min(snap.num_int, self.LABELS_AUTO_CAP)
+            snap.labels = build_labels(
+                snap, max_width=self._labels_max_width, landmarks=landmarks
+            )
+            self.maintenance.incr("label_builds")
+            self.maintenance.observe_ms("label_build", snap.labels.build_ms)
+        idx = snap.labels
+        self.maintenance.set_gauge("label_coverage", round(idx.coverage, 4))
+        self.maintenance.set_gauge("label_entries", idx.n_entries)
+        if snap.device_labels is None:
+            self._upload_labels(snap)
+
+    def _upload_labels(self, snap: GraphSnapshot) -> None:
+        idx = snap.labels
+        if idx is None:
+            snap.device_labels = None
+            return
+        out_lab = np.ascontiguousarray(idx.out_lab)
+        in_lab = np.ascontiguousarray(idx.in_lab)
+        if self._mesh is None:
+            snap.device_labels = (
+                jax.device_put(out_lab), jax.device_put(in_lab)
+            )
+        else:
+            # labels replicate: the rows are narrow (≤ max_width) and the
+            # intersection kernel never touches the sharded bitmaps
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(self._mesh, P())
+            snap.device_labels = (
+                jax.device_put(out_lab, repl), jax.device_put(in_lab, repl)
+            )
+
+    def _labels_usable(self, snap: GraphSnapshot) -> bool:
+        """Route checks through the label index on this snapshot? False
+        while a pending overlay has mutated the interior (ELL) subgraph
+        — counted ONCE per blocked overlay generation as a
+        ``label_invalidations`` maintenance event."""
+        if not self._labels_enabled or snap.labels is None:
+            return False
+        if snap.lab_dirty:
+            if self._label_blocked_snap != snap.snapshot_id:
+                self._label_blocked_snap = snap.snapshot_id
+                self.maintenance.incr("label_invalidations")
+                self.maintenance.set_gauge(
+                    "label_dirty_nodes", len(snap.lab_dirty)
+                )
+            return False
+        self.maintenance.set_gauge("label_dirty_nodes", 0)
+        return snap.device_labels is not None
+
+    def warm_compile(self) -> int:
+        """Ahead-of-time compile of the full slice-width ladder (BFS and
+        label kernels) against the current snapshot's geometry, so the
+        first real slice of every width hits the jit cache — and, with a
+        persistent compilation cache configured (serve.compile_cache_dir),
+        so the multi-second compile cost is paid once per binary instead
+        of once per boot. Returns the number of kernels warmed."""
+        snap = self.snapshot()
+        if snap.n_nodes == 0 or snap.n_edges == 0:
+            return 0
+        ni = snap.num_int
+        warmed = 0
+        for B in self.stream_widths(snap):
+            if self._closing:
+                break  # teardown must never race an in-flight compile
+            # the empty-batch geometry: every entry array at its minimum
+            # pad (B), every row a dropped/padded sentinel — the same
+            # static shapes a real B-query slice produces
+            e_rows = np.full(B, ni + 1, np.int32)
+            e_q = np.zeros(B, np.int32)
+            a_rows = np.full(B, ni, np.int32)
+            targets = np.full(B, ni, np.int32)
+            buf, sizes = pack_entries(
+                (e_rows, e_q, e_rows, e_q, a_rows, e_q, targets)
+            )
+            ov = snap.device_overlay
+            _check_kernel(
+                snap.device_buckets,
+                jnp.asarray(buf),
+                ov_nbrs=None if ov is None else ov[0],
+                ov_dst=None if ov is None else ov[1],
+                sizes=sizes,
+                n_active=snap.num_active,
+                n_int=ni,
+                valid_rows=tuple(b.n for b in snap.buckets),
+                it_cap=self._it_cap,
+                block_iters=self._block_iters,
+                bitmap_sharding=self._bitmap_sharding
+                if self._mesh is not None and (B // 32) % self._mesh.shape.get("data", 1) == 0
+                else (self._bitmap_sharding_rows_only if self._mesh is not None else None),
+            ).block_until_ready()
+            warmed += 1
+            if self._labels_enabled and snap.device_labels is not None:
+                pairs = np.concatenate(
+                    [np.full(B, ni, np.int32), np.full(B, ni, np.int32),
+                     np.zeros(B, np.int32)]
+                )
+                _label_kernel(
+                    snap.device_labels[0], snap.device_labels[1],
+                    jnp.asarray(pairs), n_pairs=B, B=B,
+                ).block_until_ready()
+                warmed += 1
+        return warmed
 
     # -- resolution ----------------------------------------------------------
 
@@ -1871,6 +2112,10 @@ class TpuCheckEngine:
             nonlocal max_iters, t_prev_ready
             _seq, off, dev, host_ans, nq, chunk, t_disp = rec
             out, iters, truncated = self._unpack_slice(dev, host_ans, nq)
+            if dev is not None and not (
+                isinstance(dev, _HybridSlice) and dev.bfs_dev is None
+            ):
+                self.bfs_steps_stats.observe(float(iters))
             if truncated:
                 out, redo_iters = self._run_exact(
                     snap, chunk, it_cap=min(
@@ -2032,11 +2277,17 @@ class TpuCheckEngine:
                     i1 = max(i0 + 1, min(i1, nq))
                     bounds.append((i0, i1))
                     i0 = i1
+            use_labels = self._labels_usable(snap)
             for a, b in bounds:
                 # sub-chunks keep the slice width: queries pad, geometry stays
-                dev, host_ans = self._device_batch(
-                    snap, sd, tg, multi, a, b, W, it_cap=it_cap
-                )
+                if use_labels:
+                    dev, host_ans = self._device_batch_labeled(
+                        snap, sd, tg, multi, a, b, W, it_cap=it_cap
+                    )
+                else:
+                    dev, host_ans = self._device_batch(
+                        snap, sd, tg, multi, a, b, W, it_cap=it_cap
+                    )
                 yield [dev, host_ans, b - a, tuples[s0 + a : s0 + b]]
 
     @staticmethod
@@ -2050,19 +2301,60 @@ class TpuCheckEngine:
         bits = ((f[:W, None] >> lanes) & 1).astype(bool).ravel()[:nq]
         return bits | host_ans[:nq], int(f[W]), bool(f[W + 1])
 
+    @staticmethod
+    def _decode_label_bits(f: Optional[np.ndarray], nq: int) -> np.ndarray:
+        """Label kernel output ``uint32[W]`` → bool[nq] (None → zeros)."""
+        if f is None:
+            return np.zeros(nq, bool)
+        lanes = np.arange(32, dtype=np.uint32)
+        return ((f[:, None] >> lanes) & 1).astype(bool).ravel()[:nq]
+
+    @classmethod
+    def _decode_hybrid(cls, lab, bfs, bfs_pos, host_ans, nq):
+        """Decode one label-routed slice from fetched arrays: label bits
+        for the whole slice, BFS sub-batch bits scattered onto their
+        positions. Only the BFS part can truncate."""
+        out = cls._decode_label_bits(lab, nq)
+        iters, trunc = 0, False
+        if bfs is not None:
+            bits2, iters, trunc = cls._decode_packed(
+                bfs, host_ans[bfs_pos], bfs_pos.size
+            )
+            out[bfs_pos] = bits2
+        return out | host_ans[:nq], iters, trunc
+
     @classmethod
     def _unpack_slice(cls, dev, host_ans, nq):
         """One slice's decisions. Returns ``(bool[nq], iters, truncated)``."""
         if dev is None:
             return host_ans[:nq], 0, False
+        if isinstance(dev, _HybridSlice):
+            lab = (
+                jax.device_get(dev.label_dev)
+                if dev.label_dev is not None
+                else None
+            )
+            bfs = (
+                jax.device_get(dev.bfs_dev)
+                if dev.bfs_dev is not None
+                else None
+            )
+            return cls._decode_hybrid(lab, bfs, dev.bfs_pos, host_ans, nq)
         return cls._decode_packed(jax.device_get(dev), host_ans, nq)
 
     def _collect(self, results, n: int):
         """Fetch every dispatched slice in ONE device transfer and unpack.
         Returns ``(decisions, max_iters, truncated query indices)`` —
         queries in a truncated slice carry NO decision the caller may use
-        (``_run_exact`` re-runs them)."""
-        devs = [r[0] for r in results if r[0] is not None]
+        (``_run_exact`` re-runs them). Hybrid (label-routed) slices
+        contribute their label output and BFS sub-batch to the same
+        single transfer."""
+        devs: list = []
+        for r in results:
+            d = r[0]
+            if d is None:
+                continue
+            devs.extend(d.parts() if isinstance(d, _HybridSlice) else [d])
         flat = None
         if devs:
             cat = jnp.concatenate(devs) if len(devs) > 1 else devs[0]
@@ -2073,16 +2365,32 @@ class TpuCheckEngine:
         trunc_idx: list[int] = []
         pos = 0
         off = 0
+
+        def take(part):
+            nonlocal off
+            seg = flat[off : off + part.shape[0]]
+            off += part.shape[0]
+            return seg
+
         for dev, host_ans, nq, _ in results:
             if dev is None:
                 out[pos : pos + nq] = host_ans[:nq]
-            else:
-                size = dev.shape[0]
-                bits, it, tr = self._decode_packed(
-                    flat[off : off + size], host_ans, nq
+            elif isinstance(dev, _HybridSlice):
+                lab = take(dev.label_dev) if dev.label_dev is not None else None
+                bfs = take(dev.bfs_dev) if dev.bfs_dev is not None else None
+                bits, it, tr = self._decode_hybrid(
+                    lab, bfs, dev.bfs_pos, host_ans, nq
                 )
-                off += size
                 out[pos : pos + nq] = bits
+                if bfs is not None:
+                    self.bfs_steps_stats.observe(float(it))
+                max_iters = max(max_iters, it)
+                if tr:
+                    trunc_idx.extend(range(pos, pos + nq))
+            else:
+                bits, it, tr = self._decode_packed(take(dev), host_ans, nq)
+                out[pos : pos + nq] = bits
+                self.bfs_steps_stats.observe(float(it))
                 max_iters = max(max_iters, it)
                 if tr:
                     trunc_idx.extend(range(pos, pos + nq))
@@ -2098,6 +2406,171 @@ class TpuCheckEngine:
         want = min(32, _ceil_pow2(max_iters + 1))
         if want > self._block_iters:
             self._block_iters = want
+
+    #: per-query pair-fanout cap on the label path: a query spawning more
+    #: pairs than this (huge sink in-degree × wildcardish seed sets)
+    #: costs more as intersections than as one more BFS rider
+    _LABEL_PAIR_CAP = 64
+
+    def _device_batch_labeled(
+        self,
+        snap: GraphSnapshot,
+        sd: np.ndarray,
+        tg: np.ndarray,
+        multi: dict,
+        i0: int,
+        i1: int,
+        W: int,
+        it_cap: Optional[int] = None,
+    ):
+        """The label fast path for one sub-chunk: resolve the chunk with
+        the SAME host machinery as the BFS path (``pack_chunk`` — host
+        walk, sink gathers, host-decided grants), then answer every
+        label-certifiable query with ONE intersection kernel step and
+        ride the rest on a compacted BFS sub-batch, bit-identically.
+
+        The reach0 mapping (see keto_tpu/graph/labels.py):
+
+        - a query's **pairs** are (seed row u) × (target-side row r):
+          the interior target itself, or a sink target's interior
+          in-neighbor gathers (``a_rows`` — exactly what the BFS kernel
+          gathers from the fixpoint);
+        - an e1 seed equal to an interior target would conflate reach0
+          with the "via ≥ 1 edge" rule — that query falls back (the
+          kernel's R0-vs-pull distinction, which labels don't carry);
+          an e2 seed equal to the target was reached via a real edge on
+          the host walk, so ``host_ans`` already granted it and the pair
+          drops;
+        - wildcard/multi-start queries, uncertifiable pairs (coverage
+          gaps), and over-fanout queries fall back.
+        """
+        packed, host_ans = pack_chunk(snap, sd, tg, multi, i0, i1, W)
+        nq = i1 - i0
+        if packed is None:
+            return None, host_ans  # nothing reaches any device path
+        (e1r, e1q, e2r, e2q, ar, aq, targets) = packed
+        ni = snap.num_int
+        B = 32 * W
+        idx = snap.labels
+        tq = np.asarray(targets[:nq], np.int64)
+        t_int = tq < ni
+
+        fallback = np.zeros(nq, bool)
+        for i in multi:
+            if i0 <= i < i1:
+                fallback[i - i0] = True
+
+        # valid (non-padding) entries; e1/e2 pad with row ni+1, a with ni
+        m1 = (e1r != ni + 1) & (e1q < nq)
+        m2 = (e2r != ni + 1) & (e2q < nq)
+        ma = (ar != ni) & (aq < nq)
+        s_rows = np.concatenate([e1r[m1], e2r[m2]]).astype(np.int64)
+        s_q = np.concatenate([e1q[m1], e2q[m2]]).astype(np.int64)
+        # e1 seed == interior target: reach0 would count the 0-edge path
+        e1_rows_v = e1r[m1].astype(np.int64)
+        e1_q_v = e1q[m1].astype(np.int64)
+        self_hit = t_int[e1_q_v] & (e1_rows_v == tq[e1_q_v])
+        if self_hit.any():
+            fallback[e1_q_v[self_hit]] = True
+
+        # target-side rows per query: the interior target, or the sink
+        # answer-gather rows
+        b_rows = np.concatenate(
+            [tq[t_int], ar[ma].astype(np.int64)]
+        )
+        b_q = np.concatenate([np.nonzero(t_int)[0], aq[ma].astype(np.int64)])
+
+        # group both sides by query, then cross-join per query
+        so = np.argsort(s_q, kind="stable")
+        s_rows, s_q = s_rows[so], s_q[so]
+        bo = np.argsort(b_q, kind="stable")
+        b_rows, b_q = b_rows[bo], b_q[bo]
+        ns = np.bincount(s_q, minlength=nq)
+        nr = np.bincount(b_q, minlength=nq)
+        n_pairs_q = ns * nr
+        over = n_pairs_q > self._LABEL_PAIR_CAP
+        if over.any():
+            fallback[over] = True
+        # drop both sides of fallback queries before the join
+        keep_s = ~fallback[s_q]
+        keep_b = ~fallback[b_q]
+        s_rows, s_q = s_rows[keep_s], s_q[keep_s]
+        b_rows, b_q = b_rows[keep_b], b_q[keep_b]
+        ns = np.bincount(s_q, minlength=nq) if s_q.size else np.zeros(nq, np.int64)
+        nr = np.bincount(b_q, minlength=nq) if b_q.size else np.zeros(nq, np.int64)
+
+        rep_nr = np.repeat(nr, ns)  # aligned to s_rows
+        total = int(rep_nr.sum())
+        if total:
+            b_starts = np.cumsum(nr) - nr
+            seed_q = s_q
+            base = np.repeat(b_starts[seed_q], rep_nr)
+            csum = np.cumsum(rep_nr) - rep_nr
+            within = np.arange(total) - np.repeat(csum, rep_nr)
+            pa = np.repeat(s_rows, rep_nr)
+            pb = b_rows[base + within]
+            pq = np.repeat(seed_q, rep_nr)
+            # e2-seed == target pairs: already host-granted, reach0 would
+            # double-count the 0-edge path — drop (e1 cases fell back)
+            drop = t_int[pq] & (pa == pb)
+            if drop.any():
+                pa, pb, pq = pa[~drop], pb[~drop], pq[~drop]
+            # coverage: a miss on an uncertifiable pair is not a deny
+            cert = idx.certifiable(pa, pb)
+            if not cert.all():
+                bad = np.unique(pq[~cert])
+                fallback[bad] = True
+                keep = ~fallback[pq]
+                pa, pb, pq = pa[keep], pb[keep], pq[keep]
+        else:
+            pa = pb = pq = np.zeros(0, np.int64)
+
+        n_fb = int(np.count_nonzero(fallback))
+        self.maintenance.incr("label_checks", by=nq - n_fb)
+        if n_fb:
+            self.maintenance.incr("label_fallbacks", by=n_fb)
+
+        ldev = None
+        if pa.size:
+            faults.check("device-exec")
+            P = _entry_pad(B, pa.size)
+            pad = P - pa.size
+            entries = np.concatenate(
+                [
+                    np.concatenate([pa, np.full(pad, ni, np.int64)]),
+                    np.concatenate([pb, np.full(pad, ni, np.int64)]),
+                    np.concatenate([pq, np.zeros(pad, np.int64)]),
+                ]
+            ).astype(np.int32)
+            if self._multiprocess:
+                from jax.sharding import NamedSharding, PartitionSpec as P_
+
+                ebuf = jax.device_put(
+                    entries, NamedSharding(self._mesh, P_())
+                )
+            else:
+                ebuf = jnp.asarray(entries)
+            dl = snap.device_labels
+            ldev = _label_kernel(dl[0], dl[1], ebuf, n_pairs=P, B=B)
+
+        bfs_dev = None
+        bfs_pos = None
+        if n_fb:
+            pos = np.nonzero(fallback)[0]
+            gidx = pos + i0
+            sd2 = sd[gidx]
+            tg2 = tg[gidx]
+            multi2 = {
+                j: multi[int(i)] for j, i in enumerate(gidx) if int(i) in multi
+            }
+            W2 = next(w for w in _WORD_WIDTHS if 32 * w >= pos.size)
+            bfs_dev, _ = self._device_batch(
+                snap, sd2, tg2, multi2, 0, pos.size, W2, it_cap=it_cap
+            )
+            bfs_pos = pos
+        if ldev is None and bfs_dev is None:
+            return None, host_ans
+        return _HybridSlice(ldev, bfs_dev, bfs_pos), host_ans
 
     def _device_batch(
         self,

@@ -115,6 +115,26 @@ CONFIG_SCHEMA = {
                     "default": 86400.0,
                     "description": "Idempotent writes: how long (seconds) an X-Idempotency-Key / x-idempotency-key binding dedups retries of the same transaction. Within the TTL a retried key re-applies nothing and replays the original snaptoken (X-Keto-Idempotent-Replay: true); past it the key is garbage-collected from the durable dedup table and a resend applies as a fresh write. Size it to your clients' worst-case retry horizon.",
                 },
+                "labels_enabled": {
+                    "type": "boolean",
+                    "default": True,
+                    "description": "2-hop reachability labels: build a pruned-landmark label index over the interior graph at snapshot-build time and serve label-certifiable checks with one O(1)-step intersection kernel instead of the depth-paying BFS loop. Checks the labels cannot certify (wildcards, overlay-dirtied interior edges, coverage gaps, self-queries) fall back to BFS bit-identically. false skips construction entirely.",
+                },
+                "labels_max_width": {
+                    "type": "integer",
+                    "default": 64,
+                    "description": "Per-row width cap of the 2-hop label arrays (entries per node per direction). A row hitting the cap is marked uncovered — checks through it fall back to BFS — so the cap bounds device memory without ever changing a decision. Raise on hub-heavy graphs whose labels overflow (watch keto_label_coverage_ratio).",
+                },
+                "labels_landmarks": {
+                    "type": "integer",
+                    "default": 0,
+                    "description": "How many degree-ranked interior nodes to process as 2-hop landmarks. 0 = auto (all interior rows up to a 131072 cap — full coverage on every graph the depth tax hurts, bounded build time on huge shallow ones). Fewer landmarks shrink label build time and coverage; uncovered pairs fall back to BFS, never to a wrong answer.",
+                },
+                "compile_cache_dir": {
+                    "type": "string",
+                    "default": "",
+                    "description": "Persistent XLA compilation cache directory (jax compilation_cache_dir). When set, compiled kernels survive process restarts — and boot warms the full slice-width ladder (BFS + label kernels) ahead of traffic, so the multi-second warmup/compile cost is paid once per binary instead of once per boot. Empty disables both.",
+                },
                 "drain_timeout_s": {
                     "type": "number",
                     "default": 5.0,

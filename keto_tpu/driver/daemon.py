@@ -20,7 +20,7 @@ import signal
 import threading
 import time
 from dataclasses import dataclass
-from typing import TYPE_CHECKING, Any
+from typing import TYPE_CHECKING, Any, Optional
 
 from keto_tpu.servers.grpc_api import build_grpc_server
 from keto_tpu.servers.native_mux import make_port_mux
@@ -64,6 +64,8 @@ class Daemon:
         # set by a shutdown signal (or shutdown_soon()); serve_all's
         # blocking loop waits on it and then drains
         self._stop_requested = threading.Event()
+        # boot warmup worker (_warm_snapshot); shutdown joins it briefly
+        self._warm_thread: Optional[threading.Thread] = None
 
     def _start_role(self, role: str, host: str, port: int) -> _RoleServers:
         rest = make_rest_server(self.registry, role, host="127.0.0.1", port=0)
@@ -215,9 +217,23 @@ class Daemon:
         if not hasattr(engine, "snapshot"):
             return
 
+        warm_widths = bool(
+            self.registry.config().get("serve.compile_cache_dir", "")
+        ) and hasattr(engine, "warm_compile")
+
         def run():
             try:
                 engine.snapshot()
+                if warm_widths:
+                    # ahead-of-time compile of the full slice-width
+                    # ladder (BFS + label kernels): with the persistent
+                    # compilation cache configured, the first boot pays
+                    # the compiles once per binary and every later boot
+                    # replays them from disk before traffic arrives
+                    n = engine.warm_compile()
+                    self.registry.logger().info(
+                        "width-ladder warmup compiled/loaded %d kernels", n
+                    )
             except Exception:
                 stats = getattr(engine, "maintenance", None)
                 if stats is not None:
@@ -227,7 +243,10 @@ class Daemon:
                     exc_info=True,
                 )
 
-        threading.Thread(target=run, name="keto-tpu-snapshot-warm", daemon=True).start()
+        self._warm_thread = threading.Thread(
+            target=run, name="keto-tpu-snapshot-warm", daemon=True
+        )
+        self._warm_thread.start()
 
     @property
     def read_port(self) -> int:
@@ -249,3 +268,11 @@ class Daemon:
             role.grpc_server.stop(grace=2)
         self._roles.clear()
         self.registry.close()
+        # the warm thread checks the engine's closing flag between
+        # kernels; a bounded join here keeps interpreter teardown from
+        # racing an in-flight XLA compile (observed as a segfault at
+        # exit when a quick boot-shutdown cycle interrupted the
+        # width-ladder warmup)
+        warm = self._warm_thread
+        if warm is not None and warm.is_alive():
+            warm.join(timeout=30.0)

@@ -112,6 +112,24 @@ class Registry:
             backend = self._config.get("engine.backend", "auto")
             store = self.relation_tuple_manager()
             if backend != "oracle" and hasattr(store, "snapshot_rows"):
+                # persistent XLA compilation cache: compiled kernel
+                # geometries survive restarts, so the boot warmup
+                # (Daemon._warm_snapshot → engine.warm_compile) hits disk
+                # instead of recompiling the whole width ladder
+                cc_dir = str(self._config.get("serve.compile_cache_dir", "") or "")
+                if cc_dir:
+                    try:
+                        import jax
+
+                        jax.config.update("jax_compilation_cache_dir", cc_dir)
+                        jax.config.update(
+                            "jax_persistent_cache_min_compile_time_secs", 0.0
+                        )
+                    except Exception:
+                        self.logger().warning(
+                            "persistent compilation cache unavailable; "
+                            "continuing without it", exc_info=True,
+                        )
                 from keto_tpu.check.tpu_engine import TpuCheckEngine
 
                 engine = TpuCheckEngine(
@@ -134,6 +152,15 @@ class Registry:
                     ),
                     degraded_probe_s=float(
                         self._config.get("serve.degraded_probe_s", 5.0)
+                    ),
+                    labels_enabled=bool(
+                        self._config.get("serve.labels_enabled", True)
+                    ),
+                    labels_max_width=int(
+                        self._config.get("serve.labels_max_width", 64)
+                    ),
+                    labels_landmarks=int(
+                        self._config.get("serve.labels_landmarks", 0)
                     ),
                 )
                 # mirror per-slice service times into /metrics — the same
@@ -408,6 +435,35 @@ class Registry:
             "keto_maintenance_runs_total", "counter",
             "Completed maintenance operations, by op.",
             maintenance_durations("count", 1.0), ("op",),
+        )
+
+        def label_paths():
+            counters, _, _ = maintenance_raw()
+            return [
+                (("label",), float(counters.get("label_checks", 0))),
+                (("fallback",), float(counters.get("label_fallbacks", 0))),
+            ]
+
+        m.register_callback(
+            "keto_label_checks_total", "counter",
+            "Check queries answered by the 2-hop label fast path (path="
+            "label) vs routed to the BFS kernel while labels were live "
+            "(path=fallback: wildcards, coverage gaps, self-queries).",
+            label_paths, ("path",),
+        )
+
+        def label_coverage():
+            _, gauges, _ = maintenance_raw()
+            v = gauges.get("label_coverage", 0.0)
+            yield (), float(v) if isinstance(v, (int, float)) else 0.0
+
+        m.register_callback(
+            "keto_label_coverage_ratio", "gauge",
+            "Fraction of interior rows the 2-hop label index can certify "
+            "on both sides (processed landmark, untruncated labels) — "
+            "label build/patch/invalidation events ride "
+            "keto_maintenance_events_total.",
+            label_coverage,
         )
 
         def overlay_gauge(key):

@@ -64,6 +64,12 @@ class CompactionResult:
     #: indices into ``snapshot.buckets`` whose host arrays changed (the
     #: engine re-uploads exactly these; untouched device buckets reuse)
     touched_buckets: list = field(default_factory=list)
+    #: what happened to the 2-hop label index (keto_tpu/graph/labels.py):
+    #: "none" (no index on the input), "kept" (interior subgraph
+    #: unchanged — index reused as-is), "patched" (folded ELL inserts
+    #: applied incrementally), or "rebuild" (folded ELL deletions, or
+    #: the incremental patch ran past its budget — the engine rebuilds)
+    labels: str = "none"
 
 
 def _subject_order_key(snap: GraphSnapshot, dev: int):
@@ -379,4 +385,41 @@ def compact_snapshot(
         for bi in touched:
             bufs[bi] = None
         new_snap.device_buckets = tuple(bufs)
-    return CompactionResult(snapshot=new_snap, touched_buckets=sorted(touched))
+
+    # --- 2-hop labels: patch for folded ELL inserts, rebuild on deletes -----
+    # (keto_tpu/graph/labels.py). The fold clears lab_dirty by
+    # construction: the compacted snapshot either carries an index that
+    # exactly matches its interior subgraph, or no index at all.
+    labels_state = "none"
+    idx = snap.labels
+    if idx is not None:
+        removed_ell = False
+        if removed is not None and removed.size:
+            keys = removed[(removed >> 32) < ni]
+            removed_ell = bool(keys.size) and bool(
+                np.any((keys & np.int64(0xFFFFFFFF)) < na)
+            )
+        if removed_ell:
+            # deleting from a 2-hop cover is a rebuild in the literature
+            # too — leave labels off; the engine rebuilds off-path
+            labels_state = "rebuild"
+        elif ov_ell is not None and ov_ell.shape[0]:
+            from keto_tpu.graph.labels import patch_labels
+
+            patched = patch_labels(
+                idx, new_snap, [tuple(e) for e in ov_ell.tolist()]
+            )
+            if patched is not None:
+                new_snap.labels = patched
+                labels_state = "patched"
+            else:
+                labels_state = "rebuild"  # budget/truncation — be safe
+        else:
+            # interior subgraph untouched (sink splices, host-walk edges,
+            # host-masked tombstones only): the index is still exact
+            new_snap.labels = idx
+            new_snap.device_labels = snap.device_labels
+            labels_state = "kept"
+    return CompactionResult(
+        snapshot=new_snap, touched_buckets=sorted(touched), labels=labels_state
+    )

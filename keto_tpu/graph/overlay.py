@@ -133,6 +133,15 @@ def apply_delta(
     )
     ell_patch: list[tuple[int, int, int, int]] = []
     nxt = base.ov_next or nb
+    # label invalidation (keto_tpu/graph/labels.py): any mutation of the
+    # iterated interior subgraph — an inserted overlay-ELL edge, a
+    # tombstoned or restored base ELL edge — invalidates the 2-hop label
+    # entries through its endpoints; the engine disables the label fast
+    # path while this set is non-empty (compaction patches labels and
+    # clears it). Monotone across stacked deltas on purpose: a restore
+    # returns the graph to base, but proving label parity for the
+    # intermediate states is not worth the bookkeeping.
+    lab_dirty: set[int] = set(base.lab_dirty or ())
 
     # overlay node classes: "static" = out-edges only, "sink" = in-edges only
     ov_class: dict[int, str] = dict(base.ov_class or {})
@@ -288,6 +297,7 @@ def apply_delta(
                     if slot is None:
                         return None  # base layout disagrees — be safe
                     ell_patch.append(slot + (src,))
+                    lab_dirty.update((src, dst))
             continue
         if nl <= dst < nb:
             return None  # base static node gains an in-edge
@@ -307,6 +317,7 @@ def apply_delta(
                     # updates it, so a new in-edge from a bitmap source
                     # needs a relayout
                 ell.append((src, dst))
+                lab_dirty.update((src, dst))
             elif ni <= dst < sb:
                 return None  # peeled row gains a device-dependent in-edge:
                 # its init-constant property breaks — relayout
@@ -372,6 +383,7 @@ def apply_delta(
             # num_int is the bitmap's all-zero row: the gather contributes
             # nothing, exactly like bucket padding
             ell_patch.append(slot + (ni,))
+            lab_dirty.update((lhs_dev, sub_dev))
         elif lhs_dev < ni and not (sb <= sub_dev < nl):
             # interior source into anything but a sink has no host-side
             # mask to hide behind — only the two handled classes exist in
@@ -417,6 +429,7 @@ def apply_delta(
         ov_ell=ell_arr,
         ov_removed=removed_arr,
         ell_patch=ell_patch or None,
+        lab_dirty=lab_dirty or None,
         device_overlay=None,  # engine re-uploads (cheap: overlay is small)
         _pattern_cache={},
         _cache_lock=__import__("threading").Lock(),

@@ -51,8 +51,10 @@ from keto_tpu.graph.snapshot import Bucket, GraphSnapshot
 #: bump when the on-disk layout or the snapshot's array semantics change —
 #: the version is part of the directory key, so old caches are simply
 #: never matched (and pruned as newer saves land). v2: per-segment
-#: checksums in meta.json + fsync-before-rename durability.
-FORMAT_VERSION = 2
+#: checksums in meta.json + fsync-before-rename durability. v3: 2-hop
+#: reachability label arrays (keto_tpu/graph/labels.py) ride along, so a
+#: cold start skips label construction too.
+FORMAT_VERSION = 3
 
 #: caches kept per directory (newest watermarks win)
 KEEP = 2
@@ -313,6 +315,24 @@ def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
         sv("set_order", order.astype(np.int64))
         sv("set_nsobj", (key_ns[order] << 32) | key_obj[order])
         sv("set_rel", key_rel[order])
+        # 2-hop label arrays (overlay-free snapshots only reach a save, so
+        # a present index is exactly the base graph's): the segment
+        # manifest below covers them like every other array, and a
+        # corrupted label segment quarantines the whole cache
+        lab_meta = None
+        idx = snap.labels
+        if idx is not None:
+            sv("lab_out", idx.out_lab)
+            sv("lab_in", idx.in_lab)
+            sv("lab_processed", idx.processed.astype(np.uint8))
+            sv("lab_out_ok", idx.out_ok.astype(np.uint8))
+            sv("lab_in_ok", idx.in_ok.astype(np.uint8))
+            lab_meta = {
+                "n": int(idx.n),
+                "max_width": int(idx.max_width),
+                "n_landmarks": int(idx.n_landmarks),
+                "n_entries": int(idx.n_entries),
+            }
         for kind, strings in (
             ("obj", _obj_strings(interned, n_obj)),
             ("rel", _rel_strings(interned, n_rel)),
@@ -349,6 +369,7 @@ def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
             "buckets": [{"offset": int(b.offset), "n": int(b.n)} for b in snap.buckets],
             "n_obj": int(n_obj),
             "n_rel": int(n_rel),
+            "labels": lab_meta,
             "segments": segments,
         }
         (tmp / "meta.json").write_text(json.dumps(meta))
@@ -474,6 +495,22 @@ def load_snapshot(path: str, verify: bool = True) -> GraphSnapshot:
         Bucket(offset=int(b["offset"]), n=int(b["n"]), nbrs=mm(f"bucket_{i}.npy"))
         for i, b in enumerate(meta["buckets"])
     ]
+    labels = None
+    lm = meta.get("labels")
+    if lm is not None:
+        from keto_tpu.graph.labels import LabelIndex
+
+        labels = LabelIndex(
+            n=int(lm["n"]),
+            out_lab=mm("lab_out.npy"),
+            in_lab=mm("lab_in.npy"),
+            processed=np.asarray(mm("lab_processed.npy")).astype(bool),
+            out_ok=np.asarray(mm("lab_out_ok.npy")).astype(bool),
+            in_ok=np.asarray(mm("lab_in_ok.npy")).astype(bool),
+            max_width=int(lm["max_width"]),
+            n_landmarks=int(lm["n_landmarks"]),
+            n_entries=int(lm.get("n_entries", 0)),
+        )
     return GraphSnapshot(
         snapshot_id=int(meta["watermark"]),
         num_sets=int(meta["num_sets"]),
@@ -490,6 +527,7 @@ def load_snapshot(path: str, verify: bool = True) -> GraphSnapshot:
         fwd_indices=mm("fwd_indices.npy"),
         sink_indptr=mm("sink_indptr.npy"),
         sink_indices=mm("sink_indices.npy"),
+        labels=labels,
     )
 
 

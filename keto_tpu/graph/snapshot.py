@@ -163,6 +163,19 @@ class GraphSnapshot:
     #: to the base's device_buckets; the engine applies + clears them
     ell_patch: Optional[list] = None
     device_overlay: Any = None  # (ov_nbrs, ov_dst) jnp arrays or None
+
+    # -- 2-hop reachability labels (keto_tpu/graph/labels.py) ----------------
+    #: pruned-landmark label index over interior rows, built at snapshot
+    #: build time; None when disabled or not yet built
+    labels: Any = None
+    #: interior device ids whose label entries the pending overlay
+    #: invalidated (endpoints of inserted/tombstoned ELL edges). While
+    #: non-empty the engine routes every check to the BFS kernel; every
+    #: other overlay class (new sinks, sink in-edges, host-walk
+    #: adjacency, host-masked tombstones) leaves the interior subgraph —
+    #: the labels' whole universe — untouched, so labels stay exact.
+    lab_dirty: Optional[set] = None
+    device_labels: Any = None  # (out_lab, in_lab) jnp arrays, engine-set
     _pattern_cache: dict = field(default_factory=dict)
     _cache_lock: threading.Lock = field(default_factory=threading.Lock)
 
@@ -184,6 +197,13 @@ class GraphSnapshot:
             or self.ov_ell is not None
             or (self.ov_removed is not None and self.ov_removed.size > 0)
         )
+
+    @property
+    def labels_usable(self) -> bool:
+        """True when the 2-hop label index may serve checks on this
+        snapshot: an index exists and no pending overlay mutation touched
+        the interior (ELL) subgraph it indexes."""
+        return self.labels is not None and not self.lab_dirty
 
     @property
     def has_wildcards(self) -> bool:

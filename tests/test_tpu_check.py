@@ -344,7 +344,9 @@ def test_it_cap_truncation_rerun_exact(make_persister):
     (the reference is always exact via its visited set)."""
     p = _deep_chain_store(make_persister)
     oracle = CheckEngine(p)
-    engine = TpuCheckEngine(p, p.namespaces, it_cap=1)
+    # labels off: the 2-hop fast path answers deep chains in one step,
+    # and this test exists to exercise the BFS truncation retry ladder
+    engine = TpuCheckEngine(p, p.namespaces, it_cap=1, labels_enabled=False)
     rungs = []
     orig = engine._run_exact
     engine._run_exact = lambda s, t, it_cap=None: (
@@ -365,7 +367,7 @@ def test_it_cap_truncation_rerun_exact(make_persister):
 def test_it_cap_truncation_rerun_exact_stream(make_persister):
     p = _deep_chain_store(make_persister)
     oracle = CheckEngine(p)
-    engine = TpuCheckEngine(p, p.namespaces, it_cap=1)
+    engine = TpuCheckEngine(p, p.namespaces, it_cap=1, labels_enabled=False)
     queries = [
         T("d", "doc", "view", SubjectID("user")),
         T("d", "doc", "view", SubjectID("ghost")),
