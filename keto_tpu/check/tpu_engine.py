@@ -44,8 +44,10 @@ Decision parity with the reference engine:
 
 from __future__ import annotations
 
+import collections
 import itertools
 import logging
+import random
 import threading
 import time
 from functools import partial
@@ -57,6 +59,7 @@ import numpy as np
 from jax import lax
 
 from keto_tpu import namespace as namespace_pkg
+from keto_tpu.driver.hbm import HbmGovernor, MemoryPressure, is_resource_exhausted
 from keto_tpu.graph.snapshot import WILDCARD, GraphSnapshot, build_snapshot
 from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
 from keto_tpu.x import faults
@@ -671,6 +674,8 @@ class TpuCheckEngine:
         labels_enabled: bool = True,
         labels_max_width: int = 64,
         labels_landmarks: int = 0,
+        hbm_budget_bytes: int = 0,
+        audit_sample_rate: float = 0.0,
     ):
         if it_cap < 1:
             raise ValueError("it_cap must be >= 1 (the answer pull needs one step)")
@@ -793,6 +798,48 @@ class TpuCheckEngine:
         # apply synchronously; they are milliseconds)
         self._sync_rebuild_budget_s = sync_rebuild_budget_s
         self._last_full_build_s = 0.0
+        # HBM budget governor (keto_tpu/driver/hbm.py): a ledger of every
+        # device allocation this engine makes, plan-before-upload against
+        # serve.hbm_budget_bytes, and the graceful eviction ladder —
+        # labels → warm compile-width ladder → overlay budget → refuse
+        # the refresh and serve stale. Lockstep meshes get deterministic
+        # mode: auto-budget probing and reactive (OOM-triggered) eviction
+        # are per-host signals and must never diverge the ladder.
+        self.hbm = HbmGovernor(
+            budget_bytes=int(hbm_budget_bytes),
+            stats=self.maintenance,
+            deterministic=self._multiprocess,
+        )
+        self.hbm.attach_rungs([
+            ("labels", self._evict_labels, self._restore_labels),
+            ("warm-ladder", self._evict_warm_ladder, self._restore_warm_ladder),
+            ("overlay-budget", self._evict_overlay_budget,
+             self._restore_overlay_budget),
+        ])
+        # ladder state the rungs flip (all derive from replicated inputs)
+        self._labels_suspended = False
+        self._width_trim = 0
+        self._configured_overlay_budget = self._max_overlay_edges
+        self._memory_pressure = False
+        self._last_label_bytes = 0
+        self._last_warm_bytes = 0
+        # sampled shadow-parity auditor: serve.audit_sample_rate of live
+        # check decisions re-verify against the CPU reference oracle in a
+        # supervised background worker — continuous proof that eviction
+        # rungs (and everything else) never change answers. Divergence
+        # counts audit_mismatches and flips health to DEGRADED.
+        self._audit_rate = max(0.0, float(audit_sample_rate))
+        self._audit_rng = random.Random(0xA0D17)
+        self._audit_pending: collections.deque = collections.deque(maxlen=4096)
+        self._audit_checks = 0
+        self._audit_mismatches = 0
+        self._audit_task = SupervisedTask(
+            "audit", self._audit_pass, stats=self.maintenance
+        )
+        # True while the supervised refresh worker owns the pass — the
+        # seam where ladder restores and deferred label rebuilds run
+        # without adding work to inline (serving-thread) refreshes
+        self._in_maintenance_pass = False
 
     # -- snapshot lifecycle --------------------------------------------------
 
@@ -959,12 +1006,23 @@ class TpuCheckEngine:
         return {
             "has_snapshot": self._snapshot is not None,
             "staleness_s": self.staleness_s(),
-            "maintenance_alive": rt.alive() and self._cache_task.alive(),
+            "maintenance_alive": (
+                rt.alive() and self._cache_task.alive() and self._audit_task.alive()
+            ),
             "refresh_failures": rt.crashes,
             "refresh_consecutive_failures": rt.consecutive_failures,
             "refresh_last_error": rt.last_error,
             "degraded": self._degraded,
             "consecutive_device_errors": self._consec_device_errors,
+            # HBM budget governor (keto_tpu/driver/hbm.py): refusing a
+            # refresh for memory reports DEGRADED(memory_pressure)
+            "memory_pressure": self._memory_pressure,
+            "hbm_resident_bytes": self.hbm.resident_bytes(),
+            "hbm_budget_bytes": self.hbm.budget_bytes,
+            "hbm_rung": self.hbm.rung_depth,
+            # shadow-parity auditor: any divergence flips DEGRADED
+            "audit_checks": self._audit_checks,
+            "audit_mismatches": self._audit_mismatches,
         }
 
     def close(self) -> None:
@@ -974,6 +1032,195 @@ class TpuCheckEngine:
         self._closing = True
         self._refresh_task.stop()
         self._cache_task.stop()
+        self._audit_task.stop()
+
+    # -- HBM budget governor (keto_tpu/driver/hbm.py) ------------------------
+
+    def _plan_or_refuse(self, what: str, need: int) -> None:
+        """Plan ``need`` device bytes before an upload. The governor walks
+        the eviction ladder until it fits; with every rung spent the
+        refresh is REFUSED — unless there is no snapshot at all (cold
+        boot: nothing to serve stale from, so the upload proceeds over
+        budget and is merely accounted)."""
+        if self.hbm.plan(need, what=what):
+            return
+        if self._snapshot is None:
+            self.hbm.note_forced(what, need)
+            return
+        self.hbm.note_refused()
+        self._memory_pressure = True
+        self.maintenance.set_gauge("memory_pressure", 1)
+        raise MemoryPressure(
+            f"HBM budget refused {what}: need {need} bytes with "
+            f"{self.hbm.resident_bytes()} resident of "
+            f"{self.hbm.budget_bytes} budgeted and every eviction rung "
+            "spent — serving the current snapshot stale"
+        )
+
+    def _guard_alloc(self, what: str, fn):
+        """Run one device-put / compiled-call seam with OOM containment:
+        a classified RESOURCE_EXHAUSTED (real XLA, or the injected
+        ``device-alloc`` oom fault) evicts one ladder rung and retries
+        ONCE, then escalates to the caller — check paths land on the
+        existing bit-identical CPU fallback, refresh paths count a
+        supervised failure and serve stale. Never a crash."""
+
+        def attempt():
+            faults.check("device-alloc")
+            return fn()
+
+        try:
+            return attempt()
+        except Exception as e:
+            if self._multiprocess or not is_resource_exhausted(e):
+                raise
+            self.hbm.note_oom(what)
+            setattr(e, "_keto_oom_handled", True)
+            rung = self.hbm.evict_one(reason=f"oom at {what}")
+            if rung is None:
+                raise
+            _log.warning(
+                "device OOM at %s: evicted rung %r, retrying once", what, rung
+            )
+            try:
+                out = attempt()
+            except Exception as e2:
+                if is_resource_exhausted(e2):
+                    setattr(e2, "_keto_oom_handled", True)
+                raise
+            self.hbm.note_oom_recovered()
+            return out
+
+    def _restore_plan_bytes(self) -> int:
+        """Bytes a full walk back up the ladder would re-place on device
+        — the ``planned`` margin ``maybe_restore`` holds against, so the
+        ladder doesn't oscillate (restore labels → over budget → evict
+        labels → ...)."""
+        est = 0
+        if self._labels_suspended:
+            est += self._last_label_bytes
+        if self._width_trim:
+            est += self._last_warm_bytes
+        return est
+
+    def _evict_labels(self) -> int:
+        """Rung 1 — drop the 2-hop label arrays: coverage loss only (the
+        router falls back to BFS bit-identically), and typically the
+        largest discretionary resident family."""
+        self._labels_suspended = True
+        freed = self.hbm.release("labels")
+        self._last_label_bytes = max(self._last_label_bytes, freed)
+        snap = self._snapshot
+        if snap is not None:
+            snap.device_labels = None
+            snap.labels = None
+        self.maintenance.set_gauge("label_coverage", 0.0)
+        self.maintenance.set_gauge("label_entries", 0)
+        return freed
+
+    def _restore_labels(self) -> None:
+        self._labels_suspended = False
+        # the next refresh pass rebuilds + re-uploads via _ensure_labels
+        self._kick_background_refresh()
+
+    def _evict_warm_ladder(self) -> int:
+        """Rung 2 — trim the compile-width ladder to its lower rungs and
+        drop the warm-compiled executables: wide-slice throughput falls,
+        decisions do not change (the same kernels at narrower widths)."""
+        self._width_trim = max(self._width_trim, len(_WORD_WIDTHS) - 4)
+        freed = self.hbm.release("warmup")
+        self._last_warm_bytes = max(self._last_warm_bytes, freed)
+        for kern in (_check_kernel, _label_kernel):
+            clear = getattr(kern, "clear_cache", None)
+            if clear is not None:
+                try:
+                    clear()
+                except Exception:
+                    # trimming still bounds future widths even when this
+                    # jax build can't drop already-compiled executables
+                    _log.warning(
+                        "compiled-kernel cache clear failed during "
+                        "warm-ladder eviction", exc_info=True,
+                    )
+        return freed
+
+    def _restore_warm_ladder(self) -> None:
+        self._width_trim = 0
+
+    def _evict_overlay_budget(self) -> int:
+        """Rung 3 — shrink the overlay edge budget so pending deltas fold
+        into the base layout (compaction retires the overlay's device
+        arrays and keeps future overlays small)."""
+        self._max_overlay_edges = max(64, self._configured_overlay_budget // 8)
+        self.maintenance.set_gauge("overlay_budget", self._max_overlay_edges)
+        snap = self._snapshot
+        freed = 0
+        if snap is not None and snap.has_overlay:
+            from keto_tpu.graph.overlay import overlay_device_bytes
+
+            freed = overlay_device_bytes(snap)  # retired when the fold lands
+            self._kick_background_refresh(force_full=True)
+        return freed
+
+    def _restore_overlay_budget(self) -> None:
+        self._max_overlay_edges = self._configured_overlay_budget
+        self.maintenance.set_gauge("overlay_budget", self._max_overlay_edges)
+
+    def _word_widths(self) -> tuple[int, ...]:
+        """The compile-width ladder currently in service: the full
+        ``_WORD_WIDTHS`` normally, its lower rungs while the governor's
+        warm-ladder rung is evicted (never fewer than two widths)."""
+        n = len(_WORD_WIDTHS) - self._width_trim
+        return _WORD_WIDTHS[: max(2, n)]
+
+    # -- sampled shadow-parity auditor ---------------------------------------
+
+    def _audit_sample(self, tuples, decisions, token: Optional[int]) -> None:
+        """Queue a random ``audit_sample_rate`` sample of live decisions
+        for re-verification against the CPU reference oracle (supervised
+        background worker — never on the serving path)."""
+        if self._audit_rate <= 0.0 or token is None:
+            return
+        rng = self._audit_rng
+        rate = self._audit_rate
+        picked = False
+        for i, rt in enumerate(tuples):
+            if rng.random() < rate:
+                self._audit_pending.append((rt, bool(decisions[i]), token))
+                picked = True
+        if picked:
+            self._audit_task.kick()
+
+    def _audit_pass(self) -> None:
+        """One supervised audit pass: drain the sample queue, re-check
+        each decision on the CPU oracle. Samples whose snaptoken no
+        longer matches the store watermark are skipped (the oracle reads
+        the live store — comparing across a write would fabricate
+        divergence). A real mismatch is the one alarm that must never be
+        rationalized away: count it and flip DEGRADED via health()."""
+        while True:
+            try:
+                rt, decision, token = self._audit_pending.popleft()
+            except IndexError:
+                return
+            try:
+                wm = self._store.watermark()
+            except Exception:
+                continue  # store unreadable: the health machine owns that
+            if wm != token:
+                self.maintenance.incr("audit_skipped_stale")
+                continue
+            got = self._fallback().subject_is_allowed(rt)
+            self._audit_checks += 1
+            self.maintenance.incr("audit_checks")
+            if got != decision:
+                self._audit_mismatches += 1
+                self.maintenance.incr("audit_mismatches")
+                _log.error(
+                    "shadow-parity audit MISMATCH: %r decided %s on device, "
+                    "%s on the CPU oracle (snaptoken %d) — flipping DEGRADED",
+                    rt, decision, got, token,
+                )
 
     # -- degraded mode (CPU fallback) ----------------------------------------
 
@@ -986,6 +1233,17 @@ class TpuCheckEngine:
         return time.monotonic() < self._probe_after
 
     def _note_device_error(self, exc: BaseException) -> None:
+        # a RESOURCE_EXHAUSTED that escaped the _guard_alloc seams (e.g.
+        # raised at transfer/collect time) still counts as memory
+        # pressure and descends one rung before the CPU fallback serves
+        # the batch — the ladder, not just the fallback, is the answer
+        if (
+            not self._multiprocess
+            and is_resource_exhausted(exc)
+            and not getattr(exc, "_keto_oom_handled", False)
+        ):
+            self.hbm.note_oom("check-path")
+            self.hbm.evict_one(reason="oom on the check path")
         self.maintenance.incr("device_errors")
         self._consec_device_errors += 1
         self._probe_after = time.monotonic() + self._degraded_probe_s
@@ -1108,6 +1366,7 @@ class TpuCheckEngine:
     def _refresh_pass(self) -> None:
         """One supervised refresh pass (the SupervisedTask target)."""
         force_full, self._refresh_force_full = self._refresh_force_full, False
+        self._in_maintenance_pass = True
         try:
             with self._lock:
                 self._refresh_locked(force_full=force_full)
@@ -1116,6 +1375,8 @@ class TpuCheckEngine:
                 # the failed pass still owes a compaction — retry as one
                 self._refresh_force_full = True
             raise
+        finally:
+            self._in_maintenance_pass = False
 
     def _refresh_locked(
         self, force_full: bool = False, delta_only: bool = False
@@ -1136,6 +1397,14 @@ class TpuCheckEngine:
             force_full and snap.has_overlay
         ):
             self._behind_since = None
+            if self._in_maintenance_pass and not delta_only:
+                # an already-current engine has no install step, so the
+                # supervised pass is where the eviction ladder walks back
+                # up once pressure clears — and where labels dropped by
+                # the ladder get rebuilt after their rung restores
+                self.hbm.maybe_restore(planned=self._restore_plan_bytes())
+                if not snap.has_overlay and snap.labels is None:
+                    self._ensure_labels(snap)
             return snap
         wild_ns_ids = frozenset(
             n.id for n in self._nm().namespaces() if n.name == ""
@@ -1190,6 +1459,14 @@ class TpuCheckEngine:
         # current even if the store moved again meanwhile (the next pass
         # is kicked by whoever observes the new gap)
         self._behind_since = None
+        # the refresh landed within budget: memory pressure (if any) has
+        # cleared, and the governor may walk back UP the eviction ladder
+        # — holding the restore against what the restored rungs would
+        # re-place so the ladder cannot oscillate at the budget edge
+        if self._memory_pressure:
+            self._memory_pressure = False
+            self.maintenance.set_gauge("memory_pressure", 0)
+        self.hbm.maybe_restore(planned=self._restore_plan_bytes())
         if new.has_overlay:
             if self._overlay_born is None:
                 self._overlay_born = time.monotonic()
@@ -1273,10 +1550,18 @@ class TpuCheckEngine:
             if new.device_buckets is None:
                 self._upload_buckets(new)
             else:
+                # old + new copies of every touched bucket are co-resident
+                # while in-flight batches still gather the old ones: plan
+                # the re-upload like any other swap before placing it
+                self._plan_or_refuse("compaction bucket re-upload", got.touched_bytes)
                 bufs = list(new.device_buckets)
                 for bi in got.touched_buckets:
-                    bufs[bi] = self._put_bucket(new.buckets[bi].nbrs, new.num_int)
+                    bufs[bi] = self._guard_alloc(
+                        "compaction-upload",
+                        lambda b=new.buckets[bi]: self._put_bucket(b.nbrs, new.num_int),
+                    )
                 new.device_buckets = tuple(bufs)
+                self.hbm.register("snapshot", new.bucket_device_bytes())
         # label index maintenance: compaction patched incrementally,
         # kept the index, or left it for a rebuild here (folded ELL
         # deletions / patch budget) — either way the compacted snapshot
@@ -1402,10 +1687,17 @@ class TpuCheckEngine:
             rows = np.asarray([e[0] for e in entries], np.int32)
             cols = np.asarray([e[1] for e in entries], np.int32)
             vals = np.asarray([e[2] for e in entries], np.int32)
-            out = bufs[bi].at[rows, cols].set(jnp.asarray(vals))
-            if self._mesh is not None:
-                out = jax.device_put(out, self._bucket_sharding)
-            bufs[bi] = out
+
+            def patch(buf=bufs[bi], rows=rows, cols=cols, vals=vals):
+                # functional update: old + new bucket transiently
+                # co-resident — an OOM here evicts a rung and retries
+                # through the device-alloc seam like every other site
+                out = buf.at[rows, cols].set(jnp.asarray(vals))
+                if self._mesh is not None:
+                    out = jax.device_put(out, self._bucket_sharding)
+                return out
+
+            bufs[bi] = self._guard_alloc("ell-patch", patch)
         snap.device_buckets = tuple(bufs)
 
     def _put_bucket(self, nbrs: np.ndarray, num_int: int):
@@ -1426,9 +1718,19 @@ class TpuCheckEngine:
         return jax.device_put(np.ascontiguousarray(nbrs), self._bucket_sharding)
 
     def _upload_buckets(self, snap: GraphSnapshot) -> None:
-        snap.device_buckets = tuple(
-            self._put_bucket(b.nbrs, snap.num_int) for b in snap.buckets
+        # plan BEFORE uploading: during a swap the old snapshot's buckets
+        # are still resident (in-flight batches gather them), so the plan
+        # runs against live residency; the governor walks the eviction
+        # ladder when over, and only a spent ladder refuses the refresh
+        need = snap.bucket_device_bytes()
+        self._plan_or_refuse("snapshot buckets", need)
+        snap.device_buckets = self._guard_alloc(
+            "snapshot-upload",
+            lambda: tuple(
+                self._put_bucket(b.nbrs, snap.num_int) for b in snap.buckets
+            ),
         )
+        self.hbm.register("snapshot", need)
 
     def _upload_overlay(self, snap: GraphSnapshot) -> None:
         """Group overlay-ELL edges by destination into a [K, C] gather
@@ -1436,7 +1738,12 @@ class TpuCheckEngine:
         geometries) and place it on device."""
         if snap.ov_ell is None or snap.ov_ell.shape[0] == 0:
             snap.device_overlay = None
+            self.hbm.register("overlay", 0)
             return
+        from keto_tpu.graph.overlay import overlay_device_bytes
+
+        need = overlay_device_bytes(snap)
+        self._plan_or_refuse("overlay ELL", need)
         src = snap.ov_ell[:, 0]
         dst = snap.ov_ell[:, 1]
         order = np.argsort(dst, kind="stable")
@@ -1457,12 +1764,19 @@ class TpuCheckEngine:
         dst_pad = np.full(K, snap.num_active, np.int32)  # scatter-dropped
         dst_pad[: uniq.shape[0]] = uniq
         if self._mesh is None:
-            snap.device_overlay = (jax.device_put(nbrs), jax.device_put(dst_pad))
-        else:
-            snap.device_overlay = (
-                jax.device_put(nbrs, self._bucket_sharding),
-                jax.device_put(dst_pad, self._ov_dst_sharding),
+            snap.device_overlay = self._guard_alloc(
+                "overlay-upload",
+                lambda: (jax.device_put(nbrs), jax.device_put(dst_pad)),
             )
+        else:
+            snap.device_overlay = self._guard_alloc(
+                "overlay-upload",
+                lambda: (
+                    jax.device_put(nbrs, self._bucket_sharding),
+                    jax.device_put(dst_pad, self._ov_dst_sharding),
+                ),
+            )
+        self.hbm.register("overlay", need)
 
     # -- 2-hop labels (keto_tpu/graph/labels.py) -----------------------------
 
@@ -1476,8 +1790,11 @@ class TpuCheckEngine:
         """Build (or rebuild) the label index for ``snap`` when enabled
         and missing, and place it on device. Called wherever a fresh
         base layout appears: full rebuild, cache load without labels,
-        compaction that couldn't patch."""
-        if not self._labels_enabled:
+        compaction that couldn't patch. Skipped entirely while the HBM
+        governor's labels rung is evicted — the index is the FIRST
+        pressure valve because dropping it costs coverage, never
+        correctness (the router falls back to BFS)."""
+        if not self._labels_enabled or self._labels_suspended:
             return
         if snap.labels is None:
             from keto_tpu.graph.labels import build_labels
@@ -1490,11 +1807,28 @@ class TpuCheckEngine:
             )
             self.maintenance.incr("label_builds")
             self.maintenance.observe_ms("label_build", snap.labels.build_ms)
+        if snap.device_labels is None:
+            # plan before uploading; a plan that evicts the labels rung
+            # itself (suspension) means the ladder chose to shed this
+            # very family — honor it and drop the fresh build
+            need = snap.labels.device_bytes()
+            self._last_label_bytes = max(self._last_label_bytes, need)
+            fits = self.hbm.plan(need, what="label arrays")
+            if not fits or self._labels_suspended or snap.labels is None:
+                snap.labels = None
+                snap.device_labels = None
+                return
+            self._upload_labels(snap)
+            if self._labels_suspended:
+                # the labels rung evicted during this upload's own OOM
+                # retry: the freshly placed arrays are already shed
+                snap.labels = None
+                snap.device_labels = None
+                self.hbm.release("labels")
+                return
         idx = snap.labels
         self.maintenance.set_gauge("label_coverage", round(idx.coverage, 4))
         self.maintenance.set_gauge("label_entries", idx.n_entries)
-        if snap.device_labels is None:
-            self._upload_labels(snap)
 
     def _upload_labels(self, snap: GraphSnapshot) -> None:
         idx = snap.labels
@@ -1504,8 +1838,9 @@ class TpuCheckEngine:
         out_lab = np.ascontiguousarray(idx.out_lab)
         in_lab = np.ascontiguousarray(idx.in_lab)
         if self._mesh is None:
-            snap.device_labels = (
-                jax.device_put(out_lab), jax.device_put(in_lab)
+            snap.device_labels = self._guard_alloc(
+                "labels-upload",
+                lambda: (jax.device_put(out_lab), jax.device_put(in_lab)),
             )
         else:
             # labels replicate: the rows are narrow (≤ max_width) and the
@@ -1513,9 +1848,13 @@ class TpuCheckEngine:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             repl = NamedSharding(self._mesh, P())
-            snap.device_labels = (
-                jax.device_put(out_lab, repl), jax.device_put(in_lab, repl)
+            snap.device_labels = self._guard_alloc(
+                "labels-upload",
+                lambda: (
+                    jax.device_put(out_lab, repl), jax.device_put(in_lab, repl)
+                ),
             )
+        self.hbm.register("labels", idx.device_bytes())
 
     def _labels_usable(self, snap: GraphSnapshot) -> bool:
         """Route checks through the label index on this snapshot? False
@@ -1535,21 +1874,37 @@ class TpuCheckEngine:
         self.maintenance.set_gauge("label_dirty_nodes", 0)
         return snap.device_labels is not None
 
+    def _warm_width_bytes(self, snap: GraphSnapshot, B: int) -> int:
+        """Device bytes one warmed width holds live while its slice runs:
+        the BFS workspace (~3 W-wide uint32 bitmaps over interior rows —
+        the same formula ``_slice_cap`` budgets with)."""
+        return (snap.num_int + 1) * 12 * (B // 32)
+
     def warm_compile(self) -> int:
-        """Ahead-of-time compile of the full slice-width ladder (BFS and
+        """Ahead-of-time compile of the slice-width ladder (BFS and
         label kernels) against the current snapshot's geometry, so the
         first real slice of every width hits the jit cache — and, with a
         persistent compilation cache configured (serve.compile_cache_dir),
         so the multi-second compile cost is paid once per binary instead
-        of once per boot. Returns the number of kernels warmed."""
+        of once per boot. Widths whose compiled-buffer footprint would
+        breach the HBM budget are SKIPPED (never evicted for — warming is
+        optional work) and counted in the ``warm_widths_skipped`` gauge /
+        ``keto_hbm_warm_widths_skipped``. Returns the number of kernels
+        warmed."""
         snap = self.snapshot()
         if snap.n_nodes == 0 or snap.n_edges == 0:
             return 0
         ni = snap.num_int
         warmed = 0
+        skipped = 0
+        warm_bytes = 0
         for B in self.stream_widths(snap):
             if self._closing:
                 break  # teardown must never race an in-flight compile
+            need = self._warm_width_bytes(snap, B)
+            if not self.hbm.plan(need - warm_bytes, what=f"warm width {B}", evict=False):
+                skipped += 1
+                continue
             # the empty-batch geometry: every entry array at its minimum
             # pad (B), every row a dropped/padded sentinel — the same
             # static shapes a real B-query slice produces
@@ -1561,32 +1916,43 @@ class TpuCheckEngine:
                 (e_rows, e_q, e_rows, e_q, a_rows, e_q, targets)
             )
             ov = snap.device_overlay
-            _check_kernel(
-                snap.device_buckets,
-                jnp.asarray(buf),
-                ov_nbrs=None if ov is None else ov[0],
-                ov_dst=None if ov is None else ov[1],
-                sizes=sizes,
-                n_active=snap.num_active,
-                n_int=ni,
-                valid_rows=tuple(b.n for b in snap.buckets),
-                it_cap=self._it_cap,
-                block_iters=self._block_iters,
-                bitmap_sharding=self._bitmap_sharding
-                if self._mesh is not None and (B // 32) % self._mesh.shape.get("data", 1) == 0
-                else (self._bitmap_sharding_rows_only if self._mesh is not None else None),
-            ).block_until_ready()
+            self._guard_alloc(
+                "warm-compile",
+                lambda: _check_kernel(
+                    snap.device_buckets,
+                    jnp.asarray(buf),
+                    ov_nbrs=None if ov is None else ov[0],
+                    ov_dst=None if ov is None else ov[1],
+                    sizes=sizes,
+                    n_active=snap.num_active,
+                    n_int=ni,
+                    valid_rows=tuple(b.n for b in snap.buckets),
+                    it_cap=self._it_cap,
+                    block_iters=self._block_iters,
+                    bitmap_sharding=self._bitmap_sharding
+                    if self._mesh is not None and (B // 32) % self._mesh.shape.get("data", 1) == 0
+                    else (self._bitmap_sharding_rows_only if self._mesh is not None else None),
+                ).block_until_ready(),
+            )
             warmed += 1
+            # one slice runs at a time: the warm family holds the WIDEST
+            # warmed width's workspace, not the sum over widths
+            warm_bytes = max(warm_bytes, need)
+            self.hbm.register("warmup", warm_bytes)
             if self._labels_enabled and snap.device_labels is not None:
                 pairs = np.concatenate(
                     [np.full(B, ni, np.int32), np.full(B, ni, np.int32),
                      np.zeros(B, np.int32)]
                 )
-                _label_kernel(
-                    snap.device_labels[0], snap.device_labels[1],
-                    jnp.asarray(pairs), n_pairs=B, B=B,
-                ).block_until_ready()
+                self._guard_alloc(
+                    "warm-compile",
+                    lambda: _label_kernel(
+                        snap.device_labels[0], snap.device_labels[1],
+                        jnp.asarray(pairs), n_pairs=B, B=B,
+                    ).block_until_ready(),
+                )
                 warmed += 1
+        self.maintenance.set_gauge("warm_widths_skipped", skipped)
         return warmed
 
     # -- resolution ----------------------------------------------------------
@@ -1940,6 +2306,7 @@ class TpuCheckEngine:
             return self._fallback_check(tuples)
         self._note_device_ok()
         self._after_batch(max_iters)
+        self._audit_sample(tuples, out, snap.snapshot_id)
         return out.tolist(), snap.snapshot_id
 
     def _cap_limit(self, snap: GraphSnapshot) -> int:
@@ -2068,7 +2435,7 @@ class TpuCheckEngine:
         this snapshot (ascending) — callers pre-warm jit geometries by
         running one batch per width."""
         cap = self._slice_cap(snap)
-        return [32 * w for w in _WORD_WIDTHS if 32 * w <= cap]
+        return [32 * w for w in self._word_widths() if 32 * w <= cap]
 
     def _stream(self, snap, tuples_iter, *, depth, slice_cap, ordered):
         depth = depth or self._dispatch_window
@@ -2133,6 +2500,7 @@ class TpuCheckEngine:
             stats.observe(ms)
             if ctrl is not None:
                 ctrl.observe(nq, ms)
+            self._audit_sample(chunk, out, snap.snapshot_id)
             return off, out
 
         src = slices()
@@ -2195,13 +2563,14 @@ class TpuCheckEngine:
         allows (~3 W-wide uint32 bitmaps over interior rows — huge graphs
         narrow the batch width before the default max_batch could overshoot
         HBM)."""
+        widths = self._word_widths()
         w_cap = next(
             (
                 w
-                for w in reversed(_WORD_WIDTHS)
+                for w in reversed(widths)
                 if (snap.num_int + 1) * 12 * w <= self._mem_budget
             ),
-            _WORD_WIDTHS[0],
+            widths[0],
         )
         return min(self._max_batch, 32 * w_cap)
 
@@ -2444,6 +2813,11 @@ class TpuCheckEngine:
         - wildcard/multi-start queries, uncertifiable pairs (coverage
           gaps), and over-fanout queries fall back.
         """
+        idx = snap.labels
+        if idx is None or snap.device_labels is None:
+            # the eviction ladder dropped the labels between routing and
+            # dispatch (concurrent OOM containment): BFS answers instead
+            return self._device_batch(snap, sd, tg, multi, i0, i1, W, it_cap=it_cap)
         packed, host_ans = pack_chunk(snap, sd, tg, multi, i0, i1, W)
         nq = i1 - i0
         if packed is None:
@@ -2451,7 +2825,6 @@ class TpuCheckEngine:
         (e1r, e1q, e2r, e2q, ar, aq, targets) = packed
         ni = snap.num_int
         B = 32 * W
-        idx = snap.labels
         tq = np.asarray(targets[:nq], np.int64)
         t_int = tq < ni
 
@@ -2551,7 +2924,10 @@ class TpuCheckEngine:
             else:
                 ebuf = jnp.asarray(entries)
             dl = snap.device_labels
-            ldev = _label_kernel(dl[0], dl[1], ebuf, n_pairs=P, B=B)
+            ldev = self._guard_alloc(
+                "label-kernel",
+                lambda: _label_kernel(dl[0], dl[1], ebuf, n_pairs=P, B=B),
+            )
 
         bfs_dev = None
         bfs_pos = None
@@ -2605,18 +2981,21 @@ class TpuCheckEngine:
         else:
             entries = jnp.asarray(buf)
         ov = snap.device_overlay
-        dev = _check_kernel(
-            snap.device_buckets,
-            entries,
-            ov_nbrs=None if ov is None else ov[0],
-            ov_dst=None if ov is None else ov[1],
-            sizes=sizes,
-            n_active=snap.num_active,
-            n_int=snap.num_int,
-            valid_rows=tuple(b.n for b in snap.buckets),
-            it_cap=it_cap or self._it_cap,
-            block_iters=self._block_iters,
-            bitmap_sharding=sharding,
+        dev = self._guard_alloc(
+            "check-kernel",
+            lambda: _check_kernel(
+                snap.device_buckets,
+                entries,
+                ov_nbrs=None if ov is None else ov[0],
+                ov_dst=None if ov is None else ov[1],
+                sizes=sizes,
+                n_active=snap.num_active,
+                n_int=snap.num_int,
+                valid_rows=tuple(b.n for b in snap.buckets),
+                it_cap=it_cap or self._it_cap,
+                block_iters=self._block_iters,
+                bitmap_sharding=sharding,
+            ),
         )
         return dev, host_ans
 

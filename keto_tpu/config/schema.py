@@ -130,6 +130,16 @@ CONFIG_SCHEMA = {
                     "default": 0,
                     "description": "How many degree-ranked interior nodes to process as 2-hop landmarks. 0 = auto (all interior rows up to a 131072 cap — full coverage on every graph the depth tax hurts, bounded build time on huge shallow ones). Fewer landmarks shrink label build time and coverage; uncovered pairs fall back to BFS, never to a wrong answer.",
                 },
+                "hbm_budget_bytes": {
+                    "type": "integer",
+                    "default": 0,
+                    "description": "Device-memory (HBM) budget in bytes for the engine's resident state (snapshot buckets, overlay ELL, 2-hop label arrays, warm-ladder workspace). Every upload is planned against the governor's ledger BEFORE it happens; over budget, a deterministic eviction ladder sheds coverage-only state (labels -> warm compile-width ladder -> overlay budget -> refuse the refresh and serve stale with DEGRADED memory_pressure) instead of dying on RESOURCE_EXHAUSTED. 0 = auto: the device's reported bytes_limit minus headroom, with a conservative fallback when the backend exposes no memory stats (e.g. CPU).",
+                },
+                "audit_sample_rate": {
+                    "type": "number",
+                    "default": 0.0,
+                    "description": "Sampled shadow-parity auditor: the fraction of live check decisions re-verified against the CPU reference oracle in a supervised background worker (0 disables). Samples whose snaptoken the store has moved past are skipped; any real divergence increments keto_audit_mismatches_total and flips health to DEGRADED — continuous proof that HBM eviction rungs (and everything else) never change answers. Costs one oracle traversal per sampled check, off the serving path.",
+                },
                 "compile_cache_dir": {
                     "type": "string",
                     "default": "",

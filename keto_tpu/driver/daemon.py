@@ -224,6 +224,18 @@ class Daemon:
         def run():
             try:
                 engine.snapshot()
+                gov = getattr(engine, "hbm", None)
+                if gov is not None:
+                    # boot-time memory picture: the budget the governor
+                    # enforces and where the first snapshot landed it —
+                    # an over-budget cold boot logs its ladder walk above
+                    snap = gov.snapshot()
+                    self.registry.logger().info(
+                        "HBM governor: %d / %d bytes resident after boot "
+                        "snapshot (eviction rung %d/%d)",
+                        snap["resident_bytes"], snap["budget_bytes"],
+                        snap["rung"], len(snap["rungs"]),
+                    )
                 if warm_widths:
                     # ahead-of-time compile of the full slice-width
                     # ladder (BFS + label kernels): with the persistent

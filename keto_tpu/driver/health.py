@@ -152,11 +152,31 @@ class HealthMonitor:
             return HealthState.NOT_SERVING, reason
         if not h.get("has_snapshot", True):
             return HealthState.STARTING, "first snapshot not built yet"
+        if int(h.get("audit_mismatches", 0) or 0) > 0:
+            # the one alarm that must never be rationalized away: a
+            # sampled live decision diverged from the CPU reference
+            # oracle (keto_audit_mismatches_total)
+            return (
+                HealthState.DEGRADED,
+                "shadow-parity audit observed device/oracle divergence "
+                f"({int(h['audit_mismatches'])} mismatches)",
+            )
         if h.get("degraded"):
             return (
                 HealthState.DEGRADED,
                 "device path failing; serving bit-identical decisions "
                 "from the CPU fallback engine",
+            )
+        if h.get("memory_pressure"):
+            # the HBM governor refused the last refresh with every
+            # eviction rung spent: answers stay correct but bounded-stale
+            # until pressure clears (staleness_budget_s still escalates
+            # to NOT_SERVING above)
+            return (
+                HealthState.DEGRADED,
+                "memory_pressure: HBM budget refused the last snapshot "
+                "refresh (eviction ladder spent); serving stale within "
+                "the staleness budget",
             )
         return HealthState.SERVING, ""
 

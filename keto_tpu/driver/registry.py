@@ -162,6 +162,12 @@ class Registry:
                     labels_landmarks=int(
                         self._config.get("serve.labels_landmarks", 0)
                     ),
+                    hbm_budget_bytes=int(
+                        self._config.get("serve.hbm_budget_bytes", 0)
+                    ),
+                    audit_sample_rate=float(
+                        self._config.get("serve.audit_sample_rate", 0.0)
+                    ),
                 )
                 # mirror per-slice service times into /metrics — the same
                 # numbers the adaptive width controller steers by
@@ -485,6 +491,124 @@ class Registry:
             "serve.overlay_edge_budget: occupancy past this triggers "
             "compaction.",
             overlay_gauge("overlay_budget"),
+        )
+
+        # HBM budget governor (keto_tpu/driver/hbm.py): the ledger, the
+        # eviction ladder, and OOM containment — read at scrape time from
+        # the governor's own snapshot so the exposed totals reconcile
+        # with the ledger by construction
+        from keto_tpu.driver.hbm import RUNGS as HBM_RUNGS
+        from keto_tpu.driver.hbm import TAGS as HBM_TAGS
+
+        def hbm_snapshot():
+            engine = self.peek("permission_engine")
+            gov = getattr(engine, "hbm", None)
+            return gov.snapshot() if gov is not None else {}
+
+        def hbm_ledger():
+            led = hbm_snapshot().get("ledger", {})
+            out = [((t,), float(led.get(t, 0))) for t in HBM_TAGS]
+            out += [
+                ((t,), float(v)) for t, v in sorted(led.items())
+                if t not in HBM_TAGS
+            ]
+            return out
+
+        m.register_callback(
+            "keto_hbm_resident_bytes", "gauge",
+            "Device bytes resident per governor ledger tag (snapshot "
+            "buckets, overlay ELL, label arrays, warm-ladder workspace); "
+            "the series sums to the governor's total ledger.",
+            hbm_ledger, ("tag",),
+        )
+
+        def hbm_scalar(key):
+            def read():
+                yield (), float(hbm_snapshot().get(key, 0) or 0)
+
+            return read
+
+        m.register_callback(
+            "keto_hbm_budget_bytes", "gauge",
+            "The enforced device-memory budget: serve.hbm_budget_bytes, "
+            "or the auto value (device bytes_limit minus headroom, with "
+            "a conservative fallback when the backend has no stats).",
+            hbm_scalar("budget_bytes"),
+        )
+        m.register_callback(
+            "keto_hbm_eviction_rung", "gauge",
+            "Current eviction-ladder depth: 0 = full service, then "
+            "labels dropped -> warm ladder trimmed -> overlay budget "
+            "shrunk; refresh refusals ride keto_hbm_refusals_total.",
+            hbm_scalar("rung"),
+        )
+
+        def hbm_evictions():
+            by = hbm_snapshot().get("evictions_by_rung", {})
+            return [((r,), float(by.get(r, 0))) for r in HBM_RUNGS]
+
+        m.register_callback(
+            "keto_hbm_evictions_total", "counter",
+            "Eviction-ladder descents, by rung (labels / warm-ladder / "
+            "overlay-budget) — planned pressure and real-OOM containment "
+            "both count here.",
+            hbm_evictions, ("rung",),
+        )
+        m.register_callback(
+            "keto_hbm_refusals_total", "counter",
+            "Snapshot refreshes refused because the plan stayed over "
+            "budget with every eviction rung spent — the engine serves "
+            "stale and reports DEGRADED(memory_pressure).",
+            hbm_scalar("refusals"),
+        )
+
+        def warm_skipped():
+            _, gauges, _ = maintenance_raw()
+            v = gauges.get("warm_widths_skipped", 0)
+            yield (), float(v) if isinstance(v, (int, float)) else 0.0
+
+        m.register_callback(
+            "keto_hbm_warm_widths_skipped", "gauge",
+            "Slice widths the boot warmup skipped because their "
+            "compiled-buffer footprint would breach the HBM budget "
+            "(warming never evicts; it just stops lower on the ladder).",
+            warm_skipped,
+        )
+        m.register_callback(
+            "keto_oom_events_total", "counter",
+            "Device allocations/compiled calls that raised a classified "
+            "RESOURCE_EXHAUSTED (real XLA or the injected device-alloc "
+            "oom fault).",
+            hbm_scalar("oom_events"),
+        )
+        m.register_callback(
+            "keto_oom_recoveries_total", "counter",
+            "OOMs contained by evicting one ladder rung and retrying "
+            "once successfully (the remainder escalate to the CPU "
+            "fallback or a supervised refresh retry — never a crash).",
+            hbm_scalar("oom_recoveries"),
+        )
+
+        # sampled shadow-parity auditor (serve.audit_sample_rate)
+        def audit_counter(key):
+            def read():
+                counters, _, _ = maintenance_raw()
+                yield (), float(counters.get(key, 0))
+
+            return read
+
+        m.register_callback(
+            "keto_audit_checks_total", "counter",
+            "Live check decisions re-verified against the CPU reference "
+            "oracle by the background shadow-parity auditor.",
+            audit_counter("audit_checks"),
+        )
+        m.register_callback(
+            "keto_audit_mismatches_total", "counter",
+            "Audited decisions that DIVERGED from the CPU oracle — any "
+            "nonzero value flips health to DEGRADED (continuous proof "
+            "that eviction rungs never change answers).",
+            audit_counter("audit_mismatches"),
         )
 
         def health_states():

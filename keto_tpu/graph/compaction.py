@@ -70,6 +70,11 @@ class CompactionResult:
     #: applied incrementally), or "rebuild" (folded ELL deletions, or
     #: the incremental patch ran past its budget — the engine rebuilds)
     labels: str = "none"
+    #: device bytes the touched-bucket re-uploads will place (the old and
+    #: new copies of a touched bucket are co-resident while in-flight
+    #: batches still gather the old one) — the HBM governor plans this
+    #: BEFORE the engine re-uploads (keto_tpu/driver/hbm.py)
+    touched_bytes: int = 0
 
 
 def _subject_order_key(snap: GraphSnapshot, dev: int):
@@ -421,5 +426,8 @@ def compact_snapshot(
             new_snap.device_labels = snap.device_labels
             labels_state = "kept"
     return CompactionResult(
-        snapshot=new_snap, touched_buckets=sorted(touched), labels=labels_state
+        snapshot=new_snap,
+        touched_buckets=sorted(touched),
+        labels=labels_state,
+        touched_bytes=sum(int(w.nbytes) for w in touched.values()),
     )

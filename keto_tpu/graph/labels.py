@@ -139,6 +139,12 @@ class LabelIndex:
             np.count_nonzero(self.processed & self.out_ok & self.in_ok) / self.n
         )
 
+    def device_bytes(self) -> int:
+        """Device footprint of the uploaded label arrays — what the HBM
+        governor (keto_tpu/driver/hbm.py) plans and registers under the
+        ``labels`` ledger tag before the engine uploads them."""
+        return int(self.out_lab.nbytes + self.in_lab.nbytes)
+
     def certifiable(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
         """bool[len(a)] — True where a MISS on pair (a[i], b[i]) is a
         sound deny (see module docstring). Rows == n (the padding row)

@@ -434,3 +434,22 @@ def apply_delta(
         _pattern_cache={},
         _cache_lock=__import__("threading").Lock(),
     )
+
+
+def _ceil_pow2(x: int) -> int:
+    return 1 if x <= 1 else 1 << (int(x) - 1).bit_length()
+
+
+def overlay_device_bytes(snap: GraphSnapshot) -> int:
+    """Device footprint the engine's overlay upload will place: the
+    pow2-padded [K, C] gather matrix plus its destination vector, sized
+    exactly the way ``TpuCheckEngine._upload_overlay`` lays them out —
+    the number the HBM governor (keto_tpu/driver/hbm.py) plans against
+    BEFORE the ``jax.device_put``."""
+    if snap.ov_ell is None or snap.ov_ell.shape[0] == 0:
+        return 0
+    dst = snap.ov_ell[:, 1]
+    uniq, counts = np.unique(dst, return_counts=True)
+    K = _ceil_pow2(uniq.shape[0])
+    C = _ceil_pow2(int(counts.max()))
+    return K * C * 4 + K * 4

@@ -556,7 +556,14 @@ def load_latest(
         candidates.append((wm, d))
     for _, d in sorted(candidates, reverse=True):
         try:
-            return load_snapshot(str(d))
+            snap = load_snapshot(str(d))
+            # the cold-start upload the HBM governor is about to plan
+            # (keto_tpu/driver/hbm.py): surface its size at load time.
+            # Counter-only stats sinks simply skip the gauge.
+            set_gauge = getattr(stats, "set_gauge", None)
+            if set_gauge is not None:
+                set_gauge("cache_loaded_bytes", snap.bucket_device_bytes())
+            return snap
         except CacheCorrupt:
             _quarantine(d, stats=stats)  # rejected; rebuild path takes over
         except Exception:

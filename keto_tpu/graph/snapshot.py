@@ -205,6 +205,14 @@ class GraphSnapshot:
         the interior (ELL) subgraph it indexes."""
         return self.labels is not None and not self.lab_dirty
 
+    def bucket_device_bytes(self) -> int:
+        """Device footprint of the bucket matrices as uploaded — what the
+        HBM governor (keto_tpu/driver/hbm.py) plans and registers under
+        the ``snapshot`` ledger tag BEFORE ``jax.device_put`` runs (mesh
+        row padding adds at most one graph-axis stripe per bucket and is
+        ignored here)."""
+        return sum(int(np.asarray(b.nbrs).nbytes) for b in self.buckets)
+
     @property
     def has_wildcards(self) -> bool:
         """True when any set node is wildcard-bearing — fixed per

@@ -8,13 +8,18 @@ read when not. The canonical points:
 
 - ``refresh-read``  — persistence reads during snapshot refresh
 - ``device-exec``   — device dispatch of a check slice
+- ``device-alloc``  — every device-put / compiled-call allocation seam
+  (the HBM governor's OOM-containment sites, keto_tpu/driver/hbm.py);
+  the ``oom`` action below raises a classified RESOURCE_EXHAUSTED there
 - ``cache-save``    — background snapshot-cache serialization
 - ``compaction``    — overlay compaction
 - ``check-dispatch``— the check batcher's collector, before dispatch
 
 Arming is programmatic (``inject`` / the ``injected`` context manager,
 used by tests/test_faults.py) or environmental: ``KETO_TPU_FAULTS`` is a
-comma list of ``point:raise``, ``point:raise:<count>``, or
+comma list of ``point:raise``, ``point:raise:<count>``,
+``point:oom``/``point:oom:<count>`` (raise ``OomInjected`` — classified
+as device RESOURCE_EXHAUSTED by the HBM governor), or
 ``point:delay=<seconds>`` specs parsed at import (and re-parseable via
 ``load_env`` for tests). The hot-path contract: sites guard with the
 module-level ``ACTIVE`` flag, so an unarmed build pays a single attribute
@@ -49,6 +54,7 @@ from typing import Optional
 POINTS = (
     "refresh-read",
     "device-exec",
+    "device-alloc",
     "cache-save",
     "compaction",
     "check-dispatch",
@@ -77,6 +83,20 @@ _hits: dict[str, int] = {}
 
 class FaultInjected(RuntimeError):
     """Raised at an armed injection point."""
+
+
+class OomInjected(FaultInjected):
+    """Injected device-memory exhaustion: str() carries the
+    RESOURCE_EXHAUSTED marker the HBM governor's classifier
+    (keto_tpu/driver/hbm.py is_resource_exhausted) keys on, so the
+    ``device-alloc`` seams exercise the SAME evict-retry-escalate path a
+    real XLA allocator failure takes."""
+
+    def __init__(self, point: str = "device-alloc"):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected out-of-memory at {point!r}"
+        )
+        self.point = point
 
 
 class _Fault:
@@ -183,6 +203,7 @@ def load_env(spec: Optional[str] = None) -> None:
     armed faults. Unknown/malformed entries are ignored — a typo'd env
     var must never take a serving process down. Kinds: ``point:raise``
     (every pass), ``point:raise:<count>`` (the next count passes),
+    ``point:oom`` / ``point:oom:<count>`` (raise ``OomInjected``),
     ``point:delay=<seconds>``, ``point:kill`` (die on the first pass),
     ``point:kill:<n>`` (die on the n-th pass)."""
     spec = os.environ.get("KETO_TPU_FAULTS", "") if spec is None else spec
@@ -195,6 +216,8 @@ def load_env(spec: Optional[str] = None) -> None:
         try:
             if kind == "raise":
                 inject(point, count=int(arg) if arg else None)
+            elif kind == "oom":
+                inject(point, exc=OomInjected, count=int(arg) if arg else None)
             elif kind == "kill":
                 nth = int(arg) if arg else 1
                 if nth < 1:

@@ -1,0 +1,218 @@
+"""memory-pressure-smoke: the CI gate on the HBM budget governor.
+
+Boots a real daemon over a pre-populated sqlite store with
+``serve.hbm_budget_bytes`` pinned far below the snapshot footprint (CPU
+backend — no device memory stats, so the governor enforces the explicit
+budget) and asserts the OOM-safe lifecycle end to end:
+
+1. the daemon reaches a READY health state **via the eviction ladder**
+   (labels dropped, warm ladder trimmed, overlay budget shrunk, the base
+   snapshot force-allocated because there is nothing to serve stale
+   from) instead of dying on the over-budget boot;
+2. every REST check decision matches the CPU reference oracle — ZERO
+   wrong answers under full memory pressure;
+3. an injected RESOURCE_EXHAUSTED on the serving path (the
+   ``device-alloc`` ``oom`` fault) recovers without process exit and
+   without a wrong answer;
+4. ``keto_hbm_resident_bytes`` per-tag series on /metrics sum exactly to
+   the governor's ledger total, and the ladder/pressure families render;
+5. the sampled shadow-parity auditor (rate 1.0) re-verifies the served
+   decisions with zero mismatches;
+6. under KETO_TPU_SANITIZE=1, zero lock-order inversions and zero
+   deadlock-watchdog trips.
+
+Exit 0 when all hold; 1 with the violations listed.
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+N_CHAIN = 400  # interior chain: pushes the bucket footprint well past BUDGET
+BUDGET = 1     # bytes — decisively below any real snapshot footprint
+
+
+def build_store(dbfile: str) -> None:
+    from keto_tpu import namespace as namespace_pkg
+    from keto_tpu.persistence.sqlite import SQLitePersister
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    nm = namespace_pkg.MemoryManager([namespace_pkg.Namespace(id=0, name="docs")])
+    store = SQLitePersister(f"sqlite://{dbfile}", lambda: nm)
+    tuples = [
+        RelationTuple(
+            namespace="docs", object=f"d{i}", relation="view",
+            subject=SubjectSet("docs", f"d{(i + 1) % N_CHAIN}", "view"),
+        )
+        for i in range(N_CHAIN)
+    ]
+    tuples += [
+        RelationTuple(
+            namespace="docs", object=f"d{i}", relation="view",
+            subject=SubjectID(f"u{i % 7}"),
+        )
+        for i in range(0, N_CHAIN, 5)
+    ]
+    store.write_relation_tuples(*tuples)
+    store.close()
+
+
+def main() -> int:
+    from bench import log  # reuse the repo's stamped logger
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+    from keto_tpu.x import faults
+    from keto_tpu.x.metrics import parse_exposition
+
+    problems: list[str] = []
+    tmp = tempfile.mkdtemp(prefix="keto-mem-smoke-")
+    dbfile = str(Path(tmp) / "store.sqlite")
+    build_store(dbfile)
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "docs"}],
+            "dsn": f"sqlite://{dbfile}",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "serve.hbm_budget_bytes": BUDGET,
+            "serve.audit_sample_rate": 1.0,
+        }
+    )
+    registry = Registry(cfg)
+    daemon = Daemon(registry)
+    daemon.serve_all(block=False)
+    try:
+        base = f"http://127.0.0.1:{daemon.read_port}"
+        with urllib.request.urlopen(f"{base}/health/ready", timeout=30) as resp:
+            if resp.status != 200:
+                problems.append(f"/health/ready answered {resp.status} under pressure")
+
+        engine = registry.permission_engine()
+        gov = engine.hbm
+        # the governor must have walked the ladder at boot, not died
+        snap = gov.snapshot()
+        log(f"[mem-smoke] governor after boot: {snap}")
+        if snap["rung"] == 0:
+            problems.append("budget below footprint but no eviction rung walked")
+        if snap["evicted"][:1] != ["labels"]:
+            problems.append(f"ladder order wrong: {snap['evicted']}")
+        if snap["forced_allocs"] < 1:
+            problems.append("base snapshot was not force-allocated on cold boot")
+
+        # every decision under pressure must match the CPU oracle
+        from keto_tpu.check.engine import CheckEngine
+        from keto_tpu.relationtuple.model import RelationTuple, SubjectID
+
+        oracle = CheckEngine(registry.relation_tuple_manager())
+        wrong = 0
+        checked = 0
+
+        def rest_check(obj: str, user: str) -> bool:
+            url = (
+                f"{base}/check?namespace=docs&object={obj}"
+                f"&relation=view&subject_id={user}"
+            )
+            try:
+                with urllib.request.urlopen(url, timeout=30) as r:
+                    return r.status == 200
+            except urllib.error.HTTPError as e:
+                if e.code == 403:
+                    return False
+                raise
+
+        for i in range(0, N_CHAIN, 7):
+            for user in ("u0", "u3", "ghost"):
+                want = oracle.subject_is_allowed(
+                    RelationTuple(
+                        namespace="docs", object=f"d{i}", relation="view",
+                        subject=SubjectID(user),
+                    )
+                )
+                got = rest_check(f"d{i}", user)
+                checked += 1
+                if got != want:
+                    wrong += 1
+        log(f"[mem-smoke] {checked} checks under pressure, {wrong} wrong")
+        if wrong:
+            problems.append(f"{wrong}/{checked} decisions diverged from the CPU oracle")
+
+        # injected RESOURCE_EXHAUSTED on the serving path: recover, don't die
+        faults.inject("device-alloc", exc=faults.OomInjected, count=1)
+        if rest_check("d0", "u0") != oracle.subject_is_allowed(
+            RelationTuple(namespace="docs", object="d0", relation="view",
+                          subject=SubjectID("u0"))
+        ):
+            problems.append("wrong answer while containing an injected OOM")
+        faults.clear("device-alloc")
+        if gov.snapshot()["oom_events"] < 1:
+            problems.append("injected oom was not classified by the governor")
+
+        # give the shadow auditor a beat to drain, then check parity
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and engine.health()["audit_checks"] == 0:
+            time.sleep(0.1)
+        h = engine.health()
+        log(f"[mem-smoke] auditor: {h['audit_checks']} checks, "
+            f"{h['audit_mismatches']} mismatches")
+        if h["audit_mismatches"]:
+            problems.append(f"shadow auditor found {h['audit_mismatches']} mismatches")
+
+        # /metrics: the resident series must reconcile with the ledger
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            families = parse_exposition(resp.read().decode())
+        resident = families.get("keto_hbm_resident_bytes")
+        if resident is None:
+            problems.append("keto_hbm_resident_bytes missing from the scrape")
+        else:
+            scraped = sum(
+                value for (sname, _labels, value) in resident["samples"]
+                if sname == "keto_hbm_resident_bytes"
+            )
+            ledger_total = gov.resident_bytes()
+            if int(scraped) != int(ledger_total):
+                problems.append(
+                    f"keto_hbm_resident_bytes sums to {scraped} but the "
+                    f"governor ledger holds {ledger_total}"
+                )
+        for fam in ("keto_hbm_eviction_rung", "keto_hbm_evictions_total",
+                    "keto_oom_events_total", "keto_audit_mismatches_total"):
+            if fam not in families:
+                problems.append(f"{fam} missing from the scrape")
+
+        from keto_tpu.x import lockwatch
+
+        if lockwatch.installed():
+            problems.extend(lockwatch.violations())
+            rep = lockwatch.report()
+            log(
+                f"[mem-smoke] lockwatch: {rep['acquires']} acquires, "
+                f"{len(rep['inversions'])} inversions, "
+                f"{len(rep['watchdog_trips'])} watchdog trips"
+            )
+    finally:
+        faults.clear()
+        daemon.shutdown()
+
+    if problems:
+        print("memory-pressure-smoke FAILED:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print("memory-pressure-smoke OK: served correctly through the eviction "
+          "ladder under a 1-byte budget, contained an injected OOM, ledger "
+          "reconciled, auditor clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -52,6 +52,7 @@ CRASH_POINTS = [
     "cache-save",        # mid snapshot-cache serialization
     "refresh-read",      # mid snapshot refresh (often at boot warm)
     "compaction",        # mid overlay compaction
+    "device-alloc",      # mid device upload (the HBM governor's OOM seam)
 ]
 
 CYCLES = int(os.environ.get("KETO_CHAOS_CYCLES", len(CRASH_POINTS)))
