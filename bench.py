@@ -1502,6 +1502,167 @@ def run_overload(rng):
     return out
 
 
+def run_reverse_query(rng):
+    """Reverse-query rounds against a live daemon: ListObjects /
+    ListSubjects latency (p50/p99 measured at the REST surface) and
+    throughput in objects/s over an RBAC-shaped graph (users → groups →
+    docs), plus watch end-to-end delta latency — the wall time from a
+    write's acknowledgement to its commit group landing on an attached
+    changefeed subscriber."""
+    import threading
+    import urllib.request
+
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    n_users = int(os.environ.get("BENCH_REVERSE_USERS", 2000))
+    n_groups = int(os.environ.get("BENCH_REVERSE_GROUPS", 64))
+    n_docs = int(os.environ.get("BENCH_REVERSE_DOCS", 5000))
+    n_queries = int(os.environ.get("BENCH_REVERSE_QUERIES", 200))
+    n_watch_writes = int(os.environ.get("BENCH_REVERSE_WATCH_WRITES", 50))
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "docs"}, {"id": 1, "name": "groups"}],
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "serve.watch_poll_ms": 20,
+            "log.level": "error",
+        }
+    )
+    daemon = Daemon(Registry(cfg))
+    daemon.serve_all(block=False)
+    out = {}
+    try:
+        store = daemon.registry.relation_tuple_manager()
+        rows = [
+            RelationTuple(
+                namespace="groups", object=f"g{u % n_groups}", relation="member",
+                subject=SubjectID(f"user-{u}"),
+            )
+            for u in range(n_users)
+        ]
+        rows += [
+            RelationTuple(
+                namespace="docs", object=f"d{d}", relation="view",
+                subject=SubjectSet("groups", f"g{d % n_groups}", "member"),
+            )
+            for d in range(n_docs)
+        ]
+        store.write_relation_tuples(*rows)
+        base = f"http://127.0.0.1:{daemon.read_port}"
+
+        def fetch(url):
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                return json.loads(resp.read())
+
+        # warm: snapshot build + both orientations' kernels
+        fetch(f"{base}/relation-tuples/list-objects?namespace=docs"
+              f"&relation=view&subject_id=user-0&page_size=4096")
+        fetch(f"{base}/relation-tuples/list-subjects?namespace=docs"
+              f"&object=d0&relation=view&page_size=4096")
+
+        lo_lat, lo_items = [], 0
+        t0 = time.perf_counter()
+        for _ in range(n_queries):
+            u = rng.randrange(n_users)
+            q0 = time.perf_counter()
+            body = fetch(
+                f"{base}/relation-tuples/list-objects?namespace=docs"
+                f"&relation=view&subject_id=user-{u}&page_size=4096"
+            )
+            lo_lat.append(time.perf_counter() - q0)
+            lo_items += len(body["objects"])
+        lo_wall = time.perf_counter() - t0
+        ls_lat, ls_items = [], 0
+        t0 = time.perf_counter()
+        for _ in range(n_queries):
+            d = rng.randrange(n_docs)
+            q0 = time.perf_counter()
+            body = fetch(
+                f"{base}/relation-tuples/list-subjects?namespace=docs"
+                f"&object=d{d}&relation=view&page_size=4096"
+            )
+            ls_lat.append(time.perf_counter() - q0)
+            ls_items += len(body["subject_ids"])
+        ls_wall = time.perf_counter() - t0
+
+        # watch end-to-end delta latency: ack → delivery on a subscriber
+        from keto_tpu.httpclient import KetoClient
+
+        client = KetoClient(base, f"http://127.0.0.1:{daemon.write_port}")
+        acks: dict[int, float] = {}
+        deltas: list[float] = []
+        got = threading.Event()
+
+        def subscriber():
+            for token, _changes in client.watch(store.watermark()):
+                t_ack = acks.get(token)
+                if t_ack is not None:
+                    deltas.append(time.perf_counter() - t_ack)
+                    if len(deltas) >= n_watch_writes:
+                        got.set()
+                        return
+
+        th = threading.Thread(target=subscriber, daemon=True)
+        th.start()
+        time.sleep(0.3)
+        for i in range(n_watch_writes):
+            r = client.patch_relation_tuples(
+                insert=[
+                    RelationTuple(
+                        namespace="docs", object=f"w{i}", relation="view",
+                        subject=SubjectID(f"watcher-{i}"),
+                    )
+                ]
+            )
+            acks[r.snaptoken] = time.perf_counter()
+            time.sleep(0.01)
+        got.wait(timeout=30)
+        eng = daemon.registry.peek("list_engine")
+        out = {
+            "graph": {"users": n_users, "groups": n_groups, "docs": n_docs},
+            "list_objects": {
+                **_pctls(lo_lat),
+                "queries": n_queries,
+                "objects_per_s": round(lo_items / lo_wall, 1),
+                "avg_result_size": round(lo_items / max(1, n_queries), 1),
+            },
+            "list_subjects": {
+                **_pctls(ls_lat),
+                "queries": n_queries,
+                "subjects_per_s": round(ls_items / ls_wall, 1),
+                "avg_result_size": round(ls_items / max(1, n_queries), 1),
+            },
+            "watch": {
+                **_pctls(deltas),
+                "delivered": len(deltas),
+                "writes": n_watch_writes,
+            },
+            "paths": {
+                f"{op}/{path}": v
+                for (op, path), v in sorted(
+                    getattr(eng, "requests_total", {}).items()
+                )
+            },
+        }
+        log(
+            f"[reverse] list-objects p50={out['list_objects']['p50_ms']}ms "
+            f"p99={out['list_objects']['p99_ms']}ms "
+            f"{out['list_objects']['objects_per_s']:,} objects/s; "
+            f"list-subjects p50={out['list_subjects']['p50_ms']}ms; "
+            f"watch delta p50={out['watch']['p50_ms']}ms "
+            f"p99={out['watch']['p99_ms']}ms "
+            f"({len(deltas)}/{n_watch_writes} delivered)"
+        )
+    finally:
+        daemon.shutdown()
+    return out
+
+
 def ensure_native():
     """Build the C++ host path if the shared objects are missing — the
     interner/layout and query resolution otherwise silently fall back to
@@ -1641,6 +1802,16 @@ def main():
             if os.environ.get("BENCH_DEPTH_ASSERT", "0") == "1":
                 raise
 
+    # reverse queries: list p50/p99, objects/s, watch end-to-end delta
+    # latency (failures degrade to an error field)
+    reverse_query = None
+    if os.environ.get("BENCH_REVERSE", "1") != "0":
+        try:
+            reverse_query = run_reverse_query(random.Random(5042))
+        except Exception as e:  # pragma: no cover - diagnostic path
+            log(f"[reverse] FAILED: {e!r}")
+            reverse_query = {"error": repr(e)}
+
     # BASELINE configs 2/4/5 — failures must not lose the headline JSON line
     config2 = None
     if os.environ.get("BENCH_CONFIG2", "1") != "0":
@@ -1701,6 +1872,7 @@ def main():
                     "scrape_overhead": scrape_overhead,
                     "overload": overload,
                     "depth_sweep": depth_sweep,
+                    "reverse_query": reverse_query,
                     "config2_flat_acl": config2,
                     "config4_10m_depth8": config4,
                     "config5_50m_stream": config5,

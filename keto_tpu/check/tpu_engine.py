@@ -810,8 +810,14 @@ class TpuCheckEngine:
             stats=self.maintenance,
             deterministic=self._multiprocess,
         )
+        # the reverse-query list engine (keto_tpu/list/tpu_engine.py)
+        # registers its eviction hooks here once constructed; until then
+        # the rung is a no-op (nothing resident to drop)
+        self._reverse_evict_cb: Optional[Callable[[], int]] = None
+        self._reverse_restore_cb: Optional[Callable[[], None]] = None
         self.hbm.attach_rungs([
             ("labels", self._evict_labels, self._restore_labels),
+            ("reverse", self._evict_reverse, self._restore_reverse),
             ("warm-ladder", self._evict_warm_ladder, self._restore_warm_ladder),
             ("overlay-budget", self._evict_overlay_budget,
              self._restore_overlay_budget),
@@ -1102,6 +1108,25 @@ class TpuCheckEngine:
         if self._width_trim:
             est += self._last_warm_bytes
         return est
+
+    def attach_reverse_rung(
+        self, evict: Callable[[], int], restore: Callable[[], None]
+    ) -> None:
+        """The list engine's hooks behind the governor's ``reverse``
+        rung (eviction drops the list layouts' device arrays; reverse
+        queries fall back to the CPU-reference lister bit-identically).
+        Called once at list-engine construction."""
+        self._reverse_evict_cb = evict
+        self._reverse_restore_cb = restore
+
+    def _evict_reverse(self) -> int:
+        cb = self._reverse_evict_cb
+        return int(cb()) if cb is not None else 0
+
+    def _restore_reverse(self) -> None:
+        cb = self._reverse_restore_cb
+        if cb is not None:
+            cb()
 
     def _evict_labels(self) -> int:
         """Rung 1 — drop the 2-hop label arrays: coverage loss only (the
@@ -2004,6 +2029,11 @@ class TpuCheckEngine:
         ap = parts.append
         special: list[int] = []
         dead: list[int] = []  # guaranteed denies; placeholder results ignored
+        #: queries whose start resolves normally but whose subject can't
+        #: exist (empty-namespace subject set with no "" namespace
+        #: configured): the placeholder subject may collide with a real
+        #: node, so tg is forced unreachable after the bulk resolve
+        no_target: list[int] = []
         for i, rt in enumerate(tuples):
             ns = _ns_bytes(rt.namespace)
             if ns is None:
@@ -2026,9 +2056,23 @@ class TpuCheckEngine:
                     ap(_PLACEHOLDER)
                     continue
                 if sns is _WILD:
-                    special.append(i)  # wildcard subject namespace
-                    ap(_PLACEHOLDER)
-                    continue
+                    # subjects match LITERALLY (host-loop parity:
+                    # _subject_target) — an empty subject namespace can
+                    # only equal a stored subject in a namespace named
+                    # "", so resolve against that namespace's id rather
+                    # than routing the whole query to the pattern path
+                    # (which the host loop does NOT do when the start is
+                    # literal; the divergence was the tier-1
+                    # bulk-resolve parity failure)
+                    wild_list = list(wild_ids)
+                    if not wild_list:
+                        # no namespace named "": the target cannot exist
+                        # — resolve the start normally, force tg = -1
+                        no_target.append(i)
+                        ap(b"%b\x1f%b\x1f%b\x1f1\x1f\x1f\x1f\x1e"
+                           % (ns, obj.encode(), rel.encode()))
+                        continue
+                    sns = b"%d" % wild_list[0]
                 ap(b"%b\x1f%b\x1f%b\x1f0\x1f%b\x1f%b\x1f%b\x1e"
                    % (ns, obj.encode(), rel.encode(), sns,
                       sub.object.encode(), sub.relation.encode()))
@@ -2055,6 +2099,8 @@ class TpuCheckEngine:
             di = np.asarray(dead)
             sd[di] = -1
             tg[di] = -1
+        if no_target:
+            tg[np.asarray(no_target)] = -1
         multi: dict = {}
         if special:
             self._resolve_specials(snap, tuples, special, sd, tg, multi)

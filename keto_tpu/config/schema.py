@@ -140,6 +140,21 @@ CONFIG_SCHEMA = {
                     "default": 0.0,
                     "description": "Sampled shadow-parity auditor: the fraction of live check decisions re-verified against the CPU reference oracle in a supervised background worker (0 disables). Samples whose snaptoken the store has moved past are skipped; any real divergence increments keto_audit_mismatches_total and flips health to DEGRADED — continuous proof that HBM eviction rungs (and everything else) never change answers. Costs one oracle traversal per sampled check, off the serving path.",
                 },
+                "watch_poll_ms": {
+                    "type": "number",
+                    "default": 100.0,
+                    "description": "Watch changefeed poll period: how often an idle watch stream probes the store watermark for new commits (keto_tpu/list/watch.py). Poll-based liveness is correct across multi-process deployments sharing one SQL store — a commit from another server's write port still reaches every watcher within one period.",
+                },
+                "watch_max_streams": {
+                    "type": "integer",
+                    "default": 64,
+                    "description": "Concurrent watch streams (REST chunked + gRPC server-stream) per process; past it new subscriptions shed 429/RESOURCE_EXHAUSTED with Retry-After instead of accumulating unbounded long-lived connections.",
+                },
+                "list_cache_entries": {
+                    "type": "integer",
+                    "default": 64,
+                    "description": "Materialized reverse-query result sets kept per process (LRU, keyed by query + snapshot id): follow-up pages of one listing slice the cached sorted result instead of re-running the BFS. A snapshot advance naturally invalidates (the key changes).",
+                },
                 "compile_cache_dir": {
                     "type": "string",
                     "default": "",

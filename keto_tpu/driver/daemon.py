@@ -163,6 +163,21 @@ class Daemon:
                 exc_info=True,
             )
         deadline = time.monotonic() + drain_s
+        # watch streams are long-lived BY DESIGN: close the hub first so
+        # every changefeed generator ends at its next poll tick and the
+        # REST backends' drains below aren't held open by subscribers
+        # (clients reconnect-with-resume through their SDK, exactly-once
+        # per commit group)
+        hub = self.registry.peek("watch_hub")
+        if hub is not None:
+            try:
+                hub.close()
+            except Exception:
+                self._count_shutdown_failure("drain_watch_close_failures")
+                self.registry.logger().warning(
+                    "watch hub close failed during drain; continuing shutdown",
+                    exc_info=True,
+                )
         batcher = self.registry.peek("check_batcher")
         if batcher is not None and hasattr(batcher, "drain"):
             if not batcher.drain(drain_s):
@@ -273,6 +288,17 @@ class Daemon:
         callers (tests, signal handlers) may race a second invocation."""
         if not self._roles:
             return
+        # end watch streams first even on the non-drain path (tests,
+        # double shutdown): their generators exit at the next poll tick
+        # instead of leaving stream tasks pending at loop teardown
+        hub = self.registry.peek("watch_hub")
+        if hub is not None:
+            try:
+                hub.close()
+            except Exception:
+                self.registry.logger().debug(
+                    "watch hub close raced shutdown", exc_info=True
+                )
         for role in self._roles.values():
             role.mux.stop()
         for role in self._roles.values():

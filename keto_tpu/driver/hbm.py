@@ -69,11 +69,13 @@ FALLBACK_BUDGET_BYTES = 4 << 30
 RESTORE_FRAC = 0.7
 
 #: the canonical ledger tags, in scrape order
-TAGS = ("snapshot", "overlay", "labels", "warmup")
+TAGS = ("snapshot", "overlay", "labels", "reverse", "warmup")
 
 #: the eviction ladder rung names, in descent order (the final "refuse
-#: the refresh" step is not a rung — it is plan() returning False)
-RUNGS = ("labels", "warm-ladder", "overlay-budget")
+#: the refresh" step is not a rung — it is plan() returning False).
+#: "reverse" drops the list layouts' device arrays — reverse queries
+#: fall back to the CPU-reference lister bit-identically
+RUNGS = ("labels", "reverse", "warm-ladder", "overlay-budget")
 
 
 def device_budget_bytes(

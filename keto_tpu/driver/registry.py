@@ -205,6 +205,44 @@ class Registry:
 
         return self._memo("expand_engine", build)
 
+    def list_engine(self):
+        """The reverse-query engine (keto_tpu/list/): snapshot-backed
+        (sharing the TPU check engine's device snapshots, transposed
+        layouts, and snaptoken semantics) when the check engine is the
+        TPU one, else the Manager-backed oracle."""
+
+        def build():
+            check = self.permission_engine()
+            if hasattr(check, "snapshot"):
+                from keto_tpu.list.tpu_engine import SnapshotListEngine
+
+                return SnapshotListEngine(
+                    check,
+                    self.namespaces_source(),
+                    cache_entries=int(
+                        self._config.get("serve.list_cache_entries", 64)
+                    ),
+                )
+            from keto_tpu.list.engine import ListEngine
+
+            return ListEngine(self.relation_tuple_manager())
+
+        return self._memo("list_engine", build)
+
+    def watch_hub(self):
+        """The Watch changefeed hub (keto_tpu/list/watch.py) over the
+        tuple store's durable change log."""
+        from keto_tpu.list.watch import WatchHub
+
+        return self._memo(
+            "watch_hub",
+            lambda: WatchHub(
+                self.relation_tuple_manager(),
+                poll_s=float(self._config.get("serve.watch_poll_ms", 100.0)) / 1e3,
+                max_streams=int(self._config.get("serve.watch_max_streams", 64)),
+            ),
+        )
+
     def check_batcher(self) -> CheckBatcher:
         def build():
             engine = self.permission_engine()
@@ -611,6 +649,63 @@ class Registry:
             audit_counter("audit_mismatches"),
         )
 
+        # reverse-query subsystem (keto_tpu/list/): request counters per
+        # answering path, and the watch hub's stream/event counters
+        def list_requests():
+            eng = self.peek("list_engine")
+            totals = getattr(eng, "requests_total", {}) if eng is not None else {}
+            out = [
+                ((op, path), float(v)) for (op, path), v in sorted(totals.items())
+            ]
+            return out or [(("objects", "device"), 0.0)]
+
+        m.register_callback(
+            "keto_list_requests_total", "counter",
+            "Reverse-query requests served, by op (objects/subjects) and "
+            "answering path (device BFS, host = CPU-reference lister, "
+            "oracle = Manager-backed wildcard/pattern fallback, empty = "
+            "unresolvable query).",
+            list_requests, ("op", "path"),
+        )
+
+        def list_device_errors():
+            eng = self.peek("list_engine")
+            yield (), float(getattr(eng, "device_errors", 0) if eng is not None else 0)
+
+        m.register_callback(
+            "keto_list_device_errors_total", "counter",
+            "Device list-BFS failures that fell back to the "
+            "CPU-reference lister (answers unchanged).",
+            list_device_errors,
+        )
+
+        def watch_stat(key):
+            def read():
+                hub = self.peek("watch_hub")
+                snap = hub.snapshot() if hub is not None else {}
+                yield (), float(snap.get(key, 0))
+
+            return read
+
+        m.register_callback(
+            "keto_watch_streams", "gauge",
+            "Watch changefeed streams currently open (REST chunked + "
+            "gRPC server-stream), bounded by serve.watch_max_streams.",
+            watch_stat("active_streams"),
+        )
+        m.register_callback(
+            "keto_watch_events_total", "counter",
+            "Tuple-change events delivered to watch subscribers (inserts "
+            "+ deletes, across all streams).",
+            watch_stat("events_total"),
+        )
+        m.register_callback(
+            "keto_watch_expired_total", "counter",
+            "Watch resumes refused because the snaptoken predates the "
+            "retained change log (410 Gone / OUT_OF_RANGE).",
+            watch_stat("expired_total"),
+        )
+
         def health_states():
             from keto_tpu.driver.health import HealthState
 
@@ -701,6 +796,9 @@ class Registry:
         return VERSION
 
     def close(self) -> None:
+        hub = self._singletons.get("watch_hub")
+        if hub is not None:
+            hub.close()
         batcher = self._singletons.get("check_batcher")
         if batcher:
             batcher.stop()

@@ -384,6 +384,19 @@ def compact_snapshot(
         _pattern_cache={},
         _cache_lock=threading.Lock(),
     )
+    # reverse-query layouts (keto_tpu/list/): re-derive BOTH orientations
+    # from the folded forward CSR — the fold clears lst_dirty/lst_patch by
+    # construction (overlay edges are now base edges), and the list
+    # engine re-uploads the fresh arrays on next use
+    from keto_tpu.graph.snapshot import build_list_layouts, build_rev_csr
+
+    n_nodes_new = new_indptr.shape[0] - 1
+    new_snap.rev_indptr, new_snap.rev_indices = build_rev_csr(
+        new_indptr, new_indices, n_nodes_new
+    )
+    new_snap.lay_fwd, new_snap.lay_rev = build_list_layouts(
+        new_indptr, new_indices, n_nodes_new, new_snap.sink_base
+    )
     # reuse untouched device buckets; the engine re-uploads the touched set
     if snap.device_buckets is not None:
         bufs = list(snap.device_buckets)

@@ -133,6 +133,49 @@ def apply_delta(
     )
     ell_patch: list[tuple[int, int, int, int]] = []
     nxt = base.ov_next or nb
+    # reverse-query mirror (keto_tpu/list/): interior-class overlay edges
+    # join the list kernels' extra gather stage; base-edge tombstones /
+    # restores patch the list layouts the way ell_patch patches the check
+    # buckets. lst_patch is APPEND-ONLY across stacked deltas (the list
+    # engine applies entries past its own counter); an edge the layouts
+    # can't locate flips lst_dirty and the device list path falls back to
+    # the CPU-reference lister until compaction folds the overlay.
+    lst_edges = [tuple(e) for e in (base.lst_ov_edges or ())]
+    lst_edge_set = set(lst_edges)
+    lst_patch = list(base.lst_patch or ())
+    lst_dirty = bool(base.lst_dirty)
+
+    def lst_slot(lay, row_dev: int, val_dev: int):
+        row = int(lay.dev2row[row_dev])
+        want = np.int32(lay.dev2row[val_dev])
+        for bi, b in enumerate(lay.buckets):
+            if b.offset <= row < b.offset + b.n:
+                cols = np.nonzero(b.nbrs[row - b.offset] == want)[0]
+                if cols.size == 0:
+                    return None
+                return bi, row - b.offset, int(cols[0])
+        return None
+
+    def lst_tombstone(src: int, dst: int, restore: bool) -> None:
+        nonlocal lst_dirty
+        if base.lay_fwd is None or base.lay_rev is None:
+            lst_dirty = True
+            return
+        for lay, row_dev, val_dev in (
+            (base.lay_fwd, dst, src),
+            (base.lay_rev, src, dst),
+        ):
+            slot = lst_slot(lay, row_dev, val_dev)
+            if slot is None:
+                lst_dirty = True
+                continue
+            val = int(lay.dev2row[val_dev]) if restore else lay.n_rows
+            lst_patch.append((lay.orient, slot[0], slot[1], slot[2], val))
+
+    def lst_drop(src: int, dst: int) -> None:
+        if (src, dst) in lst_edge_set:
+            lst_edge_set.discard((src, dst))
+            lst_edges.remove((src, dst))
     # label invalidation (keto_tpu/graph/labels.py): any mutation of the
     # iterated interior subgraph — an inserted overlay-ELL edge, a
     # tombstoned or restored base ELL edge — invalidates the 2-hop label
@@ -298,6 +341,8 @@ def apply_delta(
                         return None  # base layout disagrees — be safe
                     ell_patch.append(slot + (src,))
                     lab_dirty.update((src, dst))
+                if src < sb and dst < sb:
+                    lst_tombstone(src, dst, restore=True)
             continue
         if nl <= dst < nb:
             return None  # base static node gains an in-edge
@@ -328,6 +373,12 @@ def apply_delta(
         else:
             return None  # sink source would need class change
         fwd_add(src, dst)
+        # interior-class endpoints join the list kernels' overlay stage
+        # (covers both overlay-ELL edges and peeled-source host edges —
+        # the list layouts iterate ALL interior-class rows, peel included)
+        if src < sb and dst < sb and (src, dst) not in lst_edge_set:
+            lst_edge_set.add((src, dst))
+            lst_edges.append((src, dst))
 
     # deletes: resolve each key's endpoints (no creation) and remove the
     # edge wherever it lives — overlay structures for delta-added edges,
@@ -353,6 +404,7 @@ def apply_delta(
             ell_members.discard(edge)
             dropped_ell.add(edge)
             fwd_drop(lhs_dev, sub_dev)
+            lst_drop(lhs_dev, sub_dev)
             continue
         out_arr = ov_out.get(lhs_dev)
         if out_arr is not None and bool(np.any(out_arr == sub_dev)):
@@ -362,6 +414,7 @@ def apply_delta(
             else:
                 del ov_out[lhs_dev]
             fwd_drop(lhs_dev, sub_dev)
+            lst_drop(lhs_dev, sub_dev)
             continue
         in_arr = ov_sink_in.get(sub_dev)
         if in_arr is not None and bool(np.any(in_arr == lhs_dev)):
@@ -392,6 +445,10 @@ def apply_delta(
             return None
         # peeled/static sources and interior→sink edges are masked by the
         # ov_removed filters in out_neighbors_bulk / sink_in_rows_bulk
+        if lhs_dev < sb and sub_dev < sb:
+            # interior-class on both ends: the list layouts iterate this
+            # edge on device — sentinel-patch it out of both orientations
+            lst_tombstone(lhs_dev, sub_dev, restore=False)
     if dropped_ell:
         ell = [e for e in ell if e not in dropped_ell]
 
@@ -429,6 +486,9 @@ def apply_delta(
         ov_ell=ell_arr,
         ov_removed=removed_arr,
         ell_patch=ell_patch or None,
+        lst_ov_edges=lst_edges or None,
+        lst_patch=lst_patch or None,
+        lst_dirty=lst_dirty,
         lab_dirty=lab_dirty or None,
         device_overlay=None,  # engine re-uploads (cheap: overlay is small)
         _pattern_cache={},

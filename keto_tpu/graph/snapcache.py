@@ -54,7 +54,7 @@ from keto_tpu.graph.snapshot import Bucket, GraphSnapshot
 #: checksums in meta.json + fsync-before-rename durability. v3: 2-hop
 #: reachability label arrays (keto_tpu/graph/labels.py) ride along, so a
 #: cold start skips label construction too.
-FORMAT_VERSION = 3
+FORMAT_VERSION = 4
 
 #: caches kept per directory (newest watermarks win)
 KEEP = 2
@@ -304,6 +304,12 @@ def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
         sv("fwd_indices", snap.fwd_indices)
         sv("sink_indptr", snap.sink_indptr)
         sv("sink_indices", snap.sink_indices)
+        # both reverse-query orientations persist (FORMAT_VERSION 4): the
+        # transposed CSR reloads mmap'd; the bucketed list layouts are
+        # re-derived from the forward CSR at load (cheap, deterministic)
+        if snap.rev_indptr is not None:
+            sv("rev_indptr", snap.rev_indptr)
+            sv("rev_indices", snap.rev_indices)
         for i, b in enumerate(snap.buckets):
             sv(f"bucket_{i}", b.nbrs)
         sv("key_ns", key_ns)
@@ -511,7 +517,7 @@ def load_snapshot(path: str, verify: bool = True) -> GraphSnapshot:
             n_landmarks=int(lm["n_landmarks"]),
             n_entries=int(lm.get("n_entries", 0)),
         )
-    return GraphSnapshot(
+    snap = GraphSnapshot(
         snapshot_id=int(meta["watermark"]),
         num_sets=int(meta["num_sets"]),
         num_leaves=int(meta["num_leaves"]),
@@ -529,6 +535,18 @@ def load_snapshot(path: str, verify: bool = True) -> GraphSnapshot:
         sink_indices=mm("sink_indices.npy"),
         labels=labels,
     )
+    # reverse-query orientations: the persisted transposed CSR mmaps;
+    # the bucketed list layouts re-derive from the forward CSR (shared
+    # builder — identical to a from-scratch build)
+    from keto_tpu.graph.snapshot import build_list_layouts
+
+    snap.rev_indptr = mm("rev_indptr.npy")
+    snap.rev_indices = mm("rev_indices.npy")
+    fi = np.asarray(snap.fwd_indptr)
+    snap.lay_fwd, snap.lay_rev = build_list_layouts(
+        fi, np.asarray(snap.fwd_indices), fi.shape[0] - 1, snap.sink_base
+    )
+    return snap
 
 
 def load_latest(

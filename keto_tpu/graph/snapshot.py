@@ -96,6 +96,110 @@ class Bucket:
 
 
 @dataclass
+class ListLayout:
+    """Bucketed-ELL gather layout for the reverse-query BFS
+    (keto_tpu/list/tpu_engine.py), one per orientation.
+
+    Rows cover EVERY interior-class device id ``[0, sink_base)`` —
+    unlike the check kernel's buckets there is no peel/passive split,
+    because a listing must read the reached flag of every interior node
+    rather than a handful of packed targets. Rows are renumbered so
+    buckets are contiguous (``order``/``dev2row``); bucket matrices hold
+    ROW indices (sentinel ``n_rows`` = the all-zero bitmap row), so a
+    pull step is the same gather + OR-reduce + concat the check kernel
+    runs — no scatter.
+
+    - ``orient == "fwd"``: row r gathers the interior IN-neighbors of
+      its node — forward reachability (ListSubjects) pulls "reached"
+      toward edge targets;
+    - ``orient == "rev"``: row r gathers the interior OUT-neighbors —
+      the TRANSPOSED orientation; backward reachability (ListObjects)
+      pulls "reaches the target" toward edge sources.
+    """
+
+    orient: str
+    n_rows: int  # == sink_base of the owning snapshot
+    n_active: int  # rows with ≥ 1 gathered neighbor (bucket-covered prefix)
+    order: np.ndarray  # int64 [n_rows]: device id of row r
+    dev2row: np.ndarray  # int64 [n_rows]: device id → row
+    buckets: list  # [Bucket], nbrs hold row indices, sentinel n_rows
+
+    def device_bytes(self) -> int:
+        """Device footprint of the bucket matrices as uploaded — what
+        the HBM governor plans under the ``reverse`` ledger tag."""
+        return sum(int(np.asarray(b.nbrs).nbytes) for b in self.buckets)
+
+
+def _one_list_layout(rows_dev: np.ndarray, nbr_dev: np.ndarray, n_rows: int, orient: str) -> ListLayout:
+    """Bucketize ``rows_dev[i] gathers nbr_dev[i]`` into a ListLayout
+    over ``n_rows`` interior-class device ids (same machinery as the
+    check buckets: pow2 degree buckets, pow2 row padding, contiguous
+    rows per bucket)."""
+    deg = np.bincount(rows_dev, minlength=n_rows) if rows_dev.size else np.zeros(n_rows, np.int64)
+    with np.errstate(divide="ignore"):
+        bkey = np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64) + 1
+    bkey[deg <= 1] = 1
+    bkey[deg == 0] = 63  # degree-0 rows sort last, outside every bucket
+    order = np.lexsort((np.arange(n_rows), bkey))
+    dev2row = np.empty(n_rows, np.int64)
+    dev2row[order] = np.arange(n_rows)
+    n_active = int(np.count_nonzero(deg > 0))
+    buckets: list[Bucket] = []
+    if rows_dev.size:
+        r = dev2row[rows_dev]
+        v = dev2row[nbr_dev].astype(np.int32)
+        eorder = np.argsort(r, kind="stable")
+        rs = r[eorder]
+        vs = v[eorder]
+        starts = np.searchsorted(rs, np.arange(n_active))
+        cumcount = np.arange(rs.shape[0]) - starts[rs]
+        key_by_row = bkey[order][:n_active]
+        sentinel = np.int32(n_rows)
+        for key in np.unique(key_by_row):
+            members = np.nonzero(key_by_row == key)[0]  # contiguous
+            offset, n_r = int(members[0]), int(members.shape[0])
+            cap = 1 << (int(key) - 1)
+            n_pad = _ceil_pow2(n_r)
+            nbrs = np.full((n_pad, cap), sentinel, dtype=np.int32)
+            emask = (rs >= offset) & (rs < offset + n_r)
+            nbrs[rs[emask] - offset, cumcount[emask]] = vs[emask]
+            buckets.append(Bucket(offset=offset, n=n_r, nbrs=nbrs))
+    return ListLayout(
+        orient=orient, n_rows=n_rows, n_active=n_active, order=order,
+        dev2row=dev2row, buckets=buckets,
+    )
+
+
+def build_rev_csr(
+    fwd_indptr: np.ndarray, fwd_indices: np.ndarray, n_nodes: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """The transposed CSR over ALL device ids: in-neighbors per node.
+    Derived from the forward CSR in one stable sort, persisted by the
+    snapshot cache so both orientations survive restarts."""
+    src = np.repeat(np.arange(n_nodes, dtype=np.int64), np.diff(fwd_indptr))
+    dst = fwd_indices.astype(np.int64)
+    rorder = np.argsort(dst, kind="stable")
+    rev_indptr = np.searchsorted(dst[rorder], np.arange(n_nodes + 1))
+    rev_indices = src[rorder].astype(np.int32)
+    return rev_indptr, rev_indices
+
+
+def build_list_layouts(
+    fwd_indptr: np.ndarray, fwd_indices: np.ndarray, n_nodes: int, sink_base: int
+) -> tuple[ListLayout, ListLayout]:
+    """Both reverse-query orientations over the interior-class subgraph
+    (device ids < ``sink_base``), from the forward CSR. Shared by the
+    snapshot builder, compaction (which re-derives them after folding),
+    and the snapshot-cache load path."""
+    src = np.repeat(np.arange(n_nodes, dtype=np.int64), np.diff(fwd_indptr))
+    dst = fwd_indices.astype(np.int64)
+    m = (src < sink_base) & (dst < sink_base)
+    lay_fwd = _one_list_layout(dst[m], src[m], sink_base, "fwd")
+    lay_rev = _one_list_layout(src[m], dst[m], sink_base, "rev")
+    return lay_fwd, lay_rev
+
+
+@dataclass
 class GraphSnapshot:
     """An immutable device-layout view of the tuple set at one watermark.
 
@@ -163,6 +267,31 @@ class GraphSnapshot:
     #: to the base's device_buckets; the engine applies + clears them
     ell_patch: Optional[list] = None
     device_overlay: Any = None  # (ov_nbrs, ov_dst) jnp arrays or None
+
+    # -- reverse-query layouts (keto_tpu/list/) ------------------------------
+    #: transposed CSR over ALL device ids (in-neighbors per node) —
+    #: backward seeding, static-answer resolution, and the CPU-reference
+    #: lister all gather through it (masked by tombstones + overlay)
+    rev_indptr: Optional[np.ndarray] = None  # int64 [n_nodes+1]
+    rev_indices: Optional[np.ndarray] = None  # int32 [E]
+    #: bucketed-ELL list layouts over interior-class rows, both
+    #: orientations (ListLayout); None on pre-reverse snapshots
+    lay_fwd: Any = None
+    lay_rev: Any = None
+    #: overlay interior-class edges [(src, dst)] mirrored for the list
+    #: kernels' extra gather stage (the transposed twin of ov_ell +
+    #: interior-source ov_out entries)
+    lst_ov_edges: Optional[list] = None
+    #: pending device patches for the list layouts, APPEND-ONLY across
+    #: stacked deltas: (orient, bucket, row, col, row-value). The list
+    #: engine applies entries past its applied-counter (device arrays
+    #: ride dataclasses.replace like device_buckets)
+    lst_patch: Optional[list] = None
+    #: True when an overlay shape could not be mirrored into the list
+    #: layouts — the device list path falls back to the CPU-reference
+    #: lister (bit-identical) until compaction folds the overlay
+    lst_dirty: bool = False
+    device_list: Any = None  # per-orientation jnp arrays, list-engine-set
 
     # -- 2-hop reachability labels (keto_tpu/graph/labels.py) ----------------
     #: pruned-landmark label index over interior rows, built at snapshot
@@ -442,6 +571,67 @@ class GraphSnapshot:
         cnts[mi] += lens
         return rows, cnts
 
+    def _ov_rev(self) -> dict:
+        """Lazily cached REVERSE of the unified overlay adjacency:
+        dst dev → [src devs] for every overlay-added edge — the seeding
+        source for backward listings while a delta overlay pends.
+        Rebuilt per snapshot object (apply_delta resets the cache)."""
+        with self._cache_lock:
+            inv = self._pattern_cache.get("_ov_rev")
+            if inv is None:
+                inv = {}
+                for src, dsts in (self.ov_fwd or {}).items():
+                    for dst in dsts:
+                        inv.setdefault(int(dst), []).append(int(src))
+                self._pattern_cache["_ov_rev"] = inv
+            return inv
+
+    def in_neighbors_bulk(self, nodes: np.ndarray):
+        """(concatenated in-neighbor devs of ``nodes``, per-node counts)
+        — the transposed twin of ``out_neighbors_bulk``: base reverse
+        CSR masked by tombstones, merged with the overlay's reverse
+        adjacency. Feeds backward-listing seeds and the CPU-reference
+        lister (keto_tpu/list/)."""
+        nodes = np.asarray(nodes)
+        nb = self.n_base_nodes
+        if nodes.size and int(nodes.max()) >= nb:
+            in_base = nodes < nb
+            base_nodes = np.where(in_base, nodes, 0)
+            cnts = np.where(
+                in_base,
+                self.rev_indptr[base_nodes + 1] - self.rev_indptr[base_nodes],
+                0,
+            )
+            rows, cnts = _csr_gather_counts(
+                self.rev_indptr, self.rev_indices, base_nodes, cnts
+            )
+        else:
+            rows, cnts = _csr_gather_host(self.rev_indptr, self.rev_indices, nodes)
+        if self.ov_removed is not None and self.ov_removed.size and rows.size:
+            # tombstone keys pack (src << 32) | dst; here the gathered
+            # entry is the SOURCE and the queried node the destination
+            keys = (rows.astype(np.int64) << 32) | np.repeat(
+                nodes.astype(np.int64), cnts
+            )
+            drop = self._removed_drop(keys, cnts)
+            if drop is not None:
+                keep, cnts = drop
+                rows = rows[keep]
+        ov = self._ov_rev() if self.ov_fwd else None
+        if not ov:
+            return rows, cnts
+        member = np.asarray([int(n) in ov for n in nodes], bool)
+        if not member.any():
+            return rows, cnts
+        ends = np.cumsum(cnts)
+        mi = np.nonzero(member)[0]
+        extras = [np.asarray(ov[int(nodes[i])], rows.dtype) for i in mi]
+        lens = np.asarray([e.size for e in extras], np.int64)
+        rows = np.insert(rows, np.repeat(ends[mi], lens), np.concatenate(extras))
+        cnts = cnts.copy()
+        cnts[mi] += lens
+        return rows, cnts
+
     def _pattern_index(self, kind: str):
         """Lazily built sorted key index for pattern resolution:
         ``(order, sorted primary col, sorted secondary col | None,
@@ -697,6 +887,10 @@ def build_snapshot(
             fwd_indices=np.zeros(0, np.int32),
             sink_indptr=np.zeros(1, np.int64),
             sink_indices=np.zeros(0, np.int32),
+            rev_indptr=np.zeros(1, np.int64),
+            rev_indices=np.zeros(0, np.int32),
+            lay_fwd=_one_list_layout(np.zeros(0, np.int64), np.zeros(0, np.int64), 0, "fwd"),
+            lay_rev=_one_list_layout(np.zeros(0, np.int64), np.zeros(0, np.int64), 0, "rev"),
         )
 
     in_deg = np.bincount(dst_raw, minlength=n)
@@ -823,6 +1017,13 @@ def build_snapshot(
     sink_indptr = np.searchsorted(s_dst[sorder], np.arange(n_sink + 1))
     sink_indices = s_src[sorder]
 
+    # reverse-query layouts (keto_tpu/list/): the transposed CSR over all
+    # device ids plus bucketed-ELL list layouts in BOTH orientations over
+    # the interior-class rows — built here so every snapshot can answer
+    # ListObjects/ListSubjects without a second interning pass
+    rev_indptr, rev_indices = build_rev_csr(findptr, findices, n)
+    lay_fwd, lay_rev = build_list_layouts(findptr, findices, n, sink_base)
+
     return GraphSnapshot(
         snapshot_id=watermark,
         num_sets=g.num_sets,
@@ -839,4 +1040,8 @@ def build_snapshot(
         fwd_indices=findices,
         sink_indptr=sink_indptr,
         sink_indices=sink_indices,
+        rev_indptr=rev_indptr,
+        rev_indices=rev_indices,
+        lay_fwd=lay_fwd,
+        lay_rev=lay_rev,
     )

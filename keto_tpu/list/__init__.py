@@ -1,0 +1,26 @@
+"""Reverse-query subsystem: ListObjects / ListSubjects + Watch.
+
+The check engine answers the forward question "may X do Y?"; this
+package answers the reverse ones — "what can X access?" (ListObjects)
+and "who can access Y?" (ListSubjects) — plus a snaptoken-consistent
+Watch changefeed so downstream caches can invalidate.
+
+- :mod:`keto_tpu.list.engine` — the Manager-backed CPU reference
+  engines (the differential-testing oracle and the degraded-mode
+  fallback, in the style of keto_tpu/check/engine.py);
+- :mod:`keto_tpu.list.tpu_engine` — the snapshot-backed engine running
+  frontier-expansion BFS over the transposed bucketed-ELL layout
+  (keto_tpu/graph/snapshot.py ``ListLayout``);
+- :mod:`keto_tpu.list.watch` — the Watch hub streaming committed tuple
+  deltas with their snaptokens, in commit order, resumable.
+"""
+
+from keto_tpu.list.engine import ListEngine, decode_page_token, encode_page_token
+from keto_tpu.list.watch import WatchHub
+
+__all__ = [
+    "ListEngine",
+    "WatchHub",
+    "decode_page_token",
+    "encode_page_token",
+]

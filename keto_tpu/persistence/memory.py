@@ -610,6 +610,58 @@ class MemoryPersister(Manager):
             log = self._shared.insert_log.get(nid, ())
             return [r for w, r in log if w > watermark], self._shared.watermark
 
+    def watch_changes_since(self, watermark: int):
+        """Watch seam (keto_tpu/list/watch.py): committed mutations after
+        ``watermark`` as ``(commit groups, current watermark)`` where each
+        group is ``(snaptoken, [(action, RelationTuple)])`` in commit
+        order — inserts before deletes within one transaction, matching
+        the transact path. Raises ErrWatchExpired when either log no
+        longer reaches back to ``watermark`` (the retained horizon)."""
+        from keto_tpu.x.errors import ErrWatchExpired
+
+        nid = self.network_id
+        nm = self._nm()
+        with self._shared.lock:
+            if (
+                self._shared.log_floor.get(nid, 0) > watermark
+                or self._shared.del_floor.get(nid, 0) > watermark
+            ):
+                raise ErrWatchExpired()
+            events = [
+                (w, 0, ("insert", self._to_tuple(r)))
+                for w, r in self._shared.insert_log.get(nid, ())
+                if w > watermark
+            ]
+            for w, k in self._shared.delete_log.get(nid, ()):
+                if w <= watermark:
+                    continue
+                ns = nm.get_namespace_by_config_id(k[0])
+                if k[3] is not None:
+                    subject: object = SubjectID(id=k[3])
+                else:
+                    sns = nm.get_namespace_by_config_id(k[4])
+                    subject = SubjectSet(namespace=sns.name, object=k[5], relation=k[6])
+                events.append(
+                    (
+                        w,
+                        1,
+                        (
+                            "delete",
+                            RelationTuple(
+                                namespace=ns.name, object=k[1], relation=k[2],
+                                subject=subject,
+                            ),
+                        ),
+                    )
+                )
+            events.sort(key=lambda t: (t[0], t[1]))
+            groups: list = []
+            for w, _, op in events:
+                if not groups or groups[-1][0] != w:
+                    groups.append((w, []))
+                groups[-1][1].append(op)
+            return groups, self._shared.watermark
+
     def changes_since(self, watermark: int):
         """Ordered mutations after ``watermark`` as ``(ops, new_watermark)``
         where each op is ``("ins", InternalRow)`` or ``("del", key7)`` —

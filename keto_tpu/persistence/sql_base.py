@@ -905,6 +905,66 @@ class SQLPersisterBase(Manager):
                 self._exec("COMMIT")
         return [InternalRow(*r[:7], seq=r[7]) for r in rows], wm
 
+    def watch_changes_since(self, watermark: int):
+        """Watch seam (keto_tpu/list/watch.py): committed mutations after
+        ``watermark`` as ``(commit groups, current watermark)``, each
+        group ``(snaptoken, [(action, RelationTuple)])`` in commit order
+        (inserts before deletes within one transaction, matching the
+        transact path). Raises ErrWatchExpired when the delete log no
+        longer reaches back to ``watermark``. Surviving rows' commit_time
+        doubles as the insert log, so an insert whose tuple was later
+        deleted elides from replay (its delete still replays — a no-op
+        for subscribers, preserving exact final-state reconstruction)."""
+        from keto_tpu.x.errors import ErrWatchExpired
+
+        got = self._with_reconnect(
+            lambda: self._watch_changes_once(watermark), retry=True
+        )
+        if got is None:
+            raise ErrWatchExpired()
+        return got
+
+    def _watch_changes_once(self, watermark: int):
+        with self._lock:
+            self._begin_snapshot_read()
+            try:
+                meta = self._exec(
+                    "SELECT watermark, del_log_floor FROM keto_watermarks WHERE nid = ?",
+                    (self.network_id,),
+                ).fetchone()
+                if meta is None:
+                    return [], 0
+                wm, floor = meta
+                if floor > watermark:
+                    return None
+                ins = self._exec(
+                    "SELECT namespace_id, object, relation, subject_id, "
+                    "subject_set_namespace_id, subject_set_object, subject_set_relation, "
+                    "commit_time FROM keto_relation_tuples "
+                    "WHERE nid = ? AND commit_time > ?",
+                    (self.network_id, watermark),
+                ).fetchall()
+                dels = self._exec(
+                    "SELECT namespace_id, object, relation, subject_id, "
+                    "subject_set_namespace_id, subject_set_object, subject_set_relation, "
+                    "commit_time FROM keto_tuple_delete_log "
+                    "WHERE nid = ? AND commit_time > ?",
+                    (self.network_id, watermark),
+                ).fetchall()
+            finally:
+                self._exec("COMMIT")
+        events = sorted(
+            [(int(r[7]), 0, ("insert", self._to_tuple(r))) for r in ins]
+            + [(int(r[7]), 1, ("delete", self._to_tuple(r))) for r in dels],
+            key=lambda t: (t[0], t[1]),
+        )
+        groups: list = []
+        for token, _, op in events:
+            if not groups or groups[-1][0] != token:
+                groups.append((token, []))
+            groups[-1][1].append(op)
+        return groups, int(wm)
+
     def changes_since(self, watermark: int):
         """Ordered mutations after ``watermark`` as ``(ops, new_watermark)``
         with ops ``("ins", InternalRow) | ("del", key7)`` — the

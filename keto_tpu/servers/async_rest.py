@@ -30,7 +30,7 @@ from http import HTTPStatus
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
-from keto_tpu.servers.rest import RawBody, RestApp
+from keto_tpu.servers.rest import RawBody, RestApp, StreamBody
 
 _log = logging.getLogger("keto_tpu.rest")
 
@@ -93,6 +93,17 @@ class AsyncRestServer:
         )
         self._batch_limit = 3 * n_batch
         self._batch_pending = 0  # event-loop thread only
+        # watch streams live for the connection's lifetime and block
+        # between events — a dedicated pool keeps them from occupying
+        # request-handler threads (the hub's max_streams bounds the
+        # count, so sizing the pool to it never queues a live stream
+        # behind another); list traversals ride the BATCH pool so a
+        # 100k-result listing never convoys interactive checks out of
+        # handler threads — the server-side face of the batcher's
+        # priority lanes, applied to the reverse-query surface
+        self._watch_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix=f"rest-{role}-watch"
+        )
         #: swallowed-with-a-trace counters (keto-analyze KTA401 seam):
         #: connection teardown races and protocol-level failures
         self.teardown_errors = 0
@@ -159,6 +170,7 @@ class AsyncRestServer:
         if loop is None or not loop.is_running():
             self._pool.shutdown(wait=False, cancel_futures=True)
             self._batch_pool.shutdown(wait=False, cancel_futures=True)
+            self._watch_pool.shutdown(wait=False, cancel_futures=True)
             return
 
         async def teardown():
@@ -188,6 +200,7 @@ class AsyncRestServer:
             self._thread = None
         self._pool.shutdown(wait=False, cancel_futures=True)
         self._batch_pool.shutdown(wait=False, cancel_futures=True)
+        self._watch_pool.shutdown(wait=False, cancel_futures=True)
 
     # -- per-connection ------------------------------------------------------
 
@@ -231,7 +244,11 @@ class AsyncRestServer:
                     version == "HTTP/1.0"
                     or headers.get("connection", "").lower() == "close"
                 )
-                is_batch = parts.path == "/check/batch"
+                is_batch = parts.path in (
+                    "/check/batch",
+                    "/relation-tuples/list-objects",
+                    "/relation-tuples/list-subjects",
+                )
                 if is_batch and self._batch_pending >= self._batch_limit:
                     # listener-level shed: the batch pool's waiting line
                     # is full — refuse for microseconds on the event loop
@@ -246,17 +263,30 @@ class AsyncRestServer:
                 self._active += 1
                 if is_batch:
                     self._batch_pending += 1
+                streamed = False
                 try:
                     pool = self._batch_pool if is_batch else self._pool
                     status, payload, extra = await asyncio.get_running_loop().run_in_executor(
                         pool, self.app.handle, method, parts.path, query, body,
                         headers,
                     )
-                    await self._write_response(writer, status, payload, extra, close)
+                    if isinstance(payload, StreamBody):
+                        streamed = True
+                    else:
+                        await self._write_response(writer, status, payload, extra, close)
                 finally:
                     self._active -= 1
                     if is_batch:
                         self._batch_pending -= 1
+                if streamed:
+                    # long-lived chunked stream (GET /watch): drive the
+                    # blocking generator on the dedicated watch pool so
+                    # request-handler threads stay free; stream
+                    # responses never keep-alive. Runs OUTSIDE _active —
+                    # the SIGTERM drain must not wait on open watches
+                    # (the hub's close() ends them instead).
+                    await self._write_stream(writer, status, payload, extra)
+                    return
                 if close:
                     return
         except (
@@ -305,6 +335,45 @@ class AsyncRestServer:
             return method.upper(), target, version.strip(), headers
         except ValueError:
             return None
+
+    async def _write_stream(
+        self, writer: asyncio.StreamWriter, status: int, payload: StreamBody,
+        extra: dict,
+    ) -> None:
+        """Chunked transfer of a StreamBody: each ``next()`` on the
+        (blocking) generator runs on the watch pool; chunks flush as
+        they arrive so subscribers see commits live."""
+        head = [
+            f"HTTP/1.1 {status} {_REASONS.get(status, '')}",
+            f"Content-Type: {payload.content_type}",
+            "Transfer-Encoding: chunked",
+            "Server: keto-tpu",
+        ]
+        for k, v in extra.items():
+            head.append(f"{k}: {v}")
+        head.append("Connection: close")
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
+        await writer.drain()
+        chunks = payload.chunks
+        loop = asyncio.get_running_loop()
+        end = object()
+        try:
+            while True:
+                chunk = await loop.run_in_executor(self._watch_pool, next, chunks, end)
+                if chunk is end:
+                    writer.write(b"0\r\n\r\n")
+                    await writer.drain()
+                    return
+                if not chunk:
+                    continue
+                writer.write(b"%x\r\n" % len(chunk) + chunk + b"\r\n")
+                await writer.drain()
+        finally:
+            # client disconnects (ConnectionResetError out of drain) land
+            # here: closing the generator releases its watch slot
+            close = getattr(chunks, "close", None)
+            if close is not None:
+                await loop.run_in_executor(self._watch_pool, close)
 
     async def _write_response(
         self, writer: asyncio.StreamWriter, status: int, payload, extra: dict,

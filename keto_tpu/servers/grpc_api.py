@@ -17,6 +17,7 @@ internal/check/handler.go:162).
 
 from __future__ import annotations
 
+import json
 import logging
 import math
 import threading
@@ -351,6 +352,192 @@ class WriteService:
         )
 
 
+def _json_ser(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _json_de(data: bytes):
+    return json.loads(data.decode() or "{}")
+
+
+def _subject_from_request(req: dict):
+    """subject_id XOR subject_set from a JSON-framed request (the same
+    convention as the REST tuple codec)."""
+    sid = req.get("subject_id")
+    sset = req.get("subject_set")
+    if sid is not None:
+        from keto_tpu.relationtuple.model import SubjectID
+
+        return SubjectID(id=str(sid))
+    if isinstance(sset, dict):
+        from keto_tpu.relationtuple.model import SubjectSet
+
+        return SubjectSet(
+            namespace=str(sset.get("namespace", "")),
+            object=str(sset.get("object", "")),
+            relation=str(sset.get("relation", "")),
+        )
+    return None
+
+
+class ListService:
+    """keto.tpu.list.v1.ListService — the gRPC face of the reverse-query
+    endpoints. The upstream acl.v1alpha1 contract has no reverse-query
+    surface and the runtime image carries no protoc plugin, so these
+    methods frame requests/responses as UTF-8 JSON objects mirroring the
+    REST payloads exactly (documented in docs/concepts/api-overview.md);
+    any grpc client can call them with a JSON serializer."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    @staticmethod
+    def _consistency(req: dict):
+        raw = str(req.get("snaptoken", "") or "")
+        at_least = None
+        if raw:
+            try:
+                at_least = int(raw)
+            except ValueError:
+                raise ErrBadRequest(f"malformed snaptoken {raw!r}") from None
+        return at_least, bool(req.get("latest"))
+
+    def ListObjects(self, request, context):
+        ns = str(request.get("namespace", ""))
+        rel = str(request.get("relation", ""))
+        if not ns:
+            raise ErrBadRequest("namespace has to be specified")
+        if not rel:
+            raise ErrBadRequest("relation has to be specified")
+        sub = _subject_from_request(request)
+        if sub is None:
+            raise ErrBadRequest("Subject has to be specified.")
+        at_least, latest = self._consistency(request)
+        objs, nxt, token = self.registry.list_engine().page_objects(
+            ns, rel, sub,
+            page_size=int(request.get("page_size", 0) or 0),
+            page_token=str(request.get("page_token", "") or ""),
+            at_least=at_least, latest=latest,
+        )
+        return {"objects": objs, "next_page_token": nxt, "snaptoken": str(token)}
+
+    def ListSubjects(self, request, context):
+        ns = str(request.get("namespace", ""))
+        obj = str(request.get("object", ""))
+        rel = str(request.get("relation", ""))
+        if not ns:
+            raise ErrBadRequest("namespace has to be specified")
+        if not obj:
+            raise ErrBadRequest("object has to be specified")
+        if not rel:
+            raise ErrBadRequest("relation has to be specified")
+        at_least, latest = self._consistency(request)
+        subs, nxt, token = self.registry.list_engine().page_subjects(
+            ns, obj, rel,
+            page_size=int(request.get("page_size", 0) or 0),
+            page_token=str(request.get("page_token", "") or ""),
+            at_least=at_least, latest=latest,
+        )
+        return {
+            "subject_ids": subs,
+            "next_page_token": nxt,
+            "snaptoken": str(token),
+        }
+
+    def register(self, server):
+        server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "keto.tpu.list.v1.ListService",
+                    {
+                        "ListObjects": grpc.unary_unary_rpc_method_handler(
+                            _wrap(self.ListObjects, self.registry,
+                                  "ListService/ListObjects"),
+                            request_deserializer=_json_de,
+                            response_serializer=_json_ser,
+                        ),
+                        "ListSubjects": grpc.unary_unary_rpc_method_handler(
+                            _wrap(self.ListSubjects, self.registry,
+                                  "ListService/ListSubjects"),
+                            request_deserializer=_json_de,
+                            response_serializer=_json_ser,
+                        ),
+                    },
+                ),
+            )
+        )
+
+
+def _wrap_stream(fn, registry, name: str):
+    """The server-streaming analog of ``_wrap``: KetoError → status
+    codes, request counter + latency on stream end."""
+
+    def handler(request, context):
+        counter, latency = _request_metrics(registry.metrics())
+        code = "OK"
+        t0 = time.perf_counter()
+        try:
+            yield from fn(request, context)
+        except KetoError as e:
+            code = _CODE_BY_NUM.get(e.grpc_code, grpc.StatusCode.INTERNAL).name
+            _abort(context, e)
+        except Exception:
+            code = "INTERNAL"
+            raise
+        finally:
+            counter.inc((name, code))
+            latency.observe((name,), time.perf_counter() - t0)
+
+    return handler
+
+
+class WatchService:
+    """keto.tpu.watch.v1.WatchService — server-streaming changefeed, the
+    gRPC face of ``GET /watch``. JSON-framed like ListService; each
+    message is one commit group ``{"snaptoken", "changes": [{"action",
+    "relation_tuple"}]}``, resumable from any retained snaptoken
+    (OUT_OF_RANGE past the horizon), ended by server drain."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def Watch(self, request, context):
+        hub = self.registry.watch_hub()
+        raw = str(request.get("snaptoken", "") or "0")
+        try:
+            since = int(raw)
+        except ValueError:
+            raise ErrBadRequest(f"malformed snaptoken {raw!r}") from None
+        hub.changes_since(since)  # OUT_OF_RANGE before any message flows
+        for token, changes in hub.subscribe(since):
+            if not context.is_active():
+                return
+            yield {
+                "snaptoken": str(token),
+                "changes": [
+                    {"action": action, "relation_tuple": rt.to_json()}
+                    for action, rt in changes
+                ],
+            }
+
+    def register(self, server):
+        server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "keto.tpu.watch.v1.WatchService",
+                    {
+                        "Watch": grpc.unary_stream_rpc_method_handler(
+                            _wrap_stream(self.Watch, self.registry,
+                                         "WatchService/Watch"),
+                            request_deserializer=_json_de,
+                            response_serializer=_json_ser,
+                        ),
+                    },
+                ),
+            )
+        )
+
+
 class VersionService:
     """ory.keto.acl.v1alpha1.VersionService (reference proto version.proto:15-19)."""
 
@@ -445,6 +632,8 @@ def build_grpc_server(registry, role: str, address: str = "127.0.0.1:0"):
         CheckService(registry).register(server)
         ExpandService(registry).register(server)
         ReadService(registry).register(server)
+        ListService(registry).register(server)
+        WatchService(registry).register(server)
     else:
         WriteService(registry).register(server)
     VersionService(registry).register(server)

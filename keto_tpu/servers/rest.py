@@ -108,6 +108,18 @@ class RawBody:
     content_type: str
 
 
+@dataclass
+class StreamBody:
+    """A chunked streaming response (``GET /watch``): the server
+    backends write ``Transfer-Encoding: chunked`` and iterate ``chunks``
+    (bytes per chunk) until exhaustion, then close the connection.
+    Closing the iterator on client disconnect releases its resources
+    (the watch hub's stream slot)."""
+
+    chunks: Any  # iterator of bytes
+    content_type: str = "application/x-ndjson"
+
+
 class RestApp:
     """Routes requests for one server role against the registry."""
 
@@ -218,6 +230,12 @@ class RestApp:
                     return self._get_expand(query)
                 if route == ("GET", "/relation-tuples"):
                     return self._get_relation_tuples(query)
+                if route == ("GET", "/relation-tuples/list-objects"):
+                    return self._get_list_objects(query, headers)
+                if route == ("GET", "/relation-tuples/list-subjects"):
+                    return self._get_list_subjects(query, headers)
+                if route == ("GET", "/watch"):
+                    return self._get_watch(query)
             else:
                 if route == ("PUT", "/relation-tuples"):
                     return self._put_relation_tuple(body, headers)
@@ -430,6 +448,112 @@ class RestApp:
             {},
         )
 
+    # -- reverse queries (keto_tpu/list/) ------------------------------------
+
+    @staticmethod
+    def _page_opts(query) -> tuple[int, str]:
+        """(page_size, page_token) from the query; malformed sizes are a
+        400 like the tuple-listing endpoint's."""
+        token = (query.get("page_token") or [""])[0]
+        raw_size = (query.get("page_size") or [""])[0]
+        size = 0
+        if raw_size:
+            try:
+                size = int(raw_size)
+            except ValueError:
+                raise ErrBadRequest(f"invalid page_size {raw_size!r}") from None
+            if size < 0:
+                raise ErrBadRequest(f"page_size must be >= 0, got {raw_size!r}")
+        return size, token
+
+    def _get_list_objects(self, query, headers=None):
+        """``GET /relation-tuples/list-objects`` — every object the
+        subject can (transitively) access under namespace+relation, as a
+        paginated, sorted result with a snaptoken-pinned page token."""
+        rq = RelationQuery.from_url_query(query)
+        if rq.namespace == "":
+            raise ErrBadRequest("namespace has to be specified")
+        if rq.relation == "":
+            raise ErrBadRequest("relation has to be specified")
+        sub = rq.subject
+        if sub is None:
+            raise ErrBadRequest("Subject has to be specified.")
+        at_least, latest = self._consistency_from(query)
+        size, token = self._page_opts(query)
+        objs, nxt, snaptoken = self.registry.list_engine().page_objects(
+            rq.namespace, rq.relation, sub,
+            page_size=size, page_token=token, at_least=at_least, latest=latest,
+        )
+        return (
+            200,
+            {"objects": objs, "next_page_token": nxt, "snaptoken": str(snaptoken)},
+            {"X-Keto-Snaptoken": str(snaptoken)},
+        )
+
+    def _get_list_subjects(self, query, headers=None):
+        """``GET /relation-tuples/list-subjects`` — every subject id
+        (transitively) allowed on namespace:object#relation."""
+        rq = RelationQuery.from_url_query(query)
+        if rq.namespace == "":
+            raise ErrBadRequest("namespace has to be specified")
+        if rq.object == "":
+            raise ErrBadRequest("object has to be specified")
+        if rq.relation == "":
+            raise ErrBadRequest("relation has to be specified")
+        at_least, latest = self._consistency_from(query)
+        size, token = self._page_opts(query)
+        subs, nxt, snaptoken = self.registry.list_engine().page_subjects(
+            rq.namespace, rq.object, rq.relation,
+            page_size=size, page_token=token, at_least=at_least, latest=latest,
+        )
+        return (
+            200,
+            {
+                "subject_ids": subs,
+                "next_page_token": nxt,
+                "snaptoken": str(snaptoken),
+            },
+            {"X-Keto-Snaptoken": str(snaptoken)},
+        )
+
+    def _get_watch(self, query):
+        """``GET /watch?snaptoken=N`` — chunked ndjson changefeed: one
+        line per committed transaction, ``{"snaptoken", "changes":
+        [{"action", "relation_tuple"}]}``, resumable from any retained
+        snaptoken (410 past the horizon), ended by server drain."""
+        from keto_tpu.x.errors import ErrTooManyRequests
+
+        hub = self.registry.watch_hub()
+        raw = (query.get("snaptoken") or [""])[0] or "0"
+        try:
+            since = int(raw)
+        except ValueError:
+            raise ErrBadRequest(f"malformed snaptoken {raw!r}") from None
+        # validate the resume horizon BEFORE committing a 200: an expired
+        # token must answer 410, not die mid-stream
+        hub.changes_since(since)
+        if not hub.try_acquire_stream():
+            raise ErrTooManyRequests(
+                "too many concurrent watch streams; retry with backoff",
+                retry_after_s=1.0,
+            )
+
+        def gen():
+            try:
+                for token, changes in hub.subscribe(since, own_slot=False):
+                    msg = {
+                        "snaptoken": str(token),
+                        "changes": [
+                            {"action": action, "relation_tuple": rt.to_json()}
+                            for action, rt in changes
+                        ],
+                    }
+                    yield (json.dumps(msg) + "\n").encode()
+            finally:
+                hub.release_stream()
+
+        return 200, StreamBody(gen()), {}
+
     # -- write ---------------------------------------------------------------
 
     @staticmethod
@@ -520,6 +644,9 @@ def _make_handler(app: RestApp):
                 status, payload, headers = app.handle(
                     method, parts.path, query, body, req_headers
                 )
+                if isinstance(payload, StreamBody):
+                    self._serve_stream(status, payload, headers)
+                    return
                 if isinstance(payload, RawBody):
                     data, content_type = payload.data, payload.content_type
                 else:
@@ -536,6 +663,34 @@ def _make_handler(app: RestApp):
             finally:
                 with self.server.active_lock:
                     self.server.active_count -= 1
+
+        def _serve_stream(self, status: int, payload: StreamBody, headers) -> None:
+            """Chunked transfer: frame each generator chunk, flush so
+            subscribers see events as they commit, close on exhaustion
+            (stream responses never keep-alive). A client disconnect
+            closes the generator, releasing its watch slot."""
+            self.send_response(status)
+            self.send_header("Content-Type", payload.content_type)
+            self.send_header("Transfer-Encoding", "chunked")
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.send_header("Connection", "close")
+            self.end_headers()
+            chunks = payload.chunks
+            try:
+                for chunk in chunks:
+                    if not chunk:
+                        continue
+                    self.wfile.write(b"%x\r\n" % len(chunk) + chunk + b"\r\n")
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # subscriber went away; the finally releases the slot
+            finally:
+                close = getattr(chunks, "close", None)
+                if close is not None:
+                    close()
+                self.close_connection = True
 
         def log_message(self, fmt, *args):  # per-request logging, health excluded
             if not self.path.startswith("/health/"):

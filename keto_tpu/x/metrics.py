@@ -62,6 +62,9 @@ KNOWN_ROUTES = frozenset(
         "/check/batch",
         "/expand",
         "/relation-tuples",
+        "/relation-tuples/list-objects",
+        "/relation-tuples/list-subjects",
+        "/watch",
         "/version",
         "/metrics",
         "/health/alive",

@@ -1,0 +1,282 @@
+"""list-watch-smoke: the CI gate on the reverse-query subsystem.
+
+Two phases against REAL daemons:
+
+1. **Paginated listing under maintenance** (in-process daemon, memory
+   store): a 100k-tuple RBAC graph is listed through
+   ``/relation-tuples/list-subjects`` in pages, with a write + an
+   explicit compaction landing MID-pagination. The concatenated pages
+   must equal the expected subject set exactly — no duplicates, no gaps
+   — proving the snaptoken-pinned value-cursor tokens survive device-id
+   renumbering.
+2. **Watch resume across a kill** (daemon subprocess over one sqlite
+   file, via tests/chaos_runner.py): a subscriber collects commit
+   groups, the daemon is SIGKILLed, a restarted daemon serves a resume
+   from the last received snaptoken, and folding both streams must
+   reconstruct the exact final tuple state (read back through the
+   recovered daemon), exactly-once.
+
+Exit 0 when all hold; 1 with the violations listed. Run with
+``KETO_TPU_SANITIZE=1`` to additionally require a clean concurrency-
+sanitizer report (the CI job does).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_TUPLES = int(os.environ.get("SMOKE_LIST_TUPLES", 100_000))
+PAGE = int(os.environ.get("SMOKE_LIST_PAGE", 4096))
+WATCH_WRITES = int(os.environ.get("SMOKE_WATCH_WRITES", 30))
+
+
+def log(*a):
+    print("[list-watch-smoke]", *a, flush=True)
+
+
+def phase_paginated_list(problems: list[str]) -> None:
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "docs"}, {"id": 1, "name": "groups"}],
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "log.level": "error",
+        }
+    )
+    daemon = Daemon(Registry(cfg))
+    daemon.serve_all(block=False)
+    try:
+        store = daemon.registry.relation_tuple_manager()
+        # one big group: every user is a member; one doc grants it —
+        # list-subjects(doc) must return every user, across many pages
+        users = [f"user-{i:07d}" for i in range(N_TUPLES)]
+        rows = [
+            RelationTuple(
+                namespace="groups", object="everyone", relation="member",
+                subject=SubjectID(u),
+            )
+            for u in users
+        ]
+        rows.append(
+            RelationTuple(
+                namespace="docs", object="handbook", relation="view",
+                subject=SubjectSet("groups", "everyone", "member"),
+            )
+        )
+        t0 = time.perf_counter()
+        store.write_relation_tuples(*rows)
+        log(f"ingested {len(rows):,} tuples in {time.perf_counter() - t0:.1f}s")
+        base = f"http://127.0.0.1:{daemon.read_port}"
+
+        def page(token: str):
+            url = (
+                f"{base}/relation-tuples/list-subjects?namespace=docs"
+                f"&object=handbook&relation=view&page_size={PAGE}"
+            )
+            if token:
+                url += f"&page_token={urllib.parse.quote(token)}"
+            with urllib.request.urlopen(url, timeout=120) as resp:
+                return json.loads(resp.read())
+
+        import urllib.parse
+
+        got: list[str] = []
+        token = ""
+        pages = 0
+        compacted = False
+        t0 = time.perf_counter()
+        while True:
+            body = page(token)
+            got.extend(body["subject_ids"])
+            token = body["next_page_token"]
+            pages += 1
+            if not token:
+                break
+            if not compacted and pages >= 2:
+                # MID-pagination maintenance: land a delta, then fold it
+                # (compaction renumbers device ids — the value cursor
+                # must not care)
+                store.write_relation_tuples(
+                    RelationTuple(
+                        namespace="groups", object="other", relation="member",
+                        subject=SubjectID("zz-late"),
+                    )
+                )
+                engine = daemon.registry.permission_engine()
+                snap = engine.snapshot()
+                from keto_tpu.graph import compaction
+
+                if snap.has_overlay:
+                    res = compaction.compact_snapshot(snap)
+                    compacted = res is not None
+                log(f"mid-pagination compaction after page {pages}: {compacted}")
+        wall = time.perf_counter() - t0
+        log(
+            f"listed {len(got):,} subjects in {pages} pages "
+            f"({wall:.1f}s, {len(got) / wall:,.0f} subjects/s)"
+        )
+        if got != users:
+            dupes = len(got) - len(set(got))
+            missing = len(set(users) - set(got))
+            problems.append(
+                f"paginated listing diverged: {len(got)} items "
+                f"({dupes} duplicates, {missing} missing) vs {len(users)}"
+            )
+        if not compacted:
+            problems.append("compaction never ran mid-pagination (gate is vacuous)")
+    finally:
+        daemon.shutdown()
+
+
+def phase_watch_kill_resume(problems: list[str]) -> None:
+    from tests.test_chaos import DaemonProc
+
+    from keto_tpu.relationtuple.model import RelationQuery, RelationTuple, SubjectID
+
+    def T(obj, sub):
+        return RelationTuple(
+            namespace="docs", object=obj, relation="view", subject=SubjectID(sub)
+        )
+
+    with tempfile.TemporaryDirectory(prefix="list-watch-smoke-") as td:
+        workdir = Path(td)
+        dbfile = workdir / "smoke.db"
+        cache = workdir / "cache"
+        cache.mkdir()
+        d1 = DaemonProc(dbfile, cache, workdir)
+        got: list = []
+        try:
+            if d1.wait_ports() is None:
+                problems.append("first daemon died before publishing ports")
+                return
+            c1 = d1.client(retry_max_wait_s=2.0)
+            for i in range(WATCH_WRITES):
+                c1.patch_relation_tuples(
+                    insert=[T(f"o{i}", f"u{i % 5}")], idempotency_key=f"w-{i}"
+                )
+            c1.patch_relation_tuples(delete=[T("o0", "u0")], idempotency_key="w-del")
+
+            def run():
+                try:
+                    for token, changes in c1.watch(0):
+                        got.append((token, changes))
+                except Exception:
+                    return  # killed mid-stream: expected
+
+            th = threading.Thread(target=run, daemon=True)
+            th.start()
+            deadline = time.time() + 20
+            while len(got) < 5 and time.time() < deadline:
+                time.sleep(0.05)
+            if not got:
+                problems.append("watch delivered nothing before the kill")
+                return
+            d1.proc.kill()
+            d1.proc.wait(timeout=20)
+            log(f"SIGKILLed daemon after {len(got)} delivered groups")
+        finally:
+            d1.log.close()
+        last = got[-1][0]
+        folded: dict = {}
+
+        def fold(stream):
+            for _token, changes in stream:
+                for action, rt in changes:
+                    if action == "insert":
+                        folded[str(rt)] = True
+                    else:
+                        folded.pop(str(rt), None)
+
+        fold(got)
+        d2 = DaemonProc(dbfile, cache, workdir)
+        try:
+            if d2.wait_ports() is None:
+                problems.append("restarted daemon died before publishing ports")
+                return
+            c2 = d2.client(retry_max_wait_s=5.0)
+            post = T("after-restart", "u9")
+            c2.patch_relation_tuples(insert=[post], idempotency_key="post")
+            resumed: list = []
+
+            def run2():
+                for token, changes in c2.watch(last):
+                    resumed.append((token, changes))
+                    if any(str(rt) == str(post) for _, rt in changes):
+                        return
+
+            th2 = threading.Thread(target=run2, daemon=True)
+            th2.start()
+            th2.join(timeout=30)
+            if th2.is_alive():
+                problems.append("resume never delivered the post-restart write")
+                return
+            if any(t <= last for t, _ in resumed):
+                problems.append("resume re-delivered groups at or before the cut")
+            fold(resumed)
+            live = set()
+            token = ""
+            while True:
+                resp = c2.get_relation_tuples(RelationQuery(), page_token=token)
+                live.update(str(t) for t in resp.relation_tuples)
+                token = resp.next_page_token
+                if not token:
+                    break
+            if set(folded) != live:
+                problems.append(
+                    f"folded watch state != store: {len(folded)} vs {len(live)} "
+                    f"(missing {sorted(live - set(folded))[:3]}, "
+                    f"extra {sorted(set(folded) - live)[:3]})"
+                )
+            else:
+                log(
+                    f"resume OK: {len(resumed)} groups after the cut, folded "
+                    f"state matches {len(live)} live tuples exactly"
+                )
+            rc = d2.terminate_gracefully()
+            if rc != 0:
+                problems.append(f"recovered daemon drained with exit code {rc}")
+            viol = d2.sanitize_violations() if hasattr(d2, "sanitize_violations") else []
+            problems.extend(viol)
+        finally:
+            d2.log.close()
+
+
+def main() -> int:
+    problems: list[str] = []
+    phase_paginated_list(problems)
+    phase_watch_kill_resume(problems)
+
+    from keto_tpu.x import lockwatch
+
+    if lockwatch.installed():
+        problems.extend(lockwatch.violations())
+
+    if problems:
+        for p in problems:
+            log("FAIL:", p)
+        return 1
+    log("OK: paginated listing consistent across compaction; watch "
+        "resume exactly-once across a SIGKILL")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -200,7 +200,9 @@ def test_tiny_budget_walks_every_rung_with_decision_parity(make_persister):
         # and every decision still matches the oracle
         assert engine.batch_check(queries) == expected
         snap = engine.hbm.snapshot()
-        assert snap["evicted"] == ["labels", "warm-ladder", "overlay-budget"]
+        assert snap["evicted"] == [
+            "labels", "reverse", "warm-ladder", "overlay-budget",
+        ]
         assert snap["forced_allocs"] >= 1
         assert engine._labels_suspended
         assert engine._snapshot.labels is None

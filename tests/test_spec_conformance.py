@@ -159,6 +159,58 @@ def test_list_relation_tuples_conforms(daemon):
     assert body["relation_tuples"], "seeded tuples missing from the listing"
 
 
+def test_list_objects_conforms(daemon):
+    status, body = _request(
+        daemon.read_port, "GET", "/relation-tuples/list-objects",
+        query={"namespace": "files", "relation": "view", "subject_id": "deb"},
+    )
+    assert status == 200
+    _validate("/relation-tuples/list-objects", "GET", status, body)
+    assert body["objects"] == ["readme"]
+    # declared 400: subject missing
+    status, body = _request(
+        daemon.read_port, "GET", "/relation-tuples/list-objects",
+        query={"namespace": "files", "relation": "view"},
+    )
+    assert status == 400
+    _validate("/relation-tuples/list-objects", "GET", status, body)
+
+
+def test_list_subjects_conforms(daemon):
+    status, body = _request(
+        daemon.read_port, "GET", "/relation-tuples/list-subjects",
+        query={"namespace": "files", "object": "readme", "relation": "view"},
+    )
+    assert status == 200
+    _validate("/relation-tuples/list-subjects", "GET", status, body)
+    assert body["subject_ids"] == ["deb"]
+    status, body = _request(
+        daemon.read_port, "GET", "/relation-tuples/list-subjects",
+        query={"namespace": "files", "object": "readme"},
+    )
+    assert status == 400
+    _validate("/relation-tuples/list-subjects", "GET", status, body)
+
+
+def test_watch_conforms(daemon):
+    # the streamed lines validate against the watchEvent definition; a
+    # malformed snaptoken answers the declared 400
+    import urllib.request as _rq
+
+    url = f"http://127.0.0.1:{daemon.read_port}/watch?snaptoken=0"
+    with _rq.urlopen(url, timeout=10) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("application/x-ndjson")
+        line = resp.readline()
+    event = json.loads(line)
+    _validate_schema = SPEC["definitions"]["watchEvent"]
+    assert set(_validate_schema["required"]) <= set(event)
+    assert event["changes"] and event["changes"][0]["action"] in ("insert", "delete")
+    status, body = _request(daemon.read_port, "GET", "/watch", query={"snaptoken": "zz"})
+    assert status == 400
+    _validate("/watch", "GET", status, body)
+
+
 def test_write_api_conforms(daemon):
     put = {
         "namespace": "teams", "object": "qa", "relation": "member",

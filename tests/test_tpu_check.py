@@ -259,6 +259,66 @@ def test_bulk_resolve_native_parity(make_persister, seed):
         assert np.array_equal(multi_n[i][1], multi_p[i][1])
 
 
+def test_bulk_resolve_wild_subject_namespace_parity(make_persister):
+    # regression (tier-1 bulk-resolve parity failure): a LITERAL start
+    # with an empty-namespace subject set routed to the pattern path in
+    # the native resolver but resolved literally in the Python host loop
+    # (_subject_target), so sd diverged (-2 multi vs the start row).
+    # Subjects match literally — an empty subject namespace can only
+    # equal a stored subject in a namespace named "" — and both
+    # resolvers must agree entry for entry.
+    import numpy as np
+
+    p = make_persister([("ns0", 0), ("", 3)])
+    p.write_relation_tuples(
+        T("ns0", "o0", "r1", SubjectSet("", "o5", "r0")),
+        T("", "o5", "r0", SubjectID("u1")),
+    )
+    tpu = TpuCheckEngine(p, p.namespaces)
+    snap = tpu.snapshot()
+    queries = [
+        T("ns0", "o0", "r1", SubjectSet("", "o5", "r0")),  # divergent shape
+        T("ns0", "o0", "r1", SubjectID("u1")),
+    ]
+    sd_p, tg_p, multi_p = tpu._resolve_bulk_py(snap, queries)
+    # the pure-Python contract: literal start resolves to a single row
+    # (never the -2 multi sentinel) with a reachable target
+    assert sd_p[0] >= 0 and tg_p[0] >= 0 and 0 not in multi_p
+    if hasattr(snap.interned, "resolve_queries"):
+        got = tpu._resolve_bulk_native(snap, queries)
+        assert got is not None
+        sd_n, tg_n, multi_n = got
+        assert np.array_equal(sd_n, sd_p)
+        assert np.array_equal(tg_n, tg_p)
+        assert multi_n.keys() == multi_p.keys()
+    # decisions through the full engine stay correct either way
+    assert tpu.subject_is_allowed(queries[0]) is True
+    assert tpu.subject_is_allowed(queries[1]) is True
+
+
+def test_bulk_resolve_wild_subject_no_empty_namespace(make_persister):
+    # the other half of the contract: with NO namespace named "", an
+    # empty-namespace subject set can never match — the start still
+    # resolves, the target is unreachable, decision is deny, and the
+    # native path agrees with the host loop entry for entry
+    import numpy as np
+
+    p = make_persister([("ns0", 0)])
+    p.write_relation_tuples(T("ns0", "o0", "r1", SubjectID("u1")))
+    tpu = TpuCheckEngine(p, p.namespaces)
+    snap = tpu.snapshot()
+    queries = [T("ns0", "o0", "r1", SubjectSet("", "o5", "r0"))]
+    sd_p, tg_p, _ = tpu._resolve_bulk_py(snap, queries)
+    assert sd_p[0] >= 0 and tg_p[0] == -1
+    if hasattr(snap.interned, "resolve_queries"):
+        got = tpu._resolve_bulk_native(snap, queries)
+        assert got is not None
+        sd_n, tg_n, _ = got
+        assert np.array_equal(sd_n, sd_p)
+        assert np.array_equal(tg_n, tg_p)
+    assert tpu.subject_is_allowed(queries[0]) is False
+
+
 def test_deep_chain(make_persister):
     # depth beyond anything the fuzzer hits; exercises many BFS iterations
     p = make_persister([("n", 1)])
