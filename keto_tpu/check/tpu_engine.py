@@ -60,7 +60,7 @@ from jax import lax
 
 from keto_tpu import namespace as namespace_pkg
 from keto_tpu.driver.hbm import HbmGovernor, MemoryPressure, is_resource_exhausted
-from keto_tpu.graph.snapshot import WILDCARD, GraphSnapshot, build_snapshot
+from keto_tpu.graph.snapshot import WILDCARD, GraphSnapshot
 from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
 from keto_tpu.x import faults
 from keto_tpu.x.errors import ErrNamespaceUnknown, KetoError
@@ -676,6 +676,8 @@ class TpuCheckEngine:
         labels_landmarks: int = 0,
         hbm_budget_bytes: int = 0,
         audit_sample_rate: float = 0.0,
+        device_build_enabled: bool = True,
+        build_chunk_rows: int = 262144,
     ):
         if it_cap < 1:
             raise ValueError("it_cap must be >= 1 (the answer pull needs one step)")
@@ -846,6 +848,21 @@ class TpuCheckEngine:
         # seam where ladder restores and deferred label rebuilds run
         # without adding work to inline (serving-thread) refreshes
         self._in_maintenance_pass = False
+        # streaming snapshot pipeline (keto_tpu/graph/stream_build.py):
+        # build progress feeds health ({phase, pct} while STARTING) and
+        # the keto_build_* metric families; the governed sorter runs the
+        # build's edge-scale stable sorts on the device when the HBM
+        # governor's transient plan fits, host bit-identically otherwise
+        from keto_tpu.graph.device_build import GovernedSorter
+        from keto_tpu.graph.stream_build import BuildProgress
+
+        self.build_progress = BuildProgress(stats=self.maintenance)
+        self._build_chunk_rows = max(1, int(build_chunk_rows))
+        self._build_sorter = (
+            GovernedSorter(hbm=self.hbm, stats=self.maintenance)
+            if device_build_enabled
+            else None
+        )
 
     # -- snapshot lifecycle --------------------------------------------------
 
@@ -1029,6 +1046,12 @@ class TpuCheckEngine:
             # shadow-parity auditor: any divergence flips DEGRADED
             "audit_checks": self._audit_checks,
             "audit_mismatches": self._audit_mismatches,
+            # streaming build pipeline: a multi-minute STARTING boot is
+            # visibly alive — health surfaces the live phase and a
+            # coarse completion estimate (keto_tpu/graph/stream_build.py)
+            "build_phase": self.build_progress.current_phase,
+            "build_pct": self.build_progress.pct(),
+            "build_rows_ingested": self.build_progress.rows_ingested,
         }
 
     def close(self) -> None:
@@ -1462,16 +1485,25 @@ class TpuCheckEngine:
         if new is None:
             if delta_only:
                 return None
+            from keto_tpu.graph.stream_build import full_build
+
             t0 = time.monotonic()
-            rows, wm = self._read_store(self._store.snapshot_rows)
-            cols_fn = getattr(self._store, "snapshot_columns", None)
-            new = build_snapshot(
-                rows, wm, wild_ns_ids,
+            # streaming, overlapped, device-accelerated pipeline: chunked
+            # store scan feeds the native intern pool, the layout's
+            # stable sorts run on the device when the governor's plan
+            # fits, and build_progress narrates phases into health() and
+            # the keto_build_* families the whole way
+            new = full_build(
+                self._store, wild_ns_ids,
                 peel_seed_cap=self._peel_seed_cap,
-                columns=cols_fn(wm) if cols_fn is not None else None,
+                sorter=self._build_sorter,
+                progress=self.build_progress,
+                read_retry=self._read_store,
+                chunk_rows=self._build_chunk_rows,
             )
             self._upload_buckets(new)
-            self._ensure_labels(new)
+            with self.build_progress.phase("labels"):
+                self._ensure_labels(new)
             self._last_full_build_s = time.monotonic() - t0
             self.maintenance.incr("full_rebuilds")
             self.maintenance.observe_ms(
@@ -1567,7 +1599,11 @@ class TpuCheckEngine:
         # with the host arrays modulo the tombstones it re-uploads (an
         # unapplied restore patch would otherwise leave a stale sentinel)
         self._apply_ell_patch(snap)
-        got = compact_snapshot(snap)
+        # device splice: the fold's transposed-CSR / list-layout
+        # re-derivation sorts run on the device under the same governed
+        # policy as full builds — write-heavy tenants stop paying the
+        # host-side rebuild tail (keto_tpu/graph/device_build.py)
+        got = compact_snapshot(snap, sorter=self._build_sorter)
         if got is None:
             return None
         new = got.snapshot
@@ -1622,7 +1658,8 @@ class TpuCheckEngine:
         # to the full ingest+build path
         snap = retry_call(
             lambda: snapcache.load_latest(
-                self._cache_dir, max_watermark=store_wm, stats=self.maintenance
+                self._cache_dir, max_watermark=store_wm, stats=self.maintenance,
+                sorter=self._build_sorter,
             ),
             max_wait_s=2.0,
             base_s=0.05,
@@ -1670,7 +1707,8 @@ class TpuCheckEngine:
 
         faults.check("cache-save")
         t0 = time.monotonic()
-        path = snapcache.save_snapshot(snap, self._cache_dir)
+        with self.build_progress.phase("cache_save"):
+            path = snapcache.save_snapshot(snap, self._cache_dir)
         if path is not None:
             self.maintenance.incr("cache_saves")
             self.maintenance.observe_ms(

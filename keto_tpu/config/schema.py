@@ -160,6 +160,16 @@ CONFIG_SCHEMA = {
                     "default": "",
                     "description": "Persistent XLA compilation cache directory (jax compilation_cache_dir). When set, compiled kernels survive process restarts — and boot warms the full slice-width ladder (BFS + label kernels) ahead of traffic, so the multi-second warmup/compile cost is paid once per binary instead of once per boot. Empty disables both.",
                 },
+                "device_build_enabled": {
+                    "type": "boolean",
+                    "default": True,
+                    "description": "Device-side snapshot construction: run the build's edge-scale stable sorts (device-id renumbering, ELL grouping, forward/transposed CSRs, list layouts — the O(E log E) tail of a full rebuild and of compaction's CSR splice) on the accelerator instead of host numpy. Bit-identical by the stable-sort contract and fuzz-asserted so; each dispatch is planned against the HBM governor as a transient 'build' allocation and falls back to the host path (same answers) under memory pressure. false pins the host path.",
+                },
+                "build_chunk_rows": {
+                    "type": "integer",
+                    "default": 262144,
+                    "description": "Rows per chunk of the streaming snapshot scan (the persisters' chunked-cursor seam): each chunk feeds the native intern worker pool while the cursor fetches the next, so store I/O overlaps interning during full rebuilds. Larger chunks amortize per-chunk overhead; smaller ones smooth the pipeline and bound buffered-chunk memory.",
+                },
                 "drain_timeout_s": {
                     "type": "number",
                     "default": 5.0,

@@ -68,8 +68,11 @@ FALLBACK_BUDGET_BYTES = 4 << 30
 #: of the budget — hysteresis so the ladder doesn't oscillate at the edge
 RESTORE_FRAC = 0.7
 
-#: the canonical ledger tags, in scrape order
-TAGS = ("snapshot", "overlay", "labels", "reverse", "warmup")
+#: the canonical ledger tags, in scrape order ("build" is the streaming
+#: snapshot pipeline's transient sort footprint — registered around each
+#: device-build dispatch and released before the snapshot installs,
+#: keto_tpu/graph/device_build.py GovernedSorter)
+TAGS = ("snapshot", "overlay", "labels", "reverse", "warmup", "build")
 
 #: the eviction ladder rung names, in descent order (the final "refuse
 #: the refresh" step is not a rung — it is plan() returning False).

@@ -151,6 +151,17 @@ class HealthMonitor:
                 reason += f"; last refresh error: {err}"
             return HealthState.NOT_SERVING, reason
         if not h.get("has_snapshot", True):
+            # a 50M-tuple cold start builds for minutes — the streaming
+            # pipeline's progress (keto_tpu/graph/stream_build.py) is the
+            # boot heartbeat, so STARTING reads as alive, not hung
+            phase = h.get("build_phase")
+            if phase and phase != "idle":
+                pct = float(h.get("build_pct") or 0.0)
+                return (
+                    HealthState.STARTING,
+                    f"building first snapshot: phase={phase} ({pct:.0%}, "
+                    f"{int(h.get('build_rows_ingested') or 0)} rows ingested)",
+                )
             return HealthState.STARTING, "first snapshot not built yet"
         if int(h.get("audit_mismatches", 0) or 0) > 0:
             # the one alarm that must never be rationalized away: a
@@ -179,6 +190,27 @@ class HealthMonitor:
                 "the staleness budget",
             )
         return HealthState.SERVING, ""
+
+    def starting_detail(self) -> dict:
+        """``{"phase": ..., "pct": ...}`` of an in-flight first build
+        (the streaming pipeline's progress), or ``{}`` — REST
+        ``/health/ready`` merges this into the STARTING body so a
+        multi-minute boot reports where it is instead of a bare state."""
+        eng = self._engine
+        if eng is None or not hasattr(eng, "health"):
+            return {}
+        try:
+            h = eng.health()
+        except Exception:
+            return {}
+        phase = h.get("build_phase")
+        if not phase or phase == "idle":
+            return {}
+        return {
+            "phase": str(phase),
+            "pct": round(float(h.get("build_pct") or 0.0), 3),
+            "rows_ingested": int(h.get("build_rows_ingested") or 0),
+        }
 
     def _record(self, state: HealthState) -> None:
         stats = getattr(self._engine, "maintenance", None)

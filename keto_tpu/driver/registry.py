@@ -168,6 +168,12 @@ class Registry:
                     audit_sample_rate=float(
                         self._config.get("serve.audit_sample_rate", 0.0)
                     ),
+                    device_build_enabled=bool(
+                        self._config.get("serve.device_build_enabled", True)
+                    ),
+                    build_chunk_rows=int(
+                        self._config.get("serve.build_chunk_rows", 262144)
+                    ),
                 )
                 # mirror per-slice service times into /metrics — the same
                 # numbers the adaptive width controller steers by
@@ -176,6 +182,19 @@ class Registry:
                         "keto_engine_stream_slice_duration_seconds",
                         "Per-slice device service time of the streaming "
                         "check pipeline (what StreamSliceController steers by).",
+                    )
+                )
+                # mirror build-pipeline phase durations the same way —
+                # the phases bench grades are the phases operators scrape
+                engine.build_progress.attach_histogram(
+                    self.metrics().histogram(
+                        "keto_build_phase_duration_seconds",
+                        "Wall time per streaming-build pipeline phase "
+                        "(scan / intern / device_build / labels / "
+                        "cache_save), one histogram series per phase.",
+                        ("phase",),
+                        buckets=(0.01, 0.05, 0.25, 1.0, 5.0, 15.0, 60.0,
+                                 300.0, 1200.0),
                     )
                 )
                 return engine
@@ -335,6 +354,18 @@ class Registry:
                 "keto_engine_stream_slice_duration_seconds",
                 "Per-slice device service time of the streaming check "
                 "pipeline (what StreamSliceController steers by).",
+            )
+            # streaming-build pipeline phases (declared eagerly so a
+            # scrape before the first build exposes the family; the
+            # engine attaches the same instrument in permission_engine())
+            m.histogram(
+                "keto_build_phase_duration_seconds",
+                "Wall time per streaming-build pipeline phase "
+                "(scan / intern / device_build / labels / "
+                "cache_save), one histogram series per phase.",
+                ("phase",),
+                buckets=(0.01, 0.05, 0.25, 1.0, 5.0, 15.0, 60.0,
+                         300.0, 1200.0),
             )
             # request families are declared eagerly (the serving layers
             # re-declare idempotently) so a scrape before first traffic
@@ -508,6 +539,53 @@ class Registry:
             "label build/patch/invalidation events ride "
             "keto_maintenance_events_total.",
             label_coverage,
+        )
+
+        # streaming snapshot build (keto_tpu/graph/stream_build.py): the
+        # live pipeline phase plus cumulative ingest counters, read from
+        # the engine's BuildProgress at scrape time — a multi-minute
+        # STARTING boot is visibly alive on /metrics too
+        from keto_tpu.graph.stream_build import PHASES as BUILD_PHASES
+
+        def build_progress():
+            engine = self.peek("permission_engine")
+            return getattr(engine, "build_progress", None)
+
+        def build_phase():
+            bp = build_progress()
+            current = bp.current_phase if bp is not None else "idle"
+            return [
+                ((p,), 1.0 if p == current else 0.0)
+                for p in ("idle",) + BUILD_PHASES
+            ]
+
+        m.register_callback(
+            "keto_build_phase", "gauge",
+            "Streaming-build pipeline phase, one-hot over idle/scan/"
+            "intern/device_build/labels/cache_save — nonzero off idle "
+            "means a snapshot build is in flight.",
+            build_phase, ("phase",),
+        )
+
+        def build_attr(attr):
+            def read():
+                bp = build_progress()
+                yield (), float(getattr(bp, attr, 0) if bp is not None else 0)
+
+            return read
+
+        m.register_callback(
+            "keto_build_rows_ingested_total", "counter",
+            "Store rows scanned+interned by snapshot builds since boot "
+            "(cumulative across rebuilds; rate it to watch a cold start "
+            "make progress).",
+            build_attr("rows_ingested"),
+        )
+        m.register_callback(
+            "keto_build_edges_ingested_total", "counter",
+            "Graph edges laid out by snapshot builds since boot "
+            "(cumulative across rebuilds).",
+            build_attr("edges_ingested"),
         )
 
         def overlay_gauge(key):

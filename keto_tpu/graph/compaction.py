@@ -95,11 +95,16 @@ def _removed_mask(keys: np.ndarray, removed: Optional[np.ndarray]) -> np.ndarray
 
 
 def compact_snapshot(
-    snap: GraphSnapshot, max_ext: int = 65536
+    snap: GraphSnapshot, max_ext: int = 65536, sorter=None
 ) -> Optional[CompactionResult]:
     """Fold ``snap``'s overlay into its base layout. Returns the compacted
     snapshot (same watermark, no overlay) plus the touched bucket indices,
-    or ``None`` when the shape requires a full rebuild."""
+    or ``None`` when the shape requires a full rebuild. ``sorter`` is the
+    stable-argsort backend (keto_tpu/graph/device_build.py): the fold's
+    expensive tail — re-deriving the transposed CSR and both list layouts
+    from the spliced forward CSR — runs its edge-scale sorts on the
+    device when given, bit-identically (the splice itself is O(E)
+    vectorized scatters and stays host-side)."""
     if not snap.has_overlay:
         return CompactionResult(snapshot=snap)
 
@@ -392,10 +397,10 @@ def compact_snapshot(
 
     n_nodes_new = new_indptr.shape[0] - 1
     new_snap.rev_indptr, new_snap.rev_indices = build_rev_csr(
-        new_indptr, new_indices, n_nodes_new
+        new_indptr, new_indices, n_nodes_new, sorter=sorter
     )
     new_snap.lay_fwd, new_snap.lay_rev = build_list_layouts(
-        new_indptr, new_indices, n_nodes_new, new_snap.sink_base
+        new_indptr, new_indices, n_nodes_new, new_snap.sink_base, sorter=sorter
     )
     # reuse untouched device buckets; the engine re-uploads the touched set
     if snap.device_buckets is not None:

@@ -1,0 +1,204 @@
+"""Device-side snapshot construction: stable sorts on the accelerator.
+
+Building a 50M-tuple snapshot is dominated by O(E log E) host sorts: the
+device-id renumbering lexsort, the ELL edge grouping, the forward CSR,
+the sink reverse CSR, the transposed CSR, and both reverse-query list
+layouts — six stable argsorts over edge-scale arrays executed serially
+by numpy (keto_tpu/graph/snapshot.py documents each). TrieJax's framing
+(PAPERS.md) applies directly: they are relational sort/group-by passes
+that map cleanly onto the accelerator.
+
+This module provides the **sorter seam** those builders now go through:
+
+- ``HostSorter`` — ``np.argsort(kind="stable")``, the legacy path and
+  the bit-exactness oracle;
+- ``DeviceSorter`` — the same stable argsort executed by ``jax.lax.sort``
+  (via ``jnp.argsort(stable=True)``), batched so one build round-trips
+  the device a handful of times (``argsort_many`` fuses independent
+  sorts into one dispatch) instead of once per numpy pass.
+
+**Bit-identity is the contract, not a goal.** Every key array the build
+sorts is integral and fits int32 (device ids and edge endpoints are
+int32 throughout the layout), and a stable sort over equal integer keys
+is unique — so the permutation the device returns is *defined* to equal
+the host one, and tests/test_streaming_build.py fuzz-asserts byte
+equality of every derived snapshot array. Anything non-sort (searchsorted
+offsets, bucket scatters) stays on host over the returned permutations:
+those passes are O(E) memcpy-speed and keeping them host-side keeps the
+two paths one code path.
+
+The engine registers the transient sort footprint with the HBM governor
+under the ``build`` tag and falls back to ``HostSorter`` (same answers,
+host speed) when the plan does not fit — a cold start must never evict
+serving state just to build faster (keto_tpu/driver/hbm.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+_log = logging.getLogger("keto_tpu.device_build")
+
+#: device builds below this edge count are not worth the dispatch +
+#: transfer overhead; the engine compares against max(n_nodes, n_edges)
+DEFAULT_MIN_EDGES = 65536
+
+_jit_lock = threading.Lock()
+_jit_cache: dict[int, object] = {}
+
+
+def _sort_fn(n_arrays: int):
+    """A jitted function computing ``n_arrays`` independent stable
+    argsorts in one dispatch. Cached per arity; XLA caches per shape."""
+    fn = _jit_cache.get(n_arrays)
+    if fn is None:
+        with _jit_lock:
+            fn = _jit_cache.get(n_arrays)
+            if fn is None:
+                import jax
+                import jax.numpy as jnp
+
+                def many(*keys):
+                    return tuple(jnp.argsort(k, stable=True) for k in keys)
+
+                fn = jax.jit(many)
+                _jit_cache[n_arrays] = fn
+    return fn
+
+
+class HostSorter:
+    """The numpy stable-argsort backend (the legacy build path)."""
+
+    backend = "host"
+
+    def argsort(self, keys: np.ndarray) -> np.ndarray:
+        return np.argsort(keys, kind="stable").astype(np.int64, copy=False)
+
+    def argsort_many(self, arrays: Sequence[np.ndarray]) -> list:
+        return [self.argsort(a) for a in arrays]
+
+
+class DeviceSorter:
+    """Stable argsorts executed on the accelerator.
+
+    Keys are downcast to int32 before upload (jax's default x64-disabled
+    mode would silently truncate int64 anyway): every build key — bucket
+    keys, device ids, CSR endpoints — fits int32 by construction, and
+    sorting the int32 copies yields the identical permutation. A key
+    outside int32 range raises instead of corrupting (never observed:
+    node counts are bounded far below 2^31 by the int32 CSR layout)."""
+
+    backend = "device"
+
+    def _prep(self, keys: np.ndarray) -> np.ndarray:
+        a = np.asarray(keys)
+        if a.dtype != np.int32:
+            if a.size and (int(a.min()) < -(2**31) or int(a.max()) >= 2**31):
+                raise OverflowError("build sort key outside int32 range")
+            a = a.astype(np.int32)
+        return a
+
+    def argsort(self, keys: np.ndarray) -> np.ndarray:
+        return self.argsort_many([keys])[0]
+
+    def argsort_many(self, arrays: Sequence[np.ndarray]) -> list:
+        """All permutations in one device dispatch (the "one device
+        pass" over the interned edge array: independent sorts fuse)."""
+        prepped = [self._prep(a) for a in arrays]
+        fn = _sort_fn(len(prepped))
+        outs = fn(*prepped)
+        return [np.asarray(o).astype(np.int64, copy=False) for o in outs]
+
+
+_HOST = HostSorter()
+
+
+def host_sorter() -> HostSorter:
+    return _HOST
+
+
+def device_available() -> bool:
+    """True when a jax backend exists to sort on. Cheap after first call."""
+    try:
+        import jax
+
+        return len(jax.local_devices()) > 0
+    except Exception:
+        return False
+
+
+def estimate_sort_bytes(n_nodes: int, n_edges: int) -> int:
+    """Transient device bytes a full build's sorts peak at: keys + iota +
+    sorted outputs for the largest concurrent batch (3 edge-scale sorts),
+    plus the node-scale renumbering sort. int32 everywhere; XLA holds
+    input and output buffers live across the fused sort."""
+    per_edge_sort = 4 * 4  # key in, iota, sorted key, sorted iota
+    return 3 * per_edge_sort * max(1, n_edges) + per_edge_sort * max(1, n_nodes)
+
+
+class GovernedSorter:
+    """The engine's build-sort policy: each argsort batch runs on the
+    device when (a) a backend exists, (b) the largest array clears
+    ``min_size`` (below it dispatch overhead wins), and (c) the HBM
+    governor's transient plan fits WITHOUT evicting — a build must never
+    push serving state off the chip just to finish faster; under
+    pressure it falls back to the host path bit-identically. The
+    transient footprint is ledgered under the ``build`` tag for the
+    duration of the dispatch, and failures of any kind demote to host
+    (counted as ``device_build_errors``; answers unchanged)."""
+
+    backend = "governed"
+
+    def __init__(self, hbm=None, *, min_size: int = DEFAULT_MIN_EDGES, stats=None):
+        self._dev = make_device_sorter()
+        self._host = host_sorter()
+        self._hbm = hbm
+        self._min_size = int(min_size)
+        self._stats = stats  # MaintenanceStats or None
+
+    def _incr(self, key: str) -> None:
+        if self._stats is not None:
+            self._stats.incr(key)
+
+    def argsort(self, keys: np.ndarray) -> np.ndarray:
+        return self.argsort_many([keys])[0]
+
+    def argsort_many(self, arrays: Sequence[np.ndarray]) -> list:
+        arrays = [np.asarray(a) for a in arrays]
+        if self._dev is None or max((a.size for a in arrays), default=0) < self._min_size:
+            return self._host.argsort_many(arrays)
+        need = sum(16 * a.size for a in arrays)
+        gov = self._hbm
+        if gov is not None:
+            if not gov.plan(need, what="device build transient", evict=False):
+                # memory pressure: the build yields, serving state stays
+                self._incr("device_build_skipped")
+                return self._host.argsort_many(arrays)
+            gov.register("build", need)
+        try:
+            out = self._dev.argsort_many(arrays)
+            self._incr("device_build_dispatches")
+            return out
+        except Exception:
+            _log.warning(
+                "device build sort failed; falling back to host (bit-identical)",
+                exc_info=True,
+            )
+            self._incr("device_build_errors")
+            return self._host.argsort_many(arrays)
+        finally:
+            if gov is not None:
+                gov.release("build")
+
+
+def make_device_sorter() -> Optional[DeviceSorter]:
+    """A ``DeviceSorter`` when a backend is present, else None. The
+    caller gates on size and on the HBM governor's plan; this only
+    answers "is there hardware"."""
+    if not device_available():
+        return None
+    return DeviceSorter()

@@ -313,114 +313,158 @@ class ExtendedInterned:
         return self._ext_leaves[idx - self._base_num_leaves]
 
 
+class IncrementalInterner:
+    """Chunk-incremental interning with the exact ``intern_rows``
+    semantics: feed row chunks in store ORDER BY order via ``add_rows``
+    and ``finish()`` returns the same ``InternedGraph`` a single pass
+    over the concatenated stream would produce (ids and field codes are
+    assigned in first-occurrence order, which chunking cannot change).
+
+    This is the Python half of the streaming scan+intern pipeline
+    (keto_tpu/graph/stream_build.py): the SQL cursor hands over chunks
+    as they arrive instead of materializing the full table first. The
+    native streaming builder (native/ingest.cpp stream_build_*) is the
+    parallel counterpart; both are fuzz-asserted bit-identical."""
+
+    def __init__(self, wild_ns_ids: FrozenSet[int] = frozenset()):
+        self._wild_ns_ids = wild_ns_ids
+        self._set_ids: dict[tuple[int, str, str], int] = {}
+        self._leaf_ids: dict[str, int] = {}
+        self._objc = _Codes()
+        self._relc = _Codes()
+        # pass-1 accumulators (per-tuple field codes + subject raw kind)
+        self._t_lhs: list[int] = []
+        self._t_ns: list[int] = []
+        self._t_obj: list[int] = []
+        self._t_rel: list[int] = []
+        self._t_sub_kind: list[int] = []
+        self._t_sub_idx: list[int] = []
+
+    @property
+    def rows_seen(self) -> int:
+        return len(self._t_lhs)
+
+    def add_rows(self, rows: Iterable) -> None:
+        """Intern one chunk (pass 1); chunks must arrive in stream order."""
+        set_ids = self._set_ids
+        leaf_ids = self._leaf_ids
+        objc, relc = self._objc, self._relc
+
+        def set_node(ns_id: int, obj: str, rel: str) -> int:
+            key = (ns_id, obj, rel)
+            idx = set_ids.get(key)
+            if idx is None:
+                idx = len(set_ids)
+                set_ids[key] = idx
+                # intern field codes at node creation so code numbering
+                # matches the native interner exactly (ingest.cpp set_node)
+                objc.code(obj)
+                relc.code(rel)
+            return idx
+
+        def leaf_node(s: str) -> int:
+            idx = leaf_ids.get(s)
+            if idx is None:
+                idx = len(leaf_ids)
+                leaf_ids[s] = idx
+            return idx
+
+        t_lhs, t_ns = self._t_lhs, self._t_ns
+        t_obj, t_rel = self._t_obj, self._t_rel
+        t_sub_kind, t_sub_idx = self._t_sub_kind, self._t_sub_idx
+        for r in rows:
+            lhs = set_node(r.namespace_id, r.object, r.relation)
+            t_lhs.append(lhs)
+            t_ns.append(r.namespace_id)
+            t_obj.append(objc.code(r.object))
+            t_rel.append(relc.code(r.relation))
+            if r.subject_id is not None:
+                t_sub_kind.append(LEAF_KIND)
+                t_sub_idx.append(leaf_node(r.subject_id))
+            else:
+                t_sub_kind.append(SET_KIND)
+                t_sub_idx.append(
+                    set_node(r.sset_namespace_id, r.sset_object, r.sset_relation)
+                )
+
+    def finish(self) -> InternedGraph:
+        """Pass 2 over the accumulated per-tuple arrays: key arrays,
+        wildcard edge expansion, first-occurrence edge dedup."""
+        wild_ns_ids = self._wild_ns_ids
+        set_ids = self._set_ids
+        leaf_ids = self._leaf_ids
+        objc, relc = self._objc, self._relc
+        num_sets = len(set_ids)
+        key_ns = np.empty(num_sets, np.int64)
+        key_obj = np.empty(num_sets, np.int64)
+        key_rel = np.empty(num_sets, np.int64)
+        wild = np.zeros(num_sets, bool)
+        for (ns_id, obj, rel), i in set_ids.items():
+            key_ns[i] = ns_id
+            key_obj[i] = objc.code(obj)
+            key_rel[i] = relc.code(rel)
+            wild[i] = (ns_id in wild_ns_ids) or obj == "" or rel == ""
+        # resolve after the loop above — "" may first intern via a set key
+        empty_obj = objc.by_str.get("")
+        empty_rel = relc.by_str.get("")
+
+        tn = np.asarray(self._t_ns, np.int64)
+        to = np.asarray(self._t_obj, np.int64)
+        tr = np.asarray(self._t_rel, np.int64)
+        tl = np.asarray(self._t_lhs, np.int64)
+        tk = np.asarray(self._t_sub_kind, np.int64)
+        ti = np.asarray(self._t_sub_idx, np.int64)
+        t_sub_raw = np.where(tk == SET_KIND, ti, ti + num_sets)
+
+        # edges: literal LHS nodes take their own tuples' subjects;
+        # wildcard-bearing set nodes take every matching tuple's subject
+        srcs = [tl[~wild[tl]]] if tl.size else [np.zeros(0, np.int64)]
+        dsts = [t_sub_raw[~wild[tl]]] if tl.size else [np.zeros(0, np.int64)]
+        for i in np.nonzero(wild)[0]:
+            m = np.ones(tl.shape[0], bool)
+            if key_ns[i] not in wild_ns_ids:
+                m &= tn == key_ns[i]
+            if key_obj[i] != empty_obj:
+                m &= to == key_obj[i]
+            if key_rel[i] != empty_rel:
+                m &= tr == key_rel[i]
+            srcs.append(np.full(int(m.sum()), i, np.int64))
+            dsts.append(t_sub_raw[m])
+
+        src = np.concatenate(srcs) if srcs else np.zeros(0, np.int64)
+        dst = np.concatenate(dsts) if dsts else np.zeros(0, np.int64)
+        if src.size:
+            # duplicate tuples produce duplicate store rows (random
+            # shard_id PK, reference relationtuples.go:135-138) but add
+            # nothing to reachability — dedup edges, keeping the FIRST
+            # occurrence in emission order. Rows arrive sorted in the
+            # store's ORDER BY, so a set node's surviving out-edge order
+            # is exactly the order the Manager pages that node's tuples —
+            # the expand engine's tree-child order rides on this.
+            packed = src * np.int64(num_sets + len(leaf_ids)) + dst
+            _, keep = np.unique(packed, return_index=True)
+            src, dst = src[np.sort(keep)], dst[np.sort(keep)]
+
+        return InternedGraph(
+            set_ids=set_ids,
+            leaf_ids=leaf_ids,
+            obj_codes=objc.by_str,
+            rel_codes=relc.by_str,
+            key_ns=key_ns,
+            key_obj=key_obj,
+            key_rel=key_rel,
+            key_wild=wild,
+            src=src,
+            dst=dst,
+        )
+
+
 def intern_rows(rows: Iterable, wild_ns_ids: FrozenSet[int] = frozenset()) -> InternedGraph:
     """Intern ``persistence.memory.InternalRow``-shaped rows (attributes:
     namespace_id, object, relation, subject_id | sset_*). ``wild_ns_ids`` are
-    the ids of namespaces whose configured *name* is the empty string."""
-    set_ids: dict[tuple[int, str, str], int] = {}
-    leaf_ids: dict[str, int] = {}
-    objc, relc = _Codes(), _Codes()
-
-    def set_node(ns_id: int, obj: str, rel: str) -> int:
-        key = (ns_id, obj, rel)
-        idx = set_ids.get(key)
-        if idx is None:
-            idx = len(set_ids)
-            set_ids[key] = idx
-            # intern field codes at node creation so code numbering matches
-            # the native interner exactly (native/ingest.cpp set_node)
-            objc.code(obj)
-            relc.code(rel)
-        return idx
-
-    def leaf_node(s: str) -> int:
-        idx = leaf_ids.get(s)
-        if idx is None:
-            idx = len(leaf_ids)
-            leaf_ids[s] = idx
-        return idx
-
-    # pass 1: intern nodes, collect per-tuple field codes + subject raw kind
-    t_lhs: list[int] = []
-    t_ns: list[int] = []
-    t_obj: list[int] = []
-    t_rel: list[int] = []
-    t_sub_kind: list[int] = []
-    t_sub_idx: list[int] = []
-    for r in rows:
-        lhs = set_node(r.namespace_id, r.object, r.relation)
-        t_lhs.append(lhs)
-        t_ns.append(r.namespace_id)
-        t_obj.append(objc.code(r.object))
-        t_rel.append(relc.code(r.relation))
-        if r.subject_id is not None:
-            t_sub_kind.append(LEAF_KIND)
-            t_sub_idx.append(leaf_node(r.subject_id))
-        else:
-            t_sub_kind.append(SET_KIND)
-            t_sub_idx.append(set_node(r.sset_namespace_id, r.sset_object, r.sset_relation))
-
-    num_sets = len(set_ids)
-    key_ns = np.empty(num_sets, np.int64)
-    key_obj = np.empty(num_sets, np.int64)
-    key_rel = np.empty(num_sets, np.int64)
-    wild = np.zeros(num_sets, bool)
-    for (ns_id, obj, rel), i in set_ids.items():
-        key_ns[i] = ns_id
-        key_obj[i] = objc.code(obj)
-        key_rel[i] = relc.code(rel)
-        wild[i] = (ns_id in wild_ns_ids) or obj == "" or rel == ""
-    # resolve after the loop above — "" may first be interned via a set key
-    empty_obj = objc.by_str.get("")
-    empty_rel = relc.by_str.get("")
-
-    tn = np.asarray(t_ns, np.int64)
-    to = np.asarray(t_obj, np.int64)
-    tr = np.asarray(t_rel, np.int64)
-    tl = np.asarray(t_lhs, np.int64)
-    tk = np.asarray(t_sub_kind, np.int64)
-    ti = np.asarray(t_sub_idx, np.int64)
-    t_sub_raw = np.where(tk == SET_KIND, ti, ti + num_sets)
-
-    # pass 2: edges. Literal LHS nodes take their own tuples' subjects;
-    # wildcard-bearing set nodes take the subjects of every matching tuple.
-    srcs = [tl[~wild[tl]]]
-    dsts = [t_sub_raw[~wild[tl]]]
-    for i in np.nonzero(wild)[0]:
-        m = np.ones(tl.shape[0], bool)
-        if key_ns[i] not in wild_ns_ids:
-            m &= tn == key_ns[i]
-        if key_obj[i] != empty_obj:
-            m &= to == key_obj[i]
-        if key_rel[i] != empty_rel:
-            m &= tr == key_rel[i]
-        srcs.append(np.full(int(m.sum()), i, np.int64))
-        dsts.append(t_sub_raw[m])
-
-    src = np.concatenate(srcs) if srcs else np.zeros(0, np.int64)
-    dst = np.concatenate(dsts) if dsts else np.zeros(0, np.int64)
-    if src.size:
-        # duplicate tuples produce duplicate store rows (random shard_id PK,
-        # reference internal/persistence/sql/relationtuples.go:135-138) but
-        # add nothing to reachability — dedup edges, keeping the FIRST
-        # occurrence in emission order. Rows arrive sorted in the store's
-        # ORDER BY (memory.InternalRow.sort_key), so a set node's surviving
-        # out-edge order is exactly the order the Manager pages that node's
-        # tuples — the expand engine's tree-child order rides on this
-        # (keto_tpu/expand/tpu_engine.py).
-        packed = src * np.int64(num_sets + len(leaf_ids)) + dst
-        _, keep = np.unique(packed, return_index=True)
-        src, dst = src[np.sort(keep)], dst[np.sort(keep)]
-
-    return InternedGraph(
-        set_ids=set_ids,
-        leaf_ids=leaf_ids,
-        obj_codes=objc.by_str,
-        rel_codes=relc.by_str,
-        key_ns=key_ns,
-        key_obj=key_obj,
-        key_rel=key_rel,
-        key_wild=wild,
-        src=src,
-        dst=dst,
-    )
+    the ids of namespaces whose configured *name* is the empty string.
+    One-shot wrapper over ``IncrementalInterner`` — the streaming build
+    feeds the same machinery chunk by chunk."""
+    it = IncrementalInterner(wild_ns_ids)
+    it.add_rows(rows)
+    return it.finish()

@@ -69,6 +69,16 @@ def load_library() -> Optional[ctypes.CDLL]:
                 + [pu, c] * 5
                 + [pc, c]
             )
+        if hasattr(lib, "stream_build_new"):
+            # streaming (chunk-fed) builder: scan chunks intern on a
+            # worker pool concurrently with the caller's next fetch
+            lib.stream_build_new.restype = p
+            lib.stream_build_new.argtypes = [ctypes.POINTER(c), c, c]
+            lib.stream_build_feed.restype = c
+            lib.stream_build_feed.argtypes = [p, ctypes.c_char_p, c, c]
+            lib.stream_build_finish.restype = p
+            lib.stream_build_finish.argtypes = [p]
+            lib.stream_build_abort.argtypes = [p]
         lib.graph_free.argtypes = [p]
         for fn in ("graph_num_sets", "graph_num_leaves", "graph_num_edges"):
             getattr(lib, fn).restype = c
@@ -372,6 +382,74 @@ def native_intern_columns(lib, columns: dict, wild_ns_ids) -> Optional[NativeInt
     if not handle:
         return None
     return NativeInterned(lib, handle)
+
+
+class NativeStreamBuilder:
+    """Chunk-fed native interner (native/ingest.cpp stream_build_*).
+
+    ``feed(rows)`` packs one scan chunk into the wire format and hands
+    it to the C++ worker pool — the call returns as soon as the chunk is
+    enqueued (or after blocking briefly on the bounded queue), so the
+    caller's next store fetch overlaps interning. ``finish()`` merges
+    the per-chunk shards in feed order, which reproduces the one-shot
+    build's first-occurrence ids bit-identically
+    (tests/test_streaming_build.py asserts equality against both the
+    one-shot native path and the Python interner).
+
+    A chunk the packer cannot frame (strings containing the separator
+    control bytes — nothing legitimate does) poisons the native stream;
+    ``feed`` then returns False and the caller falls back to the Python
+    interner over its accumulated rows.
+    """
+
+    def __init__(self, lib: ctypes.CDLL, wild_ns_ids):
+        self._lib = lib
+        wild = np.asarray(sorted(wild_ns_ids), np.int64)
+        self._handle = lib.stream_build_new(
+            wild.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(wild), 0
+        )
+        self._dead = self._handle is None or not self._handle
+
+    @classmethod
+    def create(cls, wild_ns_ids) -> Optional["NativeStreamBuilder"]:
+        lib = load_library()
+        if lib is None or not hasattr(lib, "stream_build_new"):
+            return None
+        sb = cls(lib, wild_ns_ids)
+        return None if sb._dead else sb
+
+    def feed(self, rows: list) -> bool:
+        """Enqueue one chunk; False when the stream is unusable (framing
+        rejection or an earlier malformed chunk)."""
+        if self._dead:
+            return False
+        buf = pack_rows(rows)
+        if buf.count(_FIELD) != 6 * len(rows) or buf.count(_RECORD) != len(rows):
+            self.abort()
+            return False
+        rc = self._lib.stream_build_feed(self._handle, buf, len(buf), len(rows))
+        if rc != 0:
+            self.abort()
+            return False
+        return True
+
+    def finish(self) -> Optional[NativeInterned]:
+        """Join the workers and merge; None when the stream died (the
+        caller falls back to the Python interner)."""
+        if self._dead:
+            return None
+        handle = self._lib.stream_build_finish(self._handle)
+        self._handle = None
+        self._dead = True
+        if not handle:
+            return None
+        return NativeInterned(self._lib, handle)
+
+    def abort(self) -> None:
+        if not self._dead:
+            self._lib.stream_build_abort(self._handle)
+            self._handle = None
+            self._dead = True
 
 
 def native_intern_rows(

@@ -38,9 +38,11 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 import threading
 import zlib
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Optional
 
@@ -50,17 +52,41 @@ from keto_tpu.graph.snapshot import Bucket, GraphSnapshot
 
 #: bump when the on-disk layout or the snapshot's array semantics change —
 #: the version is part of the directory key, so old caches are simply
-#: never matched (and pruned as newer saves land). v2: per-segment
-#: checksums in meta.json + fsync-before-rename durability. v3: 2-hop
-#: reachability label arrays (keto_tpu/graph/labels.py) ride along, so a
-#: cold start skips label construction too.
-FORMAT_VERSION = 4
+#: never matched (and pruned as newer saves land — retention is
+#: format-version-aware, see ``_prune``). v2: per-segment checksums in
+#: meta.json + fsync-before-rename durability. v3: 2-hop reachability
+#: label arrays (keto_tpu/graph/labels.py) ride along, so a cold start
+#: skips label construction too. v4: reverse-query orientations
+#: (transposed CSR). v5: SEGMENTED layout — segments are grouped by the
+#: pipeline stage that produces them (``meta.json`` ``groups``), written
+#: in stage order at save time and verified+mapped in parallel at load,
+#: so a mesh cold-starts shards concurrently and a single process's
+#: reload is bounded by the slowest group, not the sum.
+FORMAT_VERSION = 5
 
-#: caches kept per directory (newest watermarks win)
+#: caches kept per format version within a directory (newest watermarks
+#: win). Retention never reaches across versions: a v(N-1) cache written
+#: by the previous binary survives a vN upgrade until ITS version
+#: accumulates KEEP newer caches — so a rollback (or a not-yet-upgraded
+#: replica sharing the directory) always finds a loadable cache.
 KEEP = 2
 
 #: quarantined (corrupt) caches kept for forensics; older ones drop
 QUARANTINE_KEEP = 2
+
+#: segment-group of each segment file, by the pipeline stage that
+#: produces it: "core" lands with the device build (CSRs, buckets,
+#: renumbering), "interner" with the string tables, "reverse" with the
+#: transposed orientation, "labels" with the 2-hop index. The loader
+#: verifies and maps groups concurrently.
+def _group_of(name: str) -> str:
+    if name.startswith(("rev_",)):
+        return "reverse"
+    if name.startswith("lab_"):
+        return "labels"
+    if name.startswith(("key_", "set_", "obj_", "rel_", "leaf_")):
+        return "interner"
+    return "core"
 
 
 class CacheCorrupt(ValueError):
@@ -356,12 +382,14 @@ def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
         # protocol, bit rot, a truncating copy) is DETECTED and the cache
         # quarantined instead of silently yielding wrong decisions.
         segments = {}
+        groups: dict[str, list] = {}
         for f in sorted(tmp.iterdir()):
             _fsync_file(f)  # durable before the rename publishes them
             segments[f.name] = {
                 "size": f.stat().st_size,
                 "crc32": _file_crc(f),
             }
+            groups.setdefault(_group_of(f.name), []).append(f.name)
         meta = {
             "format": FORMAT_VERSION,
             "watermark": int(snap.snapshot_id),
@@ -377,6 +405,7 @@ def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
             "n_rel": int(n_rel),
             "labels": lab_meta,
             "segments": segments,
+            "groups": groups,
         }
         (tmp / "meta.json").write_text(json.dumps(meta))
         _fsync_file(tmp / "meta.json")
@@ -401,22 +430,31 @@ def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
 
 
 def _prune(base: Path, keep: int) -> None:
-    """Drop all but the ``keep`` newest caches of the CURRENT format (a
-    format bump orphans old dirs — remove those too). Dot-prefixed
+    """Drop all but the ``keep`` newest caches PER FORMAT VERSION.
+
+    Retention is format-version-aware on purpose: pruning by mtime/
+    watermark across versions would let the first post-upgrade v5 save
+    evict the only v4 cache — and a rollback (or a replica still running
+    the previous binary against the same directory) would cold-start
+    from a full rebuild. Caches of other recognized versions age out
+    only against caches of their OWN version; directories that are not
+    ``v<N>-w<M>``-shaped at all are junk and removed. Dot-prefixed
     entries (in-flight ``.tmp-`` saves, ``.quarantine-`` forensics) are
     managed by their own lifecycles and skipped here."""
-    entries = []
+    by_fmt: dict[int, list] = {}
     for d in base.iterdir():
         if not d.is_dir() or d.name.startswith("."):
             continue
-        wm = _parse_tag(d.name)
-        if wm is None:
-            shutil.rmtree(d, ignore_errors=True)  # other-format leftovers
+        parsed = _parse_any_tag(d.name)
+        if parsed is None:
+            shutil.rmtree(d, ignore_errors=True)  # not a cache dir at all
         else:
-            entries.append((wm, d))
-    entries.sort(reverse=True)
-    for _, d in entries[keep:]:
-        shutil.rmtree(d, ignore_errors=True)
+            fmt, wm = parsed
+            by_fmt.setdefault(fmt, []).append((wm, d))
+    for entries in by_fmt.values():
+        entries.sort(reverse=True)
+        for _, d in entries[keep:]:
+            shutil.rmtree(d, ignore_errors=True)
 
 
 def _quarantine(d: Path, stats=None) -> None:
@@ -443,29 +481,48 @@ def _quarantine(d: Path, stats=None) -> None:
         shutil.rmtree(q, ignore_errors=True)
 
 
+#: verification workers: crc32 releases the GIL on large buffers, so a
+#: cold-start verify is parallel real I/O + checksum work, bounded by
+#: the slowest segment group instead of the byte sum
+VERIFY_WORKERS = 4
+
+
+def _verify_one(d: Path, name: str, want: dict) -> None:
+    f = d / name
+    if not f.is_file():
+        raise CacheCorrupt(f"{d.name}/{name}: segment missing")
+    size = f.stat().st_size
+    if size != want.get("size"):
+        raise CacheCorrupt(
+            f"{d.name}/{name}: size {size} != recorded {want.get('size')}"
+            " (torn write?)"
+        )
+    crc = _file_crc(f)
+    if crc != want.get("crc32"):
+        raise CacheCorrupt(
+            f"{d.name}/{name}: crc32 {crc:#x} != recorded "
+            f"{int(want.get('crc32', 0)):#x} (corrupt segment)"
+        )
+
+
 def _verify_segments(d: Path, meta: dict) -> None:
     """Integrity gate: every data file must match the manifest recorded
-    at save time, and no manifest entry may be missing. Raises
-    CacheCorrupt on the first mismatch."""
+    at save time, and no manifest entry may be missing. Segments verify
+    CONCURRENTLY (the v5 segmented layout's load-side win — zlib.crc32
+    releases the GIL, so checksum throughput scales with workers).
+    Raises CacheCorrupt on any mismatch."""
     segments = meta.get("segments")
     if not isinstance(segments, dict):
         raise CacheCorrupt(f"{d.name}: meta.json has no segment manifest")
-    for name, want in segments.items():
-        f = d / name
-        if not f.is_file():
-            raise CacheCorrupt(f"{d.name}/{name}: segment missing")
-        size = f.stat().st_size
-        if size != want.get("size"):
-            raise CacheCorrupt(
-                f"{d.name}/{name}: size {size} != recorded {want.get('size')}"
-                " (torn write?)"
-            )
-        crc = _file_crc(f)
-        if crc != want.get("crc32"):
-            raise CacheCorrupt(
-                f"{d.name}/{name}: crc32 {crc:#x} != recorded "
-                f"{int(want.get('crc32', 0)):#x} (corrupt segment)"
-            )
+    items = list(segments.items())
+    if len(items) <= 2:
+        for name, want in items:
+            _verify_one(d, name, want)
+        return
+    with ThreadPoolExecutor(max_workers=VERIFY_WORKERS) as pool:
+        futures = [pool.submit(_verify_one, d, name, want) for name, want in items]
+        for fut in futures:
+            fut.result()  # first corrupt segment propagates CacheCorrupt
 
 
 def _parse_tag(name: str) -> Optional[int]:
@@ -478,8 +535,24 @@ def _parse_tag(name: str) -> Optional[int]:
         return None
 
 
-def load_snapshot(path: str, verify: bool = True) -> GraphSnapshot:
+_ANY_TAG_RE = re.compile(r"^v(\d+)-w(\d+)$")
+
+
+def _parse_any_tag(name: str) -> Optional[tuple[int, int]]:
+    """``(format, watermark)`` for ANY version's cache directory, or
+    None for non-cache junk — retention (``_prune``) must recognize
+    other versions' caches without being able to load them."""
+    m = _ANY_TAG_RE.match(name)
+    if m is None:
+        return None
+    return int(m.group(1)), int(m.group(2))
+
+
+def load_snapshot(path: str, verify: bool = True, sorter=None) -> GraphSnapshot:
     """Reload one cached snapshot directory (mmap — arrays page lazily).
+    ``sorter`` rides into the list-layout re-derivation (the one
+    compute-bound step of a reload) so a cold start can run its sorts on
+    the device (keto_tpu/graph/device_build.py).
 
     ``verify`` checks every segment's size and crc32 against the manifest
     recorded at save time before anything is served from the cache —
@@ -544,13 +617,14 @@ def load_snapshot(path: str, verify: bool = True) -> GraphSnapshot:
     snap.rev_indices = mm("rev_indices.npy")
     fi = np.asarray(snap.fwd_indptr)
     snap.lay_fwd, snap.lay_rev = build_list_layouts(
-        fi, np.asarray(snap.fwd_indices), fi.shape[0] - 1, snap.sink_base
+        fi, np.asarray(snap.fwd_indices), fi.shape[0] - 1, snap.sink_base,
+        sorter=sorter,
     )
     return snap
 
 
 def load_latest(
-    cache_dir: str, max_watermark: Optional[int] = None, stats=None
+    cache_dir: str, max_watermark: Optional[int] = None, stats=None, sorter=None
 ) -> Optional[GraphSnapshot]:
     """Newest loadable cache under ``cache_dir`` with watermark ≤
     ``max_watermark`` (the store's current watermark — a cache AHEAD of
@@ -574,7 +648,7 @@ def load_latest(
         candidates.append((wm, d))
     for _, d in sorted(candidates, reverse=True):
         try:
-            snap = load_snapshot(str(d))
+            snap = load_snapshot(str(d), sorter=sorter)
             # the cold-start upload the HBM governor is about to plan
             # (keto_tpu/driver/hbm.py): surface its size at load time.
             # Counter-only stats sinks simply skip the gauge.

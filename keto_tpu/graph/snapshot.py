@@ -130,17 +130,33 @@ class ListLayout:
         return sum(int(np.asarray(b.nbrs).nbytes) for b in self.buckets)
 
 
-def _one_list_layout(rows_dev: np.ndarray, nbr_dev: np.ndarray, n_rows: int, orient: str) -> ListLayout:
+def _host_sorter():
+    """Default stable-argsort backend (lazy import — snapshot.py must
+    stay importable without the device_build module's jax probing)."""
+    from keto_tpu.graph.device_build import host_sorter
+
+    return host_sorter()
+
+
+def _one_list_layout(
+    rows_dev: np.ndarray, nbr_dev: np.ndarray, n_rows: int, orient: str,
+    sorter=None,
+) -> ListLayout:
     """Bucketize ``rows_dev[i] gathers nbr_dev[i]`` into a ListLayout
     over ``n_rows`` interior-class device ids (same machinery as the
     check buckets: pow2 degree buckets, pow2 row padding, contiguous
-    rows per bucket)."""
+    rows per bucket). ``sorter`` is the stable-argsort backend
+    (keto_tpu/graph/device_build.py); host and device produce identical
+    permutations by the stable-sort contract."""
+    S = sorter or _host_sorter()
     deg = np.bincount(rows_dev, minlength=n_rows) if rows_dev.size else np.zeros(n_rows, np.int64)
     with np.errstate(divide="ignore"):
         bkey = np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64) + 1
     bkey[deg <= 1] = 1
     bkey[deg == 0] = 63  # degree-0 rows sort last, outside every bucket
-    order = np.lexsort((np.arange(n_rows), bkey))
+    # np.lexsort((arange, bkey)) == stable argsort of bkey: the arange
+    # tie-break IS stability, so both backends share one primitive
+    order = S.argsort(bkey)
     dev2row = np.empty(n_rows, np.int64)
     dev2row[order] = np.arange(n_rows)
     n_active = int(np.count_nonzero(deg > 0))
@@ -148,7 +164,7 @@ def _one_list_layout(rows_dev: np.ndarray, nbr_dev: np.ndarray, n_rows: int, ori
     if rows_dev.size:
         r = dev2row[rows_dev]
         v = dev2row[nbr_dev].astype(np.int32)
-        eorder = np.argsort(r, kind="stable")
+        eorder = S.argsort(r)
         rs = r[eorder]
         vs = v[eorder]
         starts = np.searchsorted(rs, np.arange(n_active))
@@ -171,21 +187,24 @@ def _one_list_layout(rows_dev: np.ndarray, nbr_dev: np.ndarray, n_rows: int, ori
 
 
 def build_rev_csr(
-    fwd_indptr: np.ndarray, fwd_indices: np.ndarray, n_nodes: int
+    fwd_indptr: np.ndarray, fwd_indices: np.ndarray, n_nodes: int,
+    sorter=None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """The transposed CSR over ALL device ids: in-neighbors per node.
     Derived from the forward CSR in one stable sort, persisted by the
     snapshot cache so both orientations survive restarts."""
+    S = sorter or _host_sorter()
     src = np.repeat(np.arange(n_nodes, dtype=np.int64), np.diff(fwd_indptr))
     dst = fwd_indices.astype(np.int64)
-    rorder = np.argsort(dst, kind="stable")
+    rorder = S.argsort(dst)
     rev_indptr = np.searchsorted(dst[rorder], np.arange(n_nodes + 1))
     rev_indices = src[rorder].astype(np.int32)
     return rev_indptr, rev_indices
 
 
 def build_list_layouts(
-    fwd_indptr: np.ndarray, fwd_indices: np.ndarray, n_nodes: int, sink_base: int
+    fwd_indptr: np.ndarray, fwd_indices: np.ndarray, n_nodes: int, sink_base: int,
+    sorter=None,
 ) -> tuple[ListLayout, ListLayout]:
     """Both reverse-query orientations over the interior-class subgraph
     (device ids < ``sink_base``), from the forward CSR. Shared by the
@@ -194,8 +213,8 @@ def build_list_layouts(
     src = np.repeat(np.arange(n_nodes, dtype=np.int64), np.diff(fwd_indptr))
     dst = fwd_indices.astype(np.int64)
     m = (src < sink_base) & (dst < sink_base)
-    lay_fwd = _one_list_layout(dst[m], src[m], sink_base, "fwd")
-    lay_rev = _one_list_layout(src[m], dst[m], sink_base, "rev")
+    lay_fwd = _one_list_layout(dst[m], src[m], sink_base, "fwd", sorter=sorter)
+    lay_rev = _one_list_layout(src[m], dst[m], sink_base, "rev", sorter=sorter)
     return lay_fwd, lay_rev
 
 
@@ -851,6 +870,8 @@ def build_snapshot(
     wild_ns_ids: FrozenSet[int] = frozenset(),
     peel_seed_cap: float = 4.0,
     columns: Optional[dict] = None,
+    sorter=None,
+    progress=None,
 ) -> GraphSnapshot:
     """Intern rows and lay out the bucketed reverse-ELL adjacency.
 
@@ -859,17 +880,68 @@ def build_snapshot(
     runs in the native C++ path when ``native/libketoingest.so`` is built
     (``make native``), else in Python. ``columns`` is the store's optional
     sorted column bundle (MemoryPersister.snapshot_columns) — the
-    zero-extraction interning input.
+    zero-extraction interning input. ``sorter``/``progress`` ride through
+    to ``layout_snapshot`` (device-side build + the build-progress
+    observability seam); the streaming pipeline
+    (keto_tpu/graph/stream_build.py) interns incrementally and calls
+    ``layout_snapshot`` directly.
     """
     rows = list(rows)
     from keto_tpu.graph.native import native_intern_rows
 
-    g = native_intern_rows(rows, wild_ns_ids, columns=columns)
-    if g is None:
-        g = intern_rows(rows, wild_ns_ids)
+    if progress is not None:
+        with progress.phase("intern"):
+            g = native_intern_rows(rows, wild_ns_ids, columns=columns)
+            if g is None:
+                g = intern_rows(rows, wild_ns_ids)
+            progress.add_rows(len(rows))
+    else:
+        g = native_intern_rows(rows, wild_ns_ids, columns=columns)
+        if g is None:
+            g = intern_rows(rows, wild_ns_ids)
+    return layout_snapshot(
+        g, watermark, wild_ns_ids, peel_seed_cap=peel_seed_cap,
+        sorter=sorter, progress=progress,
+    )
+
+
+def layout_snapshot(
+    g,
+    watermark: int,
+    wild_ns_ids: FrozenSet[int] = frozenset(),
+    peel_seed_cap: float = 4.0,
+    sorter=None,
+    progress=None,
+) -> GraphSnapshot:
+    """Lay out an already-interned graph ``g`` (InternedGraph or
+    NativeInterned) into the device snapshot: classify/peel, renumber,
+    bucket, and derive the forward CSR, sink reverse CSR, transposed
+    CSR, and both list layouts. Every O(E log E) stable sort goes
+    through ``sorter`` (keto_tpu/graph/device_build.py) — the device
+    backend runs them on the accelerator in fused dispatches, the host
+    backend is the legacy numpy path; both are bit-identical by the
+    stable-sort contract and fuzz-asserted so
+    (tests/test_streaming_build.py)."""
+    if progress is not None:
+        ctx = progress.phase("device_build")
+        ctx.__enter__()
+    S = sorter or _host_sorter()
     src_raw, dst_raw = g.src, g.dst
     n = g.num_nodes
+    try:
+        snap = _layout_snapshot_inner(
+            g, watermark, wild_ns_ids, peel_seed_cap, S, src_raw, dst_raw, n
+        )
+    finally:
+        if progress is not None:
+            progress.add_edges(int(np.asarray(src_raw).shape[0]))
+            ctx.__exit__(None, None, None)
+    return snap
 
+
+def _layout_snapshot_inner(
+    g, watermark, wild_ns_ids, peel_seed_cap, S, src_raw, dst_raw, n
+) -> GraphSnapshot:
     if n == 0:
         return GraphSnapshot(
             snapshot_id=watermark,
@@ -964,8 +1036,10 @@ def build_snapshot(
     bucket_key[sink] = 63
     bucket_key[~has_in] = 64
 
-    # renumber: device order sorts by (bucket, raw id); raw2dev inverts it
-    dev_order = np.lexsort((np.arange(n), bucket_key))
+    # renumber: device order sorts by (bucket, raw id) — the raw-id
+    # tie-break IS stability, so lexsort((arange, key)) == stable
+    # argsort(key) and both sorter backends share one primitive
+    dev_order = S.argsort(bucket_key)
     raw2dev = np.empty(n, dtype=np.int64)
     raw2dev[dev_order] = np.arange(n)
 
@@ -974,11 +1048,23 @@ def build_snapshot(
     n_peeled = int(np.count_nonzero(peeled))
     num_live = int(np.count_nonzero(has_in))
 
-    # group ELL edges by destination device id; cumcount gives the column
-    # slot. Destinations of ELL edges are active-interior by construction.
+    # the three edge-scale groupings below (ELL by destination, forward
+    # CSR by source, sink reverse CSR by sink) are independent once
+    # raw2dev exists — one fused sorter dispatch covers all of them (on
+    # the device backend this is the single round trip over the interned
+    # edge array; the host backend just loops)
     dst_dev = raw2dev[dst_raw[ell_edge]]
     src_dev = raw2dev[src_raw[ell_edge]]
-    order = np.argsort(dst_dev, kind="stable")
+    all_src_dev = raw2dev[src_raw]
+    all_dst_dev = raw2dev[dst_raw]
+    s_edge = has_in[src_raw] & sink[dst_raw]
+    sink_base = num_int + n_peeled
+    s_dst = raw2dev[dst_raw[s_edge]] - sink_base
+    s_src = raw2dev[src_raw[s_edge]].astype(np.int32)
+    order, forder, sorder = S.argsort_many([dst_dev, all_src_dev, s_dst])
+
+    # group ELL edges by destination device id; cumcount gives the column
+    # slot. Destinations of ELL edges are active-interior by construction.
     dst_sorted = dst_dev[order]
     src_sorted = src_dev[order].astype(np.int32)
     starts = np.searchsorted(dst_sorted, np.arange(num_active))
@@ -999,20 +1085,12 @@ def build_snapshot(
 
     # host-side forward CSR over ALL edges (device ids) — used by expand
     # and by the batch-setup one-hop propagation from static start nodes
-    all_src_dev = raw2dev[src_raw]
-    all_dst_dev = raw2dev[dst_raw]
-    forder = np.argsort(all_src_dev, kind="stable")
     fsrc = all_src_dev[forder]
     findices = all_dst_dev[forder].astype(np.int32)
     findptr = np.searchsorted(fsrc, np.arange(n + 1))
 
     # sink reverse CSR: interior in-neighbors per sink, for answer gathers
     # (all unpeeled by construction — see the peel note above)
-    s_edge = has_in[src_raw] & sink[dst_raw]
-    sink_base = num_int + n_peeled
-    s_dst = raw2dev[dst_raw[s_edge]] - sink_base
-    s_src = raw2dev[src_raw[s_edge]].astype(np.int32)
-    sorder = np.argsort(s_dst, kind="stable")
     n_sink = num_live - sink_base
     sink_indptr = np.searchsorted(s_dst[sorder], np.arange(n_sink + 1))
     sink_indices = s_src[sorder]
@@ -1021,8 +1099,8 @@ def build_snapshot(
     # device ids plus bucketed-ELL list layouts in BOTH orientations over
     # the interior-class rows — built here so every snapshot can answer
     # ListObjects/ListSubjects without a second interning pass
-    rev_indptr, rev_indices = build_rev_csr(findptr, findices, n)
-    lay_fwd, lay_rev = build_list_layouts(findptr, findices, n, sink_base)
+    rev_indptr, rev_indices = build_rev_csr(findptr, findices, n, sorter=S)
+    lay_fwd, lay_rev = build_list_layouts(findptr, findices, n, sink_base, sorter=S)
 
     return GraphSnapshot(
         snapshot_id=watermark,

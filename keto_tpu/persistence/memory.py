@@ -140,6 +140,30 @@ class InternalRow:
         )
 
 
+class _DeferredRows:
+    """A bulk load's row objects, not yet materialized.
+
+    Constructing tens of millions of ``InternalRow`` objects was the
+    single largest cost of a bulk load (BENCH_r05: most of the 50M-tuple
+    ingest wall) — and the cold-start path never reads them: the
+    snapshot builder interns straight from the sorted column bundle
+    (``snapshot_columns`` → native_intern_columns). So a bulk load into
+    an empty store parks this thunk in ``_SharedState.rows`` instead,
+    and the FIRST consumer that actually needs row objects (a Manager
+    read, a delete, a follow-up write, ``snapshot_rows``) materializes
+    them via ``MemoryPersister._rows`` — identical objects, identical
+    order, just paid off the cold-start path."""
+
+    __slots__ = ("_make", "n")
+
+    def __init__(self, make, n: int):
+        self._make = make
+        self.n = int(n)
+
+    def materialize(self) -> list:
+        return self._make()
+
+
 class _SharedState:
     """Rows shared across per-network persister views."""
 
@@ -214,7 +238,18 @@ class MemoryPersister(Manager):
     # -- helpers -------------------------------------------------------------
 
     def _rows(self) -> list[InternalRow]:
-        return self._shared.rows.setdefault(self.network_id, [])
+        """The network's row list, materializing a parked bulk load
+        (``_DeferredRows``) on first touch. Callers hold the shared
+        lock (every call site already does)."""
+        nid = self.network_id
+        got = self._shared.rows.get(nid)
+        if isinstance(got, _DeferredRows):
+            got = got.materialize()
+            self._shared.rows[nid] = got
+        elif got is None:
+            got = []
+            self._shared.rows[nid] = got
+        return got
 
     def _to_row(self, rt: RelationTuple) -> InternalRow:
         nm = self._nm()
@@ -235,8 +270,9 @@ class MemoryPersister(Manager):
 
     def _bulk_ingest(
         self, tuples_seq: Sequence[RelationTuple]
-    ) -> Optional[tuple[list[InternalRow], dict]]:
-        """Bulk tuples → sorted rows + sorted column bundle, in ONE column
+    ) -> Optional[tuple]:
+        """Bulk tuples → ``(make_rows thunk, sorted column bundle)``
+        where the thunk constructs the sorted rows, in ONE column
         pass. The store's ORDER BY runs as a numpy lexsort over column
         arrays — list.sort(key=sort_key) materializes a nested key tuple
         per row, which dominated bulk ingest at BASELINE scale — and row
@@ -329,18 +365,28 @@ class MemoryPersister(Manager):
             "ssr": ssr_v[perm],
         }
         seqs = list(itertools.islice(self._shared.seq, n))
-        rows: list[Optional[InternalRow]] = [None] * n
-        for out_i, i in enumerate(perm.tolist()):
-            if c_kind[i]:
-                rows[out_i] = InternalRow(
-                    c_ns[i], c_obj[i], c_rel[i], c_sid[i], None, None, None, seqs[i]
-                )
-            else:
-                rows[out_i] = InternalRow(
-                    c_ns[i], c_obj[i], c_rel[i], None, c_sns[i], c_sso[i],
-                    c_ssr[i], seqs[i],
-                )
-        return rows, bundle
+
+        def make_rows() -> list:
+            # row objects in sorted order, directly (no second
+            # permutation pass). Returned as a thunk so a bulk load into
+            # an empty store can DEFER the 50M-object construction off
+            # the cold-start path entirely (_DeferredRows) — the column
+            # bundle above is what the snapshot builder actually reads.
+            rows: list[Optional[InternalRow]] = [None] * n
+            for out_i, i in enumerate(perm.tolist()):
+                if c_kind[i]:
+                    rows[out_i] = InternalRow(
+                        c_ns[i], c_obj[i], c_rel[i], c_sid[i], None, None, None,
+                        seqs[i],
+                    )
+                else:
+                    rows[out_i] = InternalRow(
+                        c_ns[i], c_obj[i], c_rel[i], None, c_sns[i], c_sso[i],
+                        c_ssr[i], seqs[i],
+                    )
+            return rows
+
+        return make_rows, bundle
 
     def _to_tuple(self, row: InternalRow) -> RelationTuple:
         nm = self._nm()
@@ -392,7 +438,11 @@ class MemoryPersister(Manager):
         idx = self._shared.lhs_index
         if idx is None:
             idx = {}
-            for nid, rows in self._shared.rows.items():
+            for nid in list(self._shared.rows):
+                rows = self._shared.rows[nid]
+                if isinstance(rows, _DeferredRows):
+                    rows = rows.materialize()
+                    self._shared.rows[nid] = rows
                 for r in rows:
                     idx.setdefault((nid, r.namespace_id, r.object, r.relation), []).append(r)
             self._shared.lhs_index = idx
@@ -455,20 +505,16 @@ class MemoryPersister(Manager):
             faults.check("transact-commit")
             new_sorted: Optional[list[InternalRow]] = None
             bundle = None
-            if len(insert) >= 4096:
+            make_rows = None
+            n_ins = len(insert)
+            if n_ins >= 4096:
                 # bulk load: one column pass + numpy lexsort, rows emerge
                 # already in ORDER BY (per-row sort keys walled at tens of
                 # millions of rows), plus the interner's column bundle.
                 # None = batch unsafe for numpy columns → row path below.
                 got = self._bulk_ingest(insert)
                 if got is not None:
-                    new_sorted, bundle = got
-            if new_sorted is not None:
-                new_rows: Sequence[InternalRow] = new_sorted
-            else:
-                new_rows = [self._to_row(rt) for rt in insert]
-                if len(new_rows) > 256:
-                    new_sorted = sorted(new_rows, key=InternalRow.sort_key)
+                    make_rows, bundle = got
             delete_keys = []
             for rt in delete:
                 delete_keys.append(self._to_row(rt).key7())
@@ -479,7 +525,34 @@ class MemoryPersister(Manager):
             col_bundle = None
             if bundle is not None and not rows and not delete:
                 col_bundle = bundle
+            # a bulk load into an EMPTY store past the insert-log cap can
+            # park its row construction entirely (_DeferredRows): the
+            # snapshot builder reads the column bundle, the insert log
+            # takes the raise-the-floor path either way, and nothing else
+            # in this transaction touches row objects. The 50M-tuple cold
+            # start stops paying row materialization at all.
+            deferred = (
+                make_rows is not None
+                and col_bundle is not None
+                and not delete_keys
+                and n_ins > self._shared.LOG_CAP
+            )
+            if make_rows is not None and not deferred:
+                new_sorted = make_rows()
             if new_sorted is not None:
+                new_rows: Sequence[InternalRow] = new_sorted
+            elif deferred:
+                new_rows = ()
+            else:
+                new_rows = [self._to_row(rt) for rt in insert]
+                if len(new_rows) > 256:
+                    new_sorted = sorted(new_rows, key=InternalRow.sort_key)
+            if deferred:
+                self._shared.rows[self.network_id] = _DeferredRows(
+                    make_rows, n_ins
+                )
+                self._shared.lhs_index = None
+            elif new_sorted is not None:
                 if rows:
                     # linear merge keeps the store sorted without re-sorting
                     rows = list(
@@ -531,6 +604,12 @@ class MemoryPersister(Manager):
             nid = self.network_id
             if col_bundle is not None:
                 self._shared.col_cache[nid] = (wm, col_bundle)
+            if deferred:
+                # parked rows never enter the insert log (same contract
+                # as the over-cap bulk branch below: a delta spanning
+                # this batch can never be served — raise the floor)
+                self._shared.log_floor[nid] = wm
+                self._shared.insert_log[nid] = []
             if hit_keys:
                 # only EFFECTIVE deletes (matched ≥ 1 row) are recorded —
                 # same contract as the sqlite store, and what apply_delta's
@@ -585,6 +664,25 @@ class MemoryPersister(Manager):
         """Consistent (rows, watermark) view for the TPU graph builder."""
         with self._shared.lock:
             return list(self._rows()), self._shared.watermark
+
+    #: the in-memory store's one-shot paths (column bundle / columnar
+    #: extraction) beat chunked packing — the streaming pipeline only
+    #: prefers the chunk seam on stores with real scan I/O to overlap
+    scan_chunks_preferred = False
+
+    def snapshot_scan(self, on_chunk, chunk_rows: int = 262144) -> int:
+        """Chunked variant of ``snapshot_rows`` (the streaming-build
+        scan seam, keto_tpu/graph/stream_build.py): invokes ``on_chunk``
+        with consecutive row chunks in store ORDER BY order and returns
+        the watermark the chunks are consistent at. Chunks are handed
+        over outside the store lock (the list is copied under it)."""
+        with self._shared.lock:
+            rows = list(self._rows())
+            wm = self._shared.watermark
+        step = max(1, int(chunk_rows))
+        for i in range(0, len(rows), step):
+            on_chunk(rows[i : i + step])
+        return wm
 
     def snapshot_columns(self, watermark: int) -> Optional[dict]:
         """The bulk-load column bundle valid at ``watermark``, or None —

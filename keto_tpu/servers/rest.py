@@ -285,7 +285,8 @@ class RestApp:
         catches up."""
         from keto_tpu.driver.health import READY_STATES, HealthState
 
-        state, reason = self.registry.health_monitor().status()
+        monitor = self.registry.health_monitor()
+        state, reason = monitor.status()
         if state not in READY_STATES:
             body = {"status": "unavailable", "reason": reason or state.value}
             # backoff advice rides the 503: probes already poll on their
@@ -297,6 +298,11 @@ class RestApp:
         body = {"status": state.value}
         if reason:
             body["reason"] = reason
+        if state is HealthState.STARTING:
+            # a multi-minute streaming build narrates itself: the body
+            # carries {phase, pct} from the pipeline's progress tracker
+            # instead of leaving probes staring at a bare state
+            body.update(monitor.starting_detail())
         return 200, body, {}
 
     # -- read ----------------------------------------------------------------

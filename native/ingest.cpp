@@ -41,10 +41,12 @@
 // with the caller (graph_free).
 
 #include <algorithm>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <mutex>
 #include <string>
 #include <string_view>
 #include <thread>
@@ -524,9 +526,190 @@ void merge_shards(Graph* g, std::vector<Shard*>& shards, int64_t n) {
     }
 }
 
+// Parse one packed-record buffer (graph_build's wire format) into a
+// thread-local Shard; returns parsed row count, or -1 on a malformed
+// buffer. Shared by the streaming builder's workers.
+int64_t parse_packed_into_shard(Shard& s, const std::vector<int64_t>& wild,
+                                const char* p, const char* end) {
+    std::string_view fields[7];
+    int64_t count = 0;
+    while (p < end) {
+        int f = 0;
+        const char* field_start = p;
+        while (p < end && f < 7) {
+            if (*p == '\x1f' || *p == '\x1e') {
+                fields[f++] = std::string_view(field_start, (size_t)(p - field_start));
+                bool rec_end = (*p == '\x1e');
+                ++p;
+                field_start = p;
+                if (rec_end) break;
+            } else {
+                ++p;
+            }
+        }
+        if (f != 7) return -1;
+        int64_t ns = 0;
+        for (char c : fields[0]) {
+            if (c < '0' || c > '9') return -1;
+            ns = ns * 10 + (c - '0');
+        }
+        if (fields[3] == "1") {
+            shard_add_row(s, wild, ns, fields[1], fields[2], true, fields[4], 0,
+                          std::string_view(), std::string_view());
+        } else {
+            int64_t sns = 0;
+            for (char c : fields[4]) {
+                if (c < '0' || c > '9') return -1;
+                sns = sns * 10 + (c - '0');
+            }
+            shard_add_row(s, wild, ns, fields[1], fields[2], false,
+                          std::string_view(), sns, fields[5], fields[6]);
+        }
+        ++count;
+    }
+    return count;
+}
+
+// ---------------------------------------------------------------------------
+// Streaming build: the chunked-cursor counterpart of build_tuples.
+//
+// The one-shot entry points require the whole input up front, which
+// serializes SQL I/O *before* interning. stream_build_feed instead
+// enqueues each scan chunk (copied — the caller's buffer is transient)
+// onto a bounded work queue drained by a worker pool; workers intern
+// chunks into per-CHUNK Shards concurrently with the caller's next
+// fetch, so store I/O overlaps interning. stream_build_finish merges
+// the shards IN FEED ORDER — the same chunk-order × local-id-order
+// replay build_tuples uses — so the result is bit-identical to a
+// serial pass over the concatenated stream (and therefore to the
+// one-shot graph_build and the Python interner).
+
+struct StreamBuilder {
+    std::vector<int64_t> wild_ns_ids;
+    std::mutex mu;
+    std::condition_variable cv_work;   // workers wait for chunks
+    std::condition_variable cv_space;  // feeder waits for queue room
+    std::deque<std::pair<size_t, std::string>> queue;  // (chunk idx, buf)
+    std::vector<Shard*> shards;        // per chunk, in feed order
+    std::vector<std::thread> workers;
+    size_t max_queue = 0;
+    bool done = false;
+    bool error = false;
+
+    ~StreamBuilder() {
+        for (Shard* s : shards) delete s;
+    }
+};
+
+void stream_worker(StreamBuilder* sb) {
+    for (;;) {
+        size_t idx;
+        std::string buf;
+        {
+            std::unique_lock<std::mutex> lk(sb->mu);
+            sb->cv_work.wait(lk, [&] { return !sb->queue.empty() || sb->done; });
+            if (sb->queue.empty()) return;  // done and drained
+            idx = sb->queue.front().first;
+            buf = std::move(sb->queue.front().second);
+            sb->queue.pop_front();
+            sb->cv_space.notify_one();
+        }
+        Shard* s = sb->shards[idx];
+        if (parse_packed_into_shard(*s, sb->wild_ns_ids, buf.data(),
+                                    buf.data() + buf.size()) < 0) {
+            std::unique_lock<std::mutex> lk(sb->mu);
+            sb->error = true;
+        }
+    }
+}
+
 }  // namespace
 
 extern "C" {
+
+// Create a streaming builder: n_threads workers (0 = the ingest_threads
+// default for a large input) drain the chunk queue concurrently with
+// the caller's scan loop.
+StreamBuilder* stream_build_new(const int64_t* wild_ns_ids, int64_t n_wild_ns,
+                                int64_t n_threads) {
+    StreamBuilder* sb = new StreamBuilder();
+    sb->wild_ns_ids.assign(wild_ns_ids, wild_ns_ids + n_wild_ns);
+    unsigned nt = n_threads > 0 ? (unsigned)n_threads : ingest_threads(1 << 20);
+    sb->max_queue = 2 * nt + 2;  // bounds buffered-chunk memory
+    sb->workers.reserve(nt);
+    for (unsigned t = 0; t < nt; ++t)
+        sb->workers.emplace_back(stream_worker, sb);
+    return sb;
+}
+
+// Enqueue one packed-record chunk (copied). n_rows sizes the chunk
+// shard's intern-table reserves. Blocks while the queue is full (the
+// scan is ahead of interning — backpressure bounds memory). Returns 0,
+// or -1 if a previous chunk was malformed (the stream is dead; callers
+// fall back to the Python interner over their accumulated rows).
+int64_t stream_build_feed(StreamBuilder* sb, const char* buf, int64_t len,
+                          int64_t n_rows) {
+    Shard* s = new Shard();
+    const size_t cn = (size_t)(n_rows > 0 ? n_rows : 1024);
+    s->sets.reserve(cn / 2 + 16);
+    s->leaf_ids.reserve(cn / 2 + 16);
+    s->obj_codes.reserve(cn / 2 + 16);
+    s->rel_codes.reserve(256);
+    s->t_lhs.reserve(cn);
+    s->t_sub_idx.reserve(cn);
+    {
+        std::unique_lock<std::mutex> lk(sb->mu);
+        if (sb->error) {
+            delete s;
+            return -1;
+        }
+        sb->cv_space.wait(lk, [&] { return sb->queue.size() < sb->max_queue; });
+        size_t idx = sb->shards.size();
+        sb->shards.push_back(s);
+        sb->queue.emplace_back(idx, std::string(buf, (size_t)len));
+    }
+    sb->cv_work.notify_one();
+    return 0;
+}
+
+// Drain the queue, join the workers, and merge the per-chunk shards in
+// feed order into a Graph (identical ids to the one-shot build over the
+// concatenated stream). Consumes the builder. Returns nullptr when any
+// chunk was malformed.
+Graph* stream_build_finish(StreamBuilder* sb) {
+    {
+        std::unique_lock<std::mutex> lk(sb->mu);
+        sb->done = true;
+    }
+    sb->cv_work.notify_all();
+    for (auto& w : sb->workers) w.join();
+    if (sb->error) {
+        delete sb;
+        return nullptr;
+    }
+    int64_t n = 0;
+    for (Shard* s : sb->shards) n += (int64_t)s->t_lhs.size();
+    Graph* g = new Graph();
+    g->wild_ns_ids = sb->wild_ns_ids;
+    reserve_rows(g, (size_t)n);
+    merge_shards(g, sb->shards, n);
+    finish_edges(g);
+    delete sb;
+    return g;
+}
+
+// Tear a builder down without producing a graph (a failed scan retries
+// with a fresh builder).
+void stream_build_abort(StreamBuilder* sb) {
+    {
+        std::unique_lock<std::mutex> lk(sb->mu);
+        sb->done = true;
+        sb->queue.clear();
+    }
+    sb->cv_work.notify_all();
+    for (auto& w : sb->workers) w.join();
+    delete sb;
+}
 
 // UCS4 columnar fast path: string columns as numpy '<U*' fixed-width
 // arrays (data pointer + per-cell width in code points). This is the

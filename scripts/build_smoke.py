@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""build-throughput-smoke: the streaming snapshot pipeline's CI gate.
+
+Over a real sqlite store (so the chunked-cursor scan has actual I/O to
+overlap), this gate asserts the ISSUE-11 contract end to end:
+
+1. **Parity** — the streaming pipeline (chunked scan → native intern
+   pool → device-sorted layout) produces a snapshot BYTE-IDENTICAL to
+   the legacy one-shot host build: fwd/rev CSR, sink CSR, both
+   ListLayouts, bucket matrices, raw2dev, interner resolution.
+2. **Overlap** — the scan phase's wall time is strictly less than the
+   total build wall (the scan no longer serializes the whole build),
+   and rows were ingested through the chunk seam.
+3. **Segmented snapcache (v5)** — a save/load round trip through the
+   grouped, parallel-verified cache layout reproduces the arrays, and
+   format-version-aware retention keeps a previous version's cache
+   alive across the upgrade.
+4. **Sanitizer clean** — under KETO_TPU_SANITIZE=1 (the CI job sets it)
+   the whole run executes on instrumented locks with zero inversions
+   and zero watchdog trips.
+
+Knobs: BUILD_SMOKE_TUPLES (default 300k; CI runs 1M), BUILD_SMOKE_CHUNK.
+Exit 0 on success, 1 with a problem list on any failure.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _arrays_equal(name: str, a, b, problems: list) -> None:
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if a.shape != b.shape or a.dtype != b.dtype or not (a == b).all():
+        problems.append(f"parity: {name} differs (shapes {a.shape} vs {b.shape})")
+
+
+def _snapshots_equal(legacy, streamed, problems: list) -> None:
+    for name in (
+        "raw2dev", "fwd_indptr", "fwd_indices", "sink_indptr", "sink_indices",
+        "rev_indptr", "rev_indices",
+    ):
+        _arrays_equal(name, getattr(legacy, name), getattr(streamed, name), problems)
+    for which in ("buckets",):
+        la, sa = getattr(legacy, which), getattr(streamed, which)
+        if len(la) != len(sa):
+            problems.append(f"parity: {which} count {len(la)} vs {len(sa)}")
+            continue
+        for i, (x, y) in enumerate(zip(la, sa)):
+            if x.offset != y.offset or x.n != y.n:
+                problems.append(f"parity: {which}[{i}] geometry differs")
+            _arrays_equal(f"{which}[{i}].nbrs", x.nbrs, y.nbrs, problems)
+    for orient in ("lay_fwd", "lay_rev"):
+        lo, so = getattr(legacy, orient), getattr(streamed, orient)
+        _arrays_equal(f"{orient}.order", lo.order, so.order, problems)
+        if len(lo.buckets) != len(so.buckets):
+            problems.append(f"parity: {orient} bucket count differs")
+        for i, (x, y) in enumerate(zip(lo.buckets, so.buckets)):
+            _arrays_equal(f"{orient}.buckets[{i}].nbrs", x.nbrs, y.nbrs, problems)
+    for scalar in ("num_sets", "num_leaves", "num_active", "num_int",
+                   "num_live", "n_peeled", "snapshot_id"):
+        if getattr(legacy, scalar) != getattr(streamed, scalar):
+            problems.append(f"parity: {scalar} differs")
+
+
+def main() -> int:
+    from keto_tpu import namespace as namespace_pkg
+    from keto_tpu.graph import snapcache, stream_build
+    from keto_tpu.graph.device_build import GovernedSorter
+    from keto_tpu.graph.snapshot import build_snapshot
+    from keto_tpu.persistence.sqlite import SQLitePersister
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    n_tuples = int(os.environ.get("BUILD_SMOKE_TUPLES", 300_000))
+    chunk_rows = int(os.environ.get("BUILD_SMOKE_CHUNK", 65_536))
+    problems: list[str] = []
+    tmp = Path(tempfile.mkdtemp(prefix="keto-build-smoke-"))
+    try:
+        nm = namespace_pkg.MemoryManager(
+            [namespace_pkg.Namespace(id=1, name="groups"),
+             namespace_pkg.Namespace(id=2, name="docs")]
+        )
+        store = SQLitePersister(f"sqlite://{tmp}/smoke.db", nm)
+        rng = random.Random(1105)
+        n_groups = max(64, n_tuples // 100)
+        t0 = time.perf_counter()
+        batch: list = []
+        for i in range(n_tuples):
+            if rng.random() < 0.55:
+                batch.append(RelationTuple(
+                    namespace="groups", object=f"g{rng.randrange(n_groups)}",
+                    relation="member", subject=SubjectID(id=f"user-{i % (n_tuples // 3 + 1)}"),
+                ))
+            elif rng.random() < 0.8:
+                batch.append(RelationTuple(
+                    namespace="docs", object=f"doc{rng.randrange(n_groups * 2)}",
+                    relation="viewer",
+                    subject=SubjectSet(namespace="groups",
+                                       object=f"g{rng.randrange(n_groups)}",
+                                       relation="member"),
+                ))
+            else:
+                batch.append(RelationTuple(
+                    namespace="groups", object=f"g{rng.randrange(n_groups)}",
+                    relation="member",
+                    subject=SubjectSet(namespace="groups",
+                                       object=f"g{rng.randrange(n_groups)}",
+                                       relation="member"),
+                ))
+            if len(batch) >= 50_000:
+                store.write_relation_tuples(*batch)
+                batch = []
+        if batch:
+            store.write_relation_tuples(*batch)
+        log(f"[build] seeded {n_tuples} tuples into sqlite in "
+            f"{time.perf_counter() - t0:.1f}s")
+
+        # -- streaming pipeline on a COLD connection (cursor path) -----------
+        store_stream = SQLitePersister(f"sqlite://{tmp}/smoke.db", nm)
+        prog = stream_build.BuildProgress()
+        sorter = GovernedSorter()
+        t0 = time.perf_counter()
+        streamed = stream_build.full_build(
+            store_stream, sorter=sorter, progress=prog, chunk_rows=chunk_rows
+        )
+        stream_wall = time.perf_counter() - t0
+        d = prog.durations()
+        log(f"[build] streaming build: {stream_wall:.2f}s wall, phases={ {k: round(v, 3) for k, v in d.items()} }, "
+            f"rows={prog.rows_ingested}")
+
+        # -- legacy one-shot host build ---------------------------------------
+        t0 = time.perf_counter()
+        rows, wm = store.snapshot_rows()
+        scan_legacy = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        legacy = build_snapshot(rows, wm)
+        legacy_wall = scan_legacy + (time.perf_counter() - t0)
+        log(f"[build] legacy build: {legacy_wall:.2f}s wall "
+            f"(scan {scan_legacy:.2f}s)")
+
+        # 1) parity
+        _snapshots_equal(legacy, streamed, problems)
+        probe = rows[len(rows) // 2]
+        if streamed.interned.resolve_set(
+            probe.namespace_id, probe.object, probe.relation
+        ) != legacy.interned.resolve_set(
+            probe.namespace_id, probe.object, probe.relation
+        ):
+            problems.append("parity: interner set resolution differs")
+
+        # 2) overlap: the scan did not serialize the build, and the chunk
+        # seam actually carried the rows
+        if prog.rows_ingested != n_tuples:
+            problems.append(
+                f"overlap: chunk seam carried {prog.rows_ingested} rows, "
+                f"expected {n_tuples}"
+            )
+        scan_s = d.get("scan", 0.0)
+        if not (0.0 <= scan_s < stream_wall):
+            problems.append(
+                f"overlap: scan wall {scan_s:.3f}s not under total wall "
+                f"{stream_wall:.3f}s"
+            )
+        if d.get("intern", 0.0) <= 0.0:
+            problems.append("overlap: no intern time recorded")
+        throughput = n_tuples / max(1e-9, stream_wall)
+        log(f"[build] streaming throughput: {throughput:,.0f} tuples/s "
+            f"(legacy {n_tuples / max(1e-9, legacy_wall):,.0f})")
+
+        # 3) segmented snapcache v5 round trip + retention
+        cache_dir = tmp / "snapcache"
+        # a previous-version cache must survive the first v5 save
+        old_dir = cache_dir / "v4-w1"
+        old_dir.mkdir(parents=True)
+        (old_dir / "meta.json").write_text("{}")
+        path = snapcache.save_snapshot(legacy, str(cache_dir))
+        if path is None:
+            problems.append("snapcache: save refused an overlay-free snapshot")
+        else:
+            import json
+
+            meta = json.loads((Path(path) / "meta.json").read_text())
+            groups = meta.get("groups") or {}
+            if not {"core", "interner", "reverse"} <= set(groups):
+                problems.append(f"snapcache: v5 groups missing ({sorted(groups)})")
+            t0 = time.perf_counter()
+            reloaded = snapcache.load_latest(str(cache_dir), sorter=sorter)
+            reload_s = time.perf_counter() - t0
+            if reloaded is None:
+                problems.append("snapcache: reload returned nothing")
+            else:
+                _snapshots_equal(legacy, reloaded, problems)
+                log(f"[build] segmented cache reload: {reload_s:.2f}s "
+                    f"({legacy_wall / max(1e-9, reload_s):.0f}x vs legacy build)")
+        if not old_dir.is_dir():
+            problems.append(
+                "snapcache: v4 cache evicted by the first v5 save "
+                "(retention must be format-version-aware)"
+            )
+
+        # 4) sanitizer (when the CI job arms it)
+        from keto_tpu.x import lockwatch
+
+        if lockwatch.installed():
+            problems.extend(lockwatch.violations())
+            rep = lockwatch.report()
+            log(f"[build] lockwatch: {rep['acquires']} acquires, "
+                f"{len(rep['inversions'])} inversions, "
+                f"{len(rep['watchdog_trips'])} watchdog trips")
+
+        store.close()
+        store_stream.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    if problems:
+        for p in problems:
+            log(f"[build] PROBLEM: {p}")
+        return 1
+    log("[build] build-throughput-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -292,8 +292,6 @@ def test_compaction_fuzz_parity(seed):
 def test_engine_write_burst_compacts_without_rebuild():
     """A write burst past the overlay budget is absorbed by compaction:
     no full rebuild, no overlay left, decisions match the oracle."""
-    import keto_tpu.check.tpu_engine as mod
-
     p = make_store()
     p.write_relation_tuples(
         T("d", "doc", "view", SubjectSet("g", "team", "member")),
@@ -309,8 +307,10 @@ def test_engine_write_burst_compacts_without_rebuild():
     def boom(*a, **k):
         raise AssertionError("full rebuild during a compactable burst")
 
-    orig = mod.build_snapshot
-    mod.build_snapshot = boom
+    import keto_tpu.graph.stream_build as sb_mod
+
+    orig = sb_mod.full_build
+    sb_mod.full_build = boom
     try:
         burst = [T("g", "core", "member", SubjectID(f"b{i}")) for i in range(40)]
         p.write_relation_tuples(*burst)
@@ -325,7 +325,7 @@ def test_engine_write_burst_compacts_without_rebuild():
         for q, g in zip(qs, got):
             assert g == oracle.subject_is_allowed(q)
     finally:
-        mod.build_snapshot = orig
+        sb_mod.full_build = orig
 
 
 def test_snapshot_cache_round_trip(tmp_path):
@@ -345,14 +345,14 @@ def test_snapshot_cache_round_trip(tmp_path):
     assert a.save_snapshot_cache() is not None
 
     b = TpuCheckEngine(p, p.namespaces, snapshot_cache_dir=cache, compact_after_s=3600.0)
-    import keto_tpu.check.tpu_engine as mod
+    import keto_tpu.graph.stream_build as sb_mod
 
-    orig = mod.build_snapshot
+    orig = sb_mod.full_build
 
     def boom(*args, **kw):
         raise AssertionError("cold start rebuilt despite a valid cache")
 
-    mod.build_snapshot = boom
+    sb_mod.full_build = boom
     try:
         snap_b = b.snapshot()
         assert b.maintenance.snapshot().get("cache_loads", 0) == 1
@@ -376,7 +376,7 @@ def test_snapshot_cache_round_trip(tmp_path):
             assert compacted is not None
             assert decisions(b, compacted, qs) == a.batch_check(qs)
     finally:
-        mod.build_snapshot = orig
+        sb_mod.full_build = orig
 
     # expand parity across cache reload
     nm = namespace_pkg.MemoryManager(NSS)

@@ -89,19 +89,22 @@ def test_delta_never_reinterns():
     engine = TpuCheckEngine(p, p.namespaces)
     engine.snapshot()
 
-    import keto_tpu.check.tpu_engine as mod
+    # the engine's full-rebuild path is stream_build.full_build
+    # (keto_tpu/graph/stream_build.py) — poisoning it proves the delta
+    # path never re-interns
+    import keto_tpu.graph.stream_build as mod
 
     def boom(*a, **k):  # any full rebuild fails the test
         raise AssertionError("full rebuild on an insert-only advance")
 
-    orig = mod.build_snapshot
-    mod.build_snapshot = boom
+    orig = mod.full_build
+    mod.full_build = boom
     try:
         p.write_relation_tuples(T("g", "team", "member", SubjectID("bob")))
         assert engine.subject_is_allowed(T("g", "team", "member", SubjectID("bob")))
         assert not engine.subject_is_allowed(T("g", "team", "member", SubjectID("eve")))
     finally:
-        mod.build_snapshot = orig
+        mod.full_build = orig
 
 
 def test_multi_hop_through_overlay_ell_edges():
@@ -451,20 +454,24 @@ def test_no_target_sentinel_never_collides_with_overlay_ids():
 
 
 def _no_rebuild(engine_mod):
-    """Context: any full rebuild fails the test."""
+    """Context: any full rebuild fails the test (the engine's rebuild
+    path is stream_build.full_build — ``engine_mod`` is kept for call
+    compatibility; the poison lands on the stream_build seam)."""
     import contextlib
+
+    import keto_tpu.graph.stream_build as sb_mod
 
     @contextlib.contextmanager
     def guard():
         def boom(*a, **k):
             raise AssertionError("full rebuild on a delta-servable advance")
 
-        orig = engine_mod.build_snapshot
-        engine_mod.build_snapshot = boom
+        orig = sb_mod.full_build
+        sb_mod.full_build = boom
         try:
             yield
         finally:
-            engine_mod.build_snapshot = orig
+            sb_mod.full_build = orig
 
     return guard()
 

@@ -1,0 +1,468 @@
+"""Streaming snapshot pipeline parity (keto_tpu/graph/stream_build.py).
+
+The ISSUE-11 contract: the streaming, overlapped, device-accelerated
+build must produce snapshots BYTE-IDENTICAL to the legacy serial host
+build — same interner ids, same CSRs (forward, sink, transposed), same
+bucket matrices, same list layouts — across chunk sizes (1 row … whole
+table), interner backends (native stream pool, native one-shot, Python
+incremental), sorter backends (host numpy vs device stable sort), and a
+mid-scan store failure retried through the x/retry seam. Plus the
+segmented FORMAT_VERSION-5 snapcache (groups, parallel verify,
+format-version-aware retention) and the deferred bulk-row optimization.
+"""
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.graph import snapcache, stream_build
+from keto_tpu.graph.device_build import DeviceSorter, GovernedSorter, HostSorter
+from keto_tpu.graph.interner import IncrementalInterner, intern_rows
+from keto_tpu.graph.snapshot import build_snapshot
+from keto_tpu.persistence.memory import MemoryPersister
+from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+
+NSS = [
+    namespace_pkg.Namespace(id=1, name="g"),
+    namespace_pkg.Namespace(id=2, name="d"),
+    namespace_pkg.Namespace(id=3, name=""),  # wildcard-named namespace
+]
+
+
+def T(ns, obj, rel, sub):
+    return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+
+def make_store():
+    return MemoryPersister(namespace_pkg.MemoryManager(NSS))
+
+
+def wild_ids(store):
+    return frozenset(n.id for n in store.namespaces().namespaces() if n.name == "")
+
+
+def rand_tuples(rng, n, with_wild=True, with_dups=True):
+    """Random tuples exercising sinks (SubjectID leaves), interior chains
+    (SubjectSet subjects), wildcard namespaces, and duplicate rows."""
+    objects = [f"o{i}" for i in range(24)]
+    rels = ["m", "v", ""]  # "" relation = wildcard-bearing set keys
+    users = [f"u{i}" for i in range(120)]
+    out = []
+    for _ in range(n):
+        ns = rng.choice(["g", "d"] + (["" ] if with_wild else []))
+        obj = rng.choice(objects)
+        rel = rng.choice(rels[:2] if ns == "" else rels) or "m"
+        if rng.random() < 0.5:
+            sub = SubjectID(id=rng.choice(users))
+        else:
+            sub = SubjectSet(
+                namespace=rng.choice(["g", "d"]),
+                object=rng.choice(objects), relation=rng.choice(["m", "v"]),
+            )
+        out.append(T(ns, obj, rel, sub))
+        if with_dups and rng.random() < 0.1:
+            out.append(T(ns, obj, rel, sub))  # duplicate store rows
+    return out
+
+
+def assert_snapshots_equal(a, b):
+    for name in (
+        "raw2dev", "fwd_indptr", "fwd_indices", "sink_indptr", "sink_indices",
+        "rev_indptr", "rev_indices",
+    ):
+        x, y = np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+        assert x.shape == y.shape and (x == y).all(), f"{name} differs"
+    for scalar in ("num_sets", "num_leaves", "num_active", "num_int",
+                   "num_live", "n_peeled"):
+        assert getattr(a, scalar) == getattr(b, scalar), scalar
+    assert len(a.buckets) == len(b.buckets)
+    for i, (x, y) in enumerate(zip(a.buckets, b.buckets)):
+        assert x.offset == y.offset and x.n == y.n
+        assert (np.asarray(x.nbrs) == np.asarray(y.nbrs)).all(), f"bucket {i}"
+    for orient in ("lay_fwd", "lay_rev"):
+        la, lb = getattr(a, orient), getattr(b, orient)
+        assert (np.asarray(la.order) == np.asarray(lb.order)).all()
+        assert len(la.buckets) == len(lb.buckets)
+        for x, y in zip(la.buckets, lb.buckets):
+            assert x.offset == y.offset and x.n == y.n
+            assert (np.asarray(x.nbrs) == np.asarray(y.nbrs)).all()
+    # interner ids: key arrays byte-identical + spot resolution
+    for name in ("key_ns", "key_obj", "key_rel"):
+        x = np.asarray(getattr(a.interned, name))
+        y = np.asarray(getattr(b.interned, name))
+        assert x.shape == y.shape and (x == y).all(), f"interned.{name}"
+
+
+# -- incremental interner ------------------------------------------------------
+
+
+@pytest.mark.parametrize("chunk", [1, 7, 10_000])
+def test_incremental_interner_matches_one_shot(chunk):
+    store = make_store()
+    store.write_relation_tuples(*rand_tuples(random.Random(11), 900))
+    rows, _ = store.snapshot_rows()
+    wild = wild_ids(store)
+    one = intern_rows(rows, wild)
+    inc = IncrementalInterner(wild)
+    for i in range(0, len(rows), chunk):
+        inc.add_rows(rows[i : i + chunk])
+    got = inc.finish()
+    assert got.set_ids == one.set_ids
+    assert got.leaf_ids == one.leaf_ids
+    assert (got.src == one.src).all() and (got.dst == one.dst).all()
+    assert (np.asarray(got.key_wild) == np.asarray(one.key_wild)).all()
+
+
+def test_native_stream_builder_matches_serial():
+    from keto_tpu.graph.native import NativeStreamBuilder, load_library
+
+    if load_library() is None or NativeStreamBuilder.create(frozenset()) is None:
+        pytest.skip("native streaming builder not built")
+    store = make_store()
+    store.write_relation_tuples(*rand_tuples(random.Random(5), 2500))
+    rows, _ = store.snapshot_rows()
+    wild = wild_ids(store)
+    ref = intern_rows(rows, wild)
+    sb = NativeStreamBuilder.create(wild)
+    for i in range(0, len(rows), 173):
+        assert sb.feed(rows[i : i + 173])
+    g = sb.finish()
+    assert g is not None
+    assert g.num_sets == ref.num_sets and g.num_leaves == ref.num_leaves
+    assert (g.src == ref.src).all() and (g.dst == ref.dst).all()
+    assert (np.asarray(g.key_ns) == ref.key_ns).all()
+    assert (np.asarray(g.key_obj) == ref.key_obj).all()
+    assert (np.asarray(g.key_wild) == np.asarray(ref.key_wild)).all()
+
+
+# -- full-pipeline fuzz parity -------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_streaming_build_parity_fuzz(seed):
+    """Streaming pipeline vs legacy host build: byte-identical snapshot
+    arrays across seeds including wildcards, sinks, and dup tuples."""
+    rng = random.Random(seed)
+    store = make_store()
+    store.write_relation_tuples(*rand_tuples(rng, 1500 + 400 * seed))
+    rows, wm = store.snapshot_rows()
+    legacy = build_snapshot(rows, wm, wild_ids(store))
+    store.scan_chunks_preferred = True  # force the chunked scan path
+    store._shared.col_cache.clear()
+    streamed = stream_build.full_build(
+        store, wild_ids(store), chunk_rows=rng.choice([1, 37, 512, 1 << 20]),
+        progress=stream_build.BuildProgress(),
+    )
+    assert streamed.snapshot_id == wm
+    assert_snapshots_equal(legacy, streamed)
+
+
+@pytest.mark.parametrize("chunk_rows", [1, 7, 191, 1 << 20])
+def test_chunk_size_sweep(chunk_rows):
+    """1 row per chunk … whole table in one chunk: identical snapshots."""
+    store = make_store()
+    store.write_relation_tuples(*rand_tuples(random.Random(42), 600))
+    rows, wm = store.snapshot_rows()
+    legacy = build_snapshot(rows, wm, wild_ids(store))
+    store.scan_chunks_preferred = True
+    store._shared.col_cache.clear()
+    streamed = stream_build.full_build(
+        store, wild_ids(store), chunk_rows=chunk_rows
+    )
+    assert_snapshots_equal(legacy, streamed)
+
+
+def test_python_interner_stream_parity(monkeypatch):
+    """With the native library unavailable the pipeline rides
+    IncrementalInterner — same snapshot, no overlap."""
+    import keto_tpu.graph.native as native_mod
+
+    monkeypatch.setattr(native_mod, "_lib", None)
+    monkeypatch.setattr(native_mod, "_lib_checked", True)
+    store = make_store()
+    store.write_relation_tuples(*rand_tuples(random.Random(9), 800))
+    rows, wm = store.snapshot_rows()
+    legacy = build_snapshot(rows, wm, wild_ids(store))
+    store.scan_chunks_preferred = True
+    store._shared.col_cache.clear()
+    streamed = stream_build.full_build(store, wild_ids(store), chunk_rows=97)
+    assert_snapshots_equal(legacy, streamed)
+
+
+# -- sql chunked cursor --------------------------------------------------------
+
+
+def test_sqlite_snapshot_scan_matches_snapshot_rows(tmp_path):
+    from keto_tpu.persistence.sqlite import SQLitePersister
+
+    nm = namespace_pkg.MemoryManager(NSS)
+    p = SQLitePersister(f"sqlite://{tmp_path}/scan.db", nm)
+    p.write_relation_tuples(*rand_tuples(random.Random(3), 700, with_wild=False))
+    rows, wm = p.snapshot_rows()
+
+    p2 = SQLitePersister(f"sqlite://{tmp_path}/scan.db", nm)
+    chunks = []
+    wm2 = p2.snapshot_scan(chunks.append, chunk_rows=53)
+    flat = [r for c in chunks for r in c]
+    assert wm2 == wm
+    assert len(flat) == len(rows)
+    assert all(x.key7() == y.key7() and x.seq == y.seq for x, y in zip(flat, rows))
+    assert all(len(c) <= 53 for c in chunks)
+    # the scan populated the snapshot-row cache like snapshot_rows would
+    rows3, wm3 = p2.snapshot_rows()
+    assert wm3 == wm and len(rows3) == len(rows)
+
+
+def test_mid_scan_failure_retries_through_xretry():
+    """A persister failure mid-scan aborts the attempt; the engine-style
+    retry (x/retry) re-runs with FRESH interner state and converges on
+    the identical snapshot."""
+    from keto_tpu.x.retry import retry_call
+
+    store = make_store()
+    store.write_relation_tuples(*rand_tuples(random.Random(8), 500))
+    rows, wm = store.snapshot_rows()
+    legacy = build_snapshot(rows, wm, wild_ids(store))
+
+    class FlakyScanStore:
+        scan_chunks_preferred = True
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.scan_calls = 0
+
+        def watermark(self):
+            return self._inner.watermark()
+
+        def snapshot_scan(self, on_chunk, chunk_rows=262144):
+            self.scan_calls += 1
+            if self.scan_calls == 1:
+                # deliver a partial scan, then die mid-cursor
+                on_chunk(rows[: len(rows) // 2])
+                raise ConnectionError("server closed the connection")
+            return self._inner.snapshot_scan(on_chunk, chunk_rows=chunk_rows)
+
+    flaky = FlakyScanStore(store)
+    retries = []
+
+    def read_retry(fn, *args):
+        return retry_call(
+            lambda: fn(*args), max_wait_s=5.0, base_s=0.01, max_s=0.05,
+            on_retry=lambda e, d: retries.append(e),
+        )
+
+    streamed = stream_build.full_build(
+        flaky, wild_ids(store), chunk_rows=64, read_retry=read_retry
+    )
+    assert flaky.scan_calls == 2 and len(retries) == 1
+    assert_snapshots_equal(legacy, streamed)
+
+
+# -- device-side build ---------------------------------------------------------
+
+
+def test_device_sorter_matches_host_argsort():
+    rng = np.random.default_rng(0)
+    host, dev = HostSorter(), DeviceSorter()
+    for n in (0, 1, 5, 1000, 40_000):
+        keys = rng.integers(0, max(1, n // 7 + 1), size=n).astype(np.int64)
+        assert (host.argsort(keys) == dev.argsort(keys)).all()
+    many = [rng.integers(0, 50, size=n).astype(np.int64) for n in (10, 999, 4096)]
+    for h, d in zip(host.argsort_many(many), dev.argsort_many(many)):
+        assert (h == d).all()
+
+
+def test_device_build_full_parity():
+    store = make_store()
+    store.write_relation_tuples(*rand_tuples(random.Random(17), 2000))
+    rows, wm = store.snapshot_rows()
+    legacy = build_snapshot(rows, wm, wild_ids(store))
+    devved = build_snapshot(rows, wm, wild_ids(store), sorter=DeviceSorter())
+    assert_snapshots_equal(legacy, devved)
+
+
+def test_governed_sorter_falls_back_under_pressure():
+    """A 1-byte HBM budget refuses the build transient (evict=False —
+    serving state is never pushed off-chip for a build) and the host
+    path answers bit-identically; the skip is counted."""
+    from keto_tpu.driver.hbm import HbmGovernor
+    from keto_tpu.x.telemetry import MaintenanceStats
+
+    stats = MaintenanceStats()
+    gov = HbmGovernor(budget_bytes=1, stats=stats)
+    sorter = GovernedSorter(hbm=gov, min_size=1, stats=stats)
+    keys = np.arange(5000, dtype=np.int64)[::-1].copy()
+    out = sorter.argsort(keys)
+    assert (out == HostSorter().argsort(keys)).all()
+    assert stats.snapshot().get("device_build_skipped", 0) >= 1
+    assert gov.ledger().get("build", 0) == 0  # transient never leaked
+
+
+def test_compaction_device_splice_parity():
+    """Folding an overlay with the device sorter equals the host fold —
+    the write path's CSR splice is sorter-agnostic by construction."""
+    from keto_tpu.graph.compaction import compact_snapshot
+    from keto_tpu.graph.overlay import apply_delta, rows_as_ops
+
+    store = make_store()
+    base_tuples = rand_tuples(random.Random(23), 1200, with_wild=False)
+    store.write_relation_tuples(*base_tuples)
+    rows, wm = store.snapshot_rows()
+    base = build_snapshot(rows, wm, wild_ids(store))
+    extra = [
+        T("g", f"o{i % 24}", "m", SubjectSet(namespace="g", object=f"o{(i + 3) % 24}", relation="m"))
+        for i in range(40)
+    ] + [T("g", f"o{i % 24}", "m", SubjectID(id=f"new-user-{i}")) for i in range(40)]
+    store.write_relation_tuples(*extra)
+    new_rows, new_wm = store.snapshot_rows()
+    delta = [r for r in new_rows if r.seq > wm]
+    snap = apply_delta(base, rows_as_ops(delta), new_wm, wild_ids(store))
+    assert snap is not None and snap.has_overlay
+    host_fold = compact_snapshot(snap)
+    dev_fold = compact_snapshot(snap, sorter=DeviceSorter())
+    assert host_fold is not None and dev_fold is not None
+    assert_snapshots_equal(host_fold.snapshot, dev_fold.snapshot)
+
+
+# -- segmented snapcache v5 ----------------------------------------------------
+
+
+def test_snapcache_v5_groups_and_round_trip(tmp_path):
+    import json
+    from pathlib import Path
+
+    store = make_store()
+    store.write_relation_tuples(*rand_tuples(random.Random(31), 900, with_wild=False))
+    rows, wm = store.snapshot_rows()
+    snap = build_snapshot(rows, wm, wild_ids(store))
+    path = snapcache.save_snapshot(snap, str(tmp_path / "cache"))
+    assert path is not None and f"v{snapcache.FORMAT_VERSION}-" in path
+    meta = json.loads((Path(path) / "meta.json").read_text())
+    groups = meta["groups"]
+    assert {"core", "interner", "reverse"} <= set(groups)
+    # every manifest segment belongs to exactly one group
+    grouped = [s for names in groups.values() for s in names]
+    assert sorted(grouped) == sorted(meta["segments"])
+    loaded = snapcache.load_latest(str(tmp_path / "cache"), sorter=DeviceSorter())
+    assert loaded is not None
+    assert_snapshots_equal(snap, loaded)
+
+
+def test_snapcache_retention_is_format_version_aware(tmp_path):
+    """A v4→v5 upgrade must not evict the previous version's only cache:
+    other recognized versions age only against themselves; junk dirs
+    still get removed."""
+    cache = tmp_path / "cache"
+    store = make_store()
+    # pre-existing older-version caches (contents irrelevant to prune)
+    for name in ("v4-w3", "v4-w9", "v4-w11", "v3-w2"):
+        d = cache / name
+        d.mkdir(parents=True)
+        (d / "meta.json").write_text("{}")
+    junk = cache / "not-a-cache"
+    junk.mkdir()
+    for i in range(snapcache.KEEP + 2):
+        store.write_relation_tuples(T("g", "team", "m", SubjectID(f"u{i}")))
+        rows, wm = store.snapshot_rows()
+        assert snapcache.save_snapshot(build_snapshot(rows, wm), str(cache))
+    names = sorted(d.name for d in cache.iterdir())
+    cur = [n for n in names if n.startswith(f"v{snapcache.FORMAT_VERSION}-")]
+    assert len(cur) == snapcache.KEEP  # current version pruned to KEEP
+    # older versions keep their newest KEEP, never zero
+    assert "v4-w11" in names and "v4-w9" in names and "v4-w3" not in names
+    assert "v3-w2" in names
+    assert "not-a-cache" not in names
+
+
+# -- deferred bulk rows --------------------------------------------------------
+
+
+def test_deferred_bulk_rows_materialize_identically():
+    from keto_tpu.persistence.memory import _DeferredRows, _SharedState
+
+    n = _SharedState.LOG_CAP + 512  # over the cap → deferral engages
+    tuples = rand_tuples(random.Random(77), n, with_wild=False, with_dups=False)
+    lazy, eager = make_store(), make_store()
+    eager._shared.LOG_CAP = 10**9  # never defers (cap unreachable)
+    lazy.write_relation_tuples(*tuples)
+    assert isinstance(lazy._shared.rows.get("default"), _DeferredRows)
+    # the snapshot builder reads columns, not rows — still deferred after
+    assert lazy.snapshot_columns(lazy.watermark()) is not None
+    eager.write_relation_tuples(*tuples)
+    got, wm1 = lazy.snapshot_rows()  # first Manager touch materializes
+    want, wm2 = eager.snapshot_rows()
+    assert len(got) == len(want)
+    assert all(a.key7() == b.key7() for a, b in zip(got, want))
+    # engine-level: identical snapshots either way
+    assert_snapshots_equal(
+        build_snapshot(want, wm2, wild_ids(eager)),
+        build_snapshot(got, wm1, wild_ids(lazy)),
+    )
+
+
+# -- progress + health ---------------------------------------------------------
+
+
+def test_build_progress_phases_and_pct():
+    p = stream_build.BuildProgress()
+    assert p.current_phase == "idle" and p.pct() == 0.0
+    p.start()
+    with p.phase("device_build"):
+        assert p.current_phase == "device_build"
+        assert 0.0 < p.pct() < 1.0
+    p.add_rows(10)
+    p.observe("scan", 0.5)
+    d = p.durations()
+    assert d["device_build"] >= 0.0 and d["scan"] == 0.5
+    p.finish()
+    assert p.current_phase == "idle" and p.rows_ingested == 10
+
+
+def test_health_reports_build_phase_while_starting():
+    from keto_tpu.driver.health import HealthMonitor, HealthState
+
+    class FakeEngine:
+        def health(self):
+            return {
+                "has_snapshot": False,
+                "staleness_s": 0.0,
+                "maintenance_alive": True,
+                "build_phase": "intern",
+                "build_pct": 0.42,
+                "build_rows_ingested": 1234,
+            }
+
+    mon = HealthMonitor(FakeEngine())
+    state, reason = mon.status()
+    assert state is HealthState.STARTING
+    assert "phase=intern" in reason and "42%" in reason
+    detail = mon.starting_detail()
+    assert detail == {"phase": "intern", "pct": 0.42, "rows_ingested": 1234}
+
+
+def test_engine_streaming_build_end_to_end(tmp_path):
+    """A TpuCheckEngine over sqlite rides the streaming pipeline for its
+    full build: decisions match the CPU oracle and the progress tracker
+    recorded the pipeline phases."""
+    from keto_tpu.check import CheckEngine
+    from keto_tpu.check.tpu_engine import TpuCheckEngine
+    from keto_tpu.persistence.sqlite import SQLitePersister
+
+    nm = namespace_pkg.MemoryManager(NSS)
+    p = SQLitePersister(f"sqlite://{tmp_path}/e2e.db", nm)
+    tuples = rand_tuples(random.Random(13), 800, with_wild=False)
+    p.write_relation_tuples(*tuples)
+    engine = TpuCheckEngine(p, p.namespaces)
+    queries = rand_tuples(random.Random(14), 150, with_wild=False, with_dups=False)
+    got = engine.batch_check(queries)
+    oracle = CheckEngine(p)
+    want = [oracle.subject_is_allowed(q) for q in queries]
+    assert got == want
+    d = engine.build_progress.durations()
+    assert "intern" in d and "device_build" in d
+    assert engine.build_progress.current_phase == "idle"
+    h = engine.health()
+    assert h["build_phase"] == "idle" and h["build_rows_ingested"] >= len(tuples)
