@@ -1719,6 +1719,138 @@ def ensure_native():
     )
 
 
+def run_sharded(rng):
+    """Sharded multi-chip serving (keto_tpu/parallel/sharded.py): checks/s
+    and BFS-step p50/p99 at 1/2/4/8 devices on a graph-axis-sharded
+    mesh, plus the halo-exchange cost (rounds + frontier-slab bytes) per
+    configuration — the explicit number the GSPMD path hides. Labels are
+    disabled so the measured path IS the halo-exchanging BFS kernel; a
+    labels-on row rides along for the served-product view.
+
+    Knobs: BENCH_SHARDED_TUPLES / BENCH_SHARDED_CHECKS /
+    BENCH_SHARDED_DEVICES (csv, default "1,2,4,8" clipped to available).
+    """
+    import jax
+    import numpy as _np
+
+    from keto_tpu import namespace as namespace_pkg
+    from keto_tpu.check import CheckEngine
+    from keto_tpu.check.tpu_engine import TpuCheckEngine
+    from keto_tpu.parallel import make_mesh
+    from keto_tpu.persistence.memory import MemoryPersister
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    def T(ns, obj, rel, sub):
+        return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+    base_tuples = int(os.environ.get("BENCH_TUPLES", 1_000_000))
+    n_tuples = int(os.environ.get("BENCH_SHARDED_TUPLES", max(20_000, base_tuples // 20)))
+    n_checks = int(os.environ.get("BENCH_SHARDED_CHECKS", 20_000))
+    reps = int(os.environ.get("BENCH_REPS", 3))
+    oracle_sample = int(os.environ.get("BENCH_SHARDED_ORACLE_SAMPLE", 300))
+    devices = jax.devices()
+    wanted = [
+        int(c)
+        for c in os.environ.get("BENCH_SHARDED_DEVICES", "1,2,4,8").split(",")
+    ]
+    counts = [c for c in wanted if c <= len(devices)]
+
+    # 3-level nested RBAC graph (the depth that makes halo exchange real)
+    n_users = max(200, n_tuples // 8)
+    n_leaf = max(16, n_tuples // 60)
+    n_mid = max(4, n_leaf // 4)
+    n_top = max(2, n_mid // 4)
+    n_docs = max(100, n_tuples // 4)
+    tuples = []
+    for u in range(n_users):
+        tuples.append(T("groups", f"leaf-{u % n_leaf}", "member", SubjectID(f"user-{u}")))
+    for g in range(n_leaf):
+        tuples.append(
+            T("groups", f"leaf-{g}", "member",
+              SubjectSet("groups", f"mid-{g % n_mid}", "member"))
+        )
+    for g in range(n_mid):
+        tuples.append(
+            T("groups", f"mid-{g}", "member",
+              SubjectSet("groups", f"top-{g % n_top}", "member"))
+        )
+    for d in range(n_docs):
+        lvl, gi = rng.choice(
+            [("leaf", rng.randrange(n_leaf)), ("mid", rng.randrange(n_mid)),
+             ("top", rng.randrange(n_top))]
+        )
+        tuples.append(
+            T("docs", f"doc-{d}", "view", SubjectSet("groups", f"{lvl}-{gi}", "member"))
+        )
+    nm = namespace_pkg.MemoryManager(
+        [namespace_pkg.Namespace(id=1, name="groups"), namespace_pkg.Namespace(id=2, name="docs")]
+    )
+    store = MemoryPersister(nm)
+    store.write_relation_tuples(*tuples)
+    queries = [
+        T("docs", f"doc-{rng.randrange(n_docs)}", "view",
+          SubjectID(f"user-{rng.randrange(int(n_users * 1.2))}"))
+        for _ in range(n_checks)
+    ]
+    oracle = CheckEngine(store)
+    want = [oracle.subject_is_allowed(q) for q in queries[:oracle_sample]]
+
+    out = {"tuples": len(tuples), "checks": n_checks, "configs": []}
+    for c in counts:
+        mesh = make_mesh(devices=devices[:c], graph=c, data=1)
+        engine = TpuCheckEngine(
+            store, store.namespaces, mesh=mesh, sharded=True,
+            labels_enabled=False,
+        )
+        engine.batch_check(queries)  # warmup/compile
+        engine.bfs_steps_stats.reset()
+        c0, _, _ = engine.maintenance.raw()
+        rounds0 = c0.get("shard_halo_rounds", 0)
+        bytes0 = c0.get("shard_halo_bytes", 0)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            got = engine.batch_check(queries)
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        sec = times[len(times) // 2]
+        mism = sum(g != w for g, w in zip(got[:oracle_sample], want))
+        steps = engine.bfs_steps_stats.snapshot()
+        c1, _, _ = engine.maintenance.raw()
+        spec = engine.snapshot().shard_spec
+        # labels-on served-product row (one rep — the contrast, not the
+        # headline)
+        eng_lab = TpuCheckEngine(store, store.namespaces, mesh=mesh, sharded=True)
+        eng_lab.batch_check(queries)
+        t0 = time.perf_counter()
+        got_lab = eng_lab.batch_check(queries)
+        lab_sec = time.perf_counter() - t0
+        mism += sum(g != w for g, w in zip(got_lab[:oracle_sample], want))
+        row = {
+            "devices": c,
+            "checks_per_s": round(n_checks / sec, 1),
+            "checks_per_s_labels": round(n_checks / lab_sec, 1),
+            "bfs_steps_p50": steps["p50_ms"],
+            "bfs_steps_p99": steps["p99_ms"],
+            "halo_rounds": int(c1.get("shard_halo_rounds", 0) - rounds0),
+            "halo_bytes": int(c1.get("shard_halo_bytes", 0) - bytes0),
+            "rows_per_shard": int(spec.rows_per_shard) if spec is not None else None,
+            "oracle_mismatches": int(mism),
+        }
+        out["configs"].append(row)
+        log(
+            f"[sharded] g={c}: {row['checks_per_s']:,.0f} checks/s "
+            f"(labels {row['checks_per_s_labels']:,.0f}), halo "
+            f"{row['halo_rounds']} rounds / {row['halo_bytes']} B, "
+            f"mismatches={mism}"
+        )
+        del engine, eng_lab
+        import gc
+
+        gc.collect()
+    return out
+
+
 def main():
     n_tuples = int(os.environ.get("BENCH_TUPLES", 1_000_000))
     n_checks = int(os.environ.get("BENCH_CHECKS", 100_000))
@@ -1843,6 +1975,16 @@ def main():
             log(f"[reverse] FAILED: {e!r}")
             reverse_query = {"error": repr(e)}
 
+    # sharded multi-chip serving: checks/s + halo cost at 1/2/4/8
+    # graph-axis shards (failures degrade to an error field)
+    sharded = None
+    if os.environ.get("BENCH_SHARDED", "1") != "0":
+        try:
+            sharded = run_sharded(random.Random(6042))
+        except Exception as e:  # pragma: no cover - diagnostic path
+            log(f"[sharded] FAILED: {e!r}")
+            sharded = {"error": repr(e)}
+
     # BASELINE configs 2/4/5 — failures must not lose the headline JSON line
     config2 = None
     if os.environ.get("BENCH_CONFIG2", "1") != "0":
@@ -1904,6 +2046,7 @@ def main():
                     "overload": overload,
                     "depth_sweep": depth_sweep,
                     "reverse_query": reverse_query,
+                    "sharded": sharded,
                     "config2_flat_acl": config2,
                     "config4_10m_depth8": config4,
                     "config5_50m_stream": config5,

@@ -324,7 +324,9 @@ class _HybridSlice:
         self.bfs_pos = bfs_pos
 
     def parts(self) -> list:
-        out = [self.label_dev]
+        # label_dev is None when every query in the chunk fell back to
+        # the BFS sub-batch (no certifiable pair survived routing)
+        out = [] if self.label_dev is None else [self.label_dev]
         if self.bfs_dev is not None:
             out.append(self.bfs_dev)
         return out
@@ -338,6 +340,28 @@ class _HybridSlice:
             bool(r()) for p in self.parts()
             for r in (getattr(p, "is_ready", None),) if r is not None
         )
+
+
+class _ShardedSlice:
+    """Device output of one explicitly-sharded dispatch
+    (keto_tpu/parallel/sharded.py): the packed ``uint32[W+3]`` kernel
+    result (decision bits, iterations, truncation, frontier-bit
+    population) plus the halo-exchange byte cost of one round — what the
+    engine turns into the ``keto_shard_*`` counters at unpack time.
+    Quacks like a device array where the streaming pipeline needs it."""
+
+    __slots__ = ("dev", "halo_bytes_per_round")
+
+    def __init__(self, dev, halo_bytes_per_round: int):
+        self.dev = dev
+        self.halo_bytes_per_round = int(halo_bytes_per_round)
+
+    def copy_to_host_async(self) -> None:
+        self.dev.copy_to_host_async()
+
+    def is_ready(self) -> bool:
+        r = getattr(self.dev, "is_ready", None)
+        return True if r is None else bool(r())
 
 
 def pack_entries(packed) -> tuple[np.ndarray, tuple[int, int, int, int]]:
@@ -660,6 +684,7 @@ class TpuCheckEngine:
         max_batch: int = 32 * _WORD_WIDTHS[-1],
         mesh=None,
         shard_rows: bool = False,
+        sharded: bool = False,
         mem_budget_bytes: int = 10 << 30,
         compact_after_s: float = 5.0,
         peel_seed_cap: float = 4.0,
@@ -720,6 +745,16 @@ class TpuCheckEngine:
         self._label_blocked_snap: Optional[int] = None
         self._mesh = mesh
         self._shard_rows = shard_rows
+        # EXPLICIT sharding (keto_tpu/parallel/sharded.py): partition the
+        # bucket/bitmap/label rows by interior-row range over the mesh's
+        # graph axis and run the BFS step as a shard_map kernel with an
+        # explicit per-hop halo exchange, instead of handing GSPMD a
+        # globally-addressed program. Queries replicate along the data
+        # axis; decisions are bit-identical to the single-device kernels.
+        self._sharded = bool(sharded and mesh is not None)
+        self._shard_count = (
+            int(mesh.shape.get("graph", 1)) if self._sharded else 0
+        )
         self._multiprocess = mesh is not None and jax.process_count() > 1
         # per-batch (snapshot, batch) fingerprint agreement across hosts:
         # divergence fails loudly instead of hanging mismatched collectives
@@ -739,6 +774,11 @@ class TpuCheckEngine:
             self._bitmap_sharding_rows_only = NamedSharding(mesh, P(row_axis))
             self._bucket_sharding = NamedSharding(mesh, P(GRAPH_AXIS, None))
             self._ov_dst_sharding = NamedSharding(mesh, P(GRAPH_AXIS))
+            # sharded mode: stacked [n_shards, ...] arrays split over the
+            # graph axis (leading dim), replicated over data; per-dispatch
+            # label pair entries replicate everywhere
+            self._shard_stack_sharding = NamedSharding(mesh, P(GRAPH_AXIS))
+            self._shard_repl_sharding = NamedSharding(mesh, P())
         self._lock = threading.Lock()
         self._snapshot: Optional[GraphSnapshot] = None
         # delta overlays beyond this edge count trigger COMPACTION — the
@@ -812,6 +852,11 @@ class TpuCheckEngine:
             stats=self.maintenance,
             deterministic=self._multiprocess,
         )
+        if self._sharded:
+            # per-shard ledger: the governor tracks each shard's owned
+            # residency so the mesh-wide plan binds on the hottest shard
+            self.hbm.set_shard_count(self._shard_count)
+            self.maintenance.set_gauge("shard_count", self._shard_count)
         # the reverse-query list engine (keto_tpu/list/tpu_engine.py)
         # registers its eviction hooks here once constructed; until then
         # the rung is a no-op (nothing resident to drop)
@@ -863,6 +908,12 @@ class TpuCheckEngine:
             if device_build_enabled
             else None
         )
+
+    @property
+    def shard_count(self) -> int:
+        """Graph-axis shards the explicit sharded mode partitions over
+        (0 = not sharded) — bench and the metrics bridge read this."""
+        return self._shard_count
 
     # -- snapshot lifecycle --------------------------------------------------
 
@@ -1065,13 +1116,16 @@ class TpuCheckEngine:
 
     # -- HBM budget governor (keto_tpu/driver/hbm.py) ------------------------
 
-    def _plan_or_refuse(self, what: str, need: int) -> None:
+    def _plan_or_refuse(self, what: str, need: int, per_shard=None) -> None:
         """Plan ``need`` device bytes before an upload. The governor walks
         the eviction ladder until it fits; with every rung spent the
         refresh is REFUSED — unless there is no snapshot at all (cold
         boot: nothing to serve stale from, so the upload proceeds over
-        budget and is merely accounted)."""
-        if self.hbm.plan(need, what=what):
+        budget and is merely accounted). ``per_shard`` additionally holds
+        the plan against each shard's slice of the budget (sharded mode:
+        the hottest shard is the binding constraint, and any eviction the
+        walk takes is mesh-wide — one ladder, every shard)."""
+        if self.hbm.plan(need, what=what, per_shard=per_shard):
             return
         if self._snapshot is None:
             self.hbm.note_forced(what, need)
@@ -1161,6 +1215,7 @@ class TpuCheckEngine:
         snap = self._snapshot
         if snap is not None:
             snap.device_labels = None
+            snap.device_shard_labels = None
             snap.labels = None
         self.maintenance.set_gauge("label_coverage", 0.0)
         self.maintenance.set_gauge("label_entries", 0)
@@ -1178,7 +1233,15 @@ class TpuCheckEngine:
         self._width_trim = max(self._width_trim, len(_WORD_WIDTHS) - 4)
         freed = self.hbm.release("warmup")
         self._last_warm_bytes = max(self._last_warm_bytes, freed)
-        for kern in (_check_kernel, _label_kernel):
+        kerns: list = [_check_kernel, _label_kernel]
+        if self._sharded:
+            from keto_tpu.parallel import sharded as shard_mod
+
+            kerns += [
+                shard_mod.check_kernel(self._mesh),
+                shard_mod.label_kernel(self._mesh),
+            ]
+        for kern in kerns:
             clear = getattr(kern, "clear_cache", None)
             if clear is not None:
                 try:
@@ -1708,7 +1771,9 @@ class TpuCheckEngine:
         faults.check("cache-save")
         t0 = time.monotonic()
         with self.build_progress.phase("cache_save"):
-            path = snapcache.save_snapshot(snap, self._cache_dir)
+            path = snapcache.save_snapshot(
+                snap, self._cache_dir, shards=max(1, self._shard_count)
+            )
         if path is not None:
             self.maintenance.incr("cache_saves")
             self.maintenance.observe_ms(
@@ -1725,7 +1790,9 @@ class TpuCheckEngine:
         from keto_tpu.graph import snapcache
 
         t0 = time.monotonic()
-        path = snapcache.save_snapshot(snap, self._cache_dir)
+        path = snapcache.save_snapshot(
+            snap, self._cache_dir, shards=max(1, self._shard_count)
+        )
         if path is not None:
             self.maintenance.incr("cache_saves")
             self.maintenance.observe_ms("cache_save", (time.monotonic() - t0) * 1e3)
@@ -1740,6 +1807,10 @@ class TpuCheckEngine:
         slots — one tiny device scatter, no bucket re-upload."""
         patch = snap.ell_patch
         snap.ell_patch = None
+        if self._sharded:
+            if patch and snap.device_shards is not None:
+                self._apply_ell_patch_sharded(snap, patch)
+            return
         if not patch or snap.device_buckets is None:
             return
         by_bucket: dict[int, list] = {}
@@ -1763,6 +1834,29 @@ class TpuCheckEngine:
             bufs[bi] = self._guard_alloc("ell-patch", patch)
         snap.device_buckets = tuple(bufs)
 
+    def _apply_ell_patch_sharded(self, snap: GraphSnapshot, patch) -> None:
+        """Route pending device-bucket patches to the OWNING SHARD's slot
+        of the stacked arrays: each (bucket, row) maps to exactly one
+        shard by the spec's row-range assignment, the host stacked array
+        updates in place (it is the upload-truth the next re-upload
+        reuses), and only touched buckets' stacks re-upload — a handful
+        of slots, never a full snapshot."""
+        spec = snap.shard_spec
+        by_bucket: dict[int, list] = {}
+        for bi, row, col, val in patch:
+            s, pos = spec.patch_pos(snap.buckets[bi].offset, bi, row)
+            by_bucket.setdefault(bi, []).append((s, pos, col, val))
+        nbrs_dev = list(snap.device_shards[0])
+        for bi, entries in by_bucket.items():
+            host = spec.nbrs_sh[bi]
+            for s, pos, col, val in entries:
+                host[s, pos, col] = val
+            nbrs_dev[bi] = self._guard_alloc(
+                "ell-patch",
+                lambda h=host: jax.device_put(h, self._shard_stack_sharding),
+            )
+        snap.device_shards = (tuple(nbrs_dev), snap.device_shards[1])
+
     def _put_bucket(self, nbrs: np.ndarray, num_int: int):
         """Place one bucket matrix on device. On a mesh, rows pad up to a
         multiple of the graph axis with sentinel rows (gathered from the
@@ -1781,6 +1875,8 @@ class TpuCheckEngine:
         return jax.device_put(np.ascontiguousarray(nbrs), self._bucket_sharding)
 
     def _upload_buckets(self, snap: GraphSnapshot) -> None:
+        if self._sharded:
+            return self._upload_buckets_sharded(snap)
         # plan BEFORE uploading: during a swap the old snapshot's buckets
         # are still resident (in-flight batches gather them), so the plan
         # runs against live residency; the governor walks the eviction
@@ -1795,13 +1891,46 @@ class TpuCheckEngine:
         )
         self.hbm.register("snapshot", need)
 
+    def _upload_buckets_sharded(self, snap: GraphSnapshot) -> None:
+        """Sharded mode: partition the buckets into row-range shards
+        (keto_tpu/parallel/sharded.py) and place the stacked per-shard
+        arrays split over the graph axis. The per-shard owned bytes land
+        in the governor's per-shard ledger, so one hot shard is visible
+        — and binding — in the mesh-wide plan."""
+        from keto_tpu.parallel import sharded as shard_mod
+
+        spec = shard_mod.make_shard_spec(snap, self._shard_count)
+        need = spec.padded_bucket_bytes()
+        self._plan_or_refuse(
+            "snapshot buckets", need, per_shard=spec.owned_bucket_bytes
+        )
+        snap.shard_spec = spec
+        snap.device_shards = self._guard_alloc(
+            "snapshot-upload",
+            lambda: (
+                tuple(
+                    jax.device_put(a, self._shard_stack_sharding)
+                    for a in spec.nbrs_sh
+                ),
+                tuple(
+                    jax.device_put(a, self._shard_stack_sharding)
+                    for a in spec.dst_sh
+                ),
+            ),
+        )
+        self.hbm.register("snapshot", need)
+        self.hbm.register_shards("snapshot", spec.owned_bucket_bytes)
+
     def _upload_overlay(self, snap: GraphSnapshot) -> None:
         """Group overlay-ELL edges by destination into a [K, C] gather
         matrix (pow2-padded so repeated small deltas reuse compiled
         geometries) and place it on device."""
         if snap.ov_ell is None or snap.ov_ell.shape[0] == 0:
             snap.device_overlay = None
+            snap.device_shard_overlay = None
             self.hbm.register("overlay", 0)
+            if self._sharded:
+                self.hbm.register_shards("overlay", [0] * self._shard_count)
             return
         from keto_tpu.graph.overlay import overlay_device_bytes
 
@@ -1815,6 +1944,29 @@ class TpuCheckEngine:
         counts = np.diff(np.append(starts, dst.shape[0]))
         K = _ceil_pow2(uniq.shape[0])
         C = _ceil_pow2(int(counts.max()))
+        if self._sharded:
+            # route overlay rows to the shard owning their destination —
+            # the same row-range ownership the buckets partition by, so
+            # the kernel's overlay stage is local to each shard's slab
+            from keto_tpu.parallel import sharded as shard_mod
+
+            nbrs = np.full((uniq.shape[0], C), snap.num_int, np.int32)
+            for i, (s0, c) in enumerate(zip(starts, counts)):
+                nbrs[i, :c] = src[s0 : s0 + c]
+            ovn, ovd, owned = shard_mod.route_overlay(
+                snap.shard_spec, nbrs, uniq, snap.num_active
+            )
+            snap.device_overlay = None
+            snap.device_shard_overlay = self._guard_alloc(
+                "overlay-upload",
+                lambda: (
+                    jax.device_put(ovn, self._shard_stack_sharding),
+                    jax.device_put(ovd, self._shard_stack_sharding),
+                ),
+            )
+            self.hbm.register("overlay", int(ovn.nbytes + ovd.nbytes))
+            self.hbm.register_shards("overlay", owned)
+            return
         if self._mesh is not None:
             # overlay rows shard over the graph axis exactly like buckets
             # (replicated indices into the row-sharded bitmap would trip
@@ -1870,7 +2022,7 @@ class TpuCheckEngine:
             )
             self.maintenance.incr("label_builds")
             self.maintenance.observe_ms("label_build", snap.labels.build_ms)
-        if snap.device_labels is None:
+        if self._labels_dev(snap) is None:
             # plan before uploading; a plan that evicts the labels rung
             # itself (suspension) means the ladder chose to shed this
             # very family — honor it and drop the fresh build
@@ -1880,6 +2032,7 @@ class TpuCheckEngine:
             if not fits or self._labels_suspended or snap.labels is None:
                 snap.labels = None
                 snap.device_labels = None
+                snap.device_shard_labels = None
                 return
             self._upload_labels(snap)
             if self._labels_suspended:
@@ -1887,19 +2040,48 @@ class TpuCheckEngine:
                 # retry: the freshly placed arrays are already shed
                 snap.labels = None
                 snap.device_labels = None
+                snap.device_shard_labels = None
                 self.hbm.release("labels")
                 return
         idx = snap.labels
         self.maintenance.set_gauge("label_coverage", round(idx.coverage, 4))
         self.maintenance.set_gauge("label_entries", idx.n_entries)
 
+    def _labels_dev(self, snap: GraphSnapshot):
+        """The device label arrays this engine's dispatch mode reads —
+        the row-striped stacks in sharded mode, the replicated pair
+        otherwise."""
+        return snap.device_shard_labels if self._sharded else snap.device_labels
+
     def _upload_labels(self, snap: GraphSnapshot) -> None:
         idx = snap.labels
         if idx is None:
             snap.device_labels = None
+            snap.device_shard_labels = None
             return
         out_lab = np.ascontiguousarray(idx.out_lab)
         in_lab = np.ascontiguousarray(idx.in_lab)
+        if self._sharded:
+            # row-striped over the graph axis: the sharded intersection
+            # kernel reconstructs each pair's two rows with a one-shot
+            # psum exchange (keto_tpu/parallel/sharded.py)
+            from keto_tpu.parallel import sharded as shard_mod
+
+            out_sh, in_sh, rl, owned = shard_mod.route_labels(
+                out_lab, in_lab, self._shard_count
+            )
+            snap.device_labels = None
+            snap.device_shard_labels = self._guard_alloc(
+                "labels-upload",
+                lambda: (
+                    jax.device_put(out_sh, self._shard_stack_sharding),
+                    jax.device_put(in_sh, self._shard_stack_sharding),
+                    rl,
+                ),
+            )
+            self.hbm.register("labels", idx.device_bytes())
+            self.hbm.register_shards("labels", owned)
+            return
         if self._mesh is None:
             snap.device_labels = self._guard_alloc(
                 "labels-upload",
@@ -1935,7 +2117,7 @@ class TpuCheckEngine:
                 )
             return False
         self.maintenance.set_gauge("label_dirty_nodes", 0)
-        return snap.device_labels is not None
+        return self._labels_dev(snap) is not None
 
     def _warm_width_bytes(self, snap: GraphSnapshot, B: int) -> int:
         """Device bytes one warmed width holds live while its slice runs:
@@ -1975,45 +2157,63 @@ class TpuCheckEngine:
             e_q = np.zeros(B, np.int32)
             a_rows = np.full(B, ni, np.int32)
             targets = np.full(B, ni, np.int32)
-            buf, sizes = pack_entries(
-                (e_rows, e_q, e_rows, e_q, a_rows, e_q, targets)
-            )
-            ov = snap.device_overlay
-            self._guard_alloc(
-                "warm-compile",
-                lambda: _check_kernel(
-                    snap.device_buckets,
-                    jnp.asarray(buf),
-                    ov_nbrs=None if ov is None else ov[0],
-                    ov_dst=None if ov is None else ov[1],
-                    sizes=sizes,
-                    n_active=snap.num_active,
-                    n_int=ni,
-                    valid_rows=tuple(b.n for b in snap.buckets),
-                    it_cap=self._it_cap,
-                    block_iters=self._block_iters,
-                    bitmap_sharding=self._bitmap_sharding
-                    if self._mesh is not None and (B // 32) % self._mesh.shape.get("data", 1) == 0
-                    else (self._bitmap_sharding_rows_only if self._mesh is not None else None),
-                ).block_until_ready(),
-            )
+            packed = (e_rows, e_q, e_rows, e_q, a_rows, e_q, targets)
+            if self._sharded and snap.device_shards is not None:
+                dev = self._dispatch_sharded(snap, packed, self._it_cap)
+                self._guard_alloc(
+                    "warm-compile", lambda d=dev: d.dev.block_until_ready()
+                )
+            else:
+                buf, sizes = pack_entries(packed)
+                ov = snap.device_overlay
+                self._guard_alloc(
+                    "warm-compile",
+                    lambda: _check_kernel(
+                        snap.device_buckets,
+                        jnp.asarray(buf),
+                        ov_nbrs=None if ov is None else ov[0],
+                        ov_dst=None if ov is None else ov[1],
+                        sizes=sizes,
+                        n_active=snap.num_active,
+                        n_int=ni,
+                        valid_rows=tuple(b.n for b in snap.buckets),
+                        it_cap=self._it_cap,
+                        block_iters=self._block_iters,
+                        bitmap_sharding=self._bitmap_sharding
+                        if self._mesh is not None and (B // 32) % self._mesh.shape.get("data", 1) == 0
+                        else (self._bitmap_sharding_rows_only if self._mesh is not None else None),
+                    ).block_until_ready(),
+                )
             warmed += 1
             # one slice runs at a time: the warm family holds the WIDEST
             # warmed width's workspace, not the sum over widths
             warm_bytes = max(warm_bytes, need)
             self.hbm.register("warmup", warm_bytes)
-            if self._labels_enabled and snap.device_labels is not None:
+            labs = self._labels_dev(snap)
+            if self._labels_enabled and labs is not None:
                 pairs = np.concatenate(
                     [np.full(B, ni, np.int32), np.full(B, ni, np.int32),
                      np.zeros(B, np.int32)]
                 )
-                self._guard_alloc(
-                    "warm-compile",
-                    lambda: _label_kernel(
-                        snap.device_labels[0], snap.device_labels[1],
-                        jnp.asarray(pairs), n_pairs=B, B=B,
-                    ).block_until_ready(),
-                )
+                if self._sharded:
+                    from keto_tpu.parallel import sharded as shard_mod
+
+                    self._guard_alloc(
+                        "warm-compile",
+                        lambda: shard_mod.label_kernel(self._mesh)(
+                            labs[0], labs[1],
+                            jax.device_put(pairs, self._shard_repl_sharding),
+                            n_pairs=B, B=B, rl=labs[2],
+                        ).block_until_ready(),
+                    )
+                else:
+                    self._guard_alloc(
+                        "warm-compile",
+                        lambda: _label_kernel(
+                            labs[0], labs[1],
+                            jnp.asarray(pairs), n_pairs=B, B=B,
+                        ).block_until_ready(),
+                    )
                 warmed += 1
         self.maintenance.set_gauge("warm_widths_skipped", skipped)
         return warmed
@@ -2377,8 +2577,12 @@ class TpuCheckEngine:
 
             # BEFORE the empty-graph early-out: hosts disagreeing on
             # whether the graph is empty is exactly the divergence that
-            # must fail loudly rather than skew answers silently
-            verify_lockstep(snap.snapshot_id, tuples)
+            # must fail loudly rather than skew answers silently. The
+            # fingerprint covers the shard geometry too: a sharded
+            # program dispatched with mismatched shard counts would hang
+            # mismatched collectives, the failure lockstep exists to
+            # pre-empt.
+            verify_lockstep(snap.snapshot_id, tuples, shards=self._shard_count)
         if snap.n_nodes == 0 or snap.n_edges == 0 or not tuples:
             return [False] * len(tuples), snap.snapshot_id
         try:
@@ -2548,7 +2752,9 @@ class TpuCheckEngine:
                 if lockstep:
                     # per stream slice, BEFORE any dispatch (same contract
                     # as batch_check_with_token): divergence fails loudly
-                    verify_lockstep(snap.snapshot_id, batch)
+                    verify_lockstep(
+                        snap.snapshot_id, batch, shards=self._shard_count
+                    )
                 if snap.n_nodes == 0 or snap.n_edges == 0:
                     yield off, None, np.zeros(len(batch), dtype=bool), len(batch), batch
                     off += len(batch)
@@ -2762,22 +2968,55 @@ class TpuCheckEngine:
         lanes = np.arange(32, dtype=np.uint32)
         return ((f[:, None] >> lanes) & 1).astype(bool).ravel()[:nq]
 
-    @classmethod
-    def _decode_hybrid(cls, lab, bfs, bfs_pos, host_ans, nq):
+    @staticmethod
+    def _decode_packed_sharded(f: np.ndarray, host_ans: np.ndarray, nq: int):
+        """Decode one sharded kernel's packed ``uint32[W+3]`` output
+        (decision bits, iterations, truncation, frontier-bit population
+        — keto_tpu/parallel/sharded.py). Returns ``(bool[nq], iters,
+        truncated, frontier_bits)``."""
+        W = f.shape[0] - 3
+        lanes = np.arange(32, dtype=np.uint32)
+        bits = ((f[:W, None] >> lanes) & 1).astype(bool).ravel()[:nq]
+        return bits | host_ans[:nq], int(f[W]), bool(f[W + 1]), int(f[W + 2])
+
+    def _decode_bfs(self, f, host_ans, nq, halo_bytes_per_round=None):
+        """Decode one fetched BFS output of either flavor; sharded
+        outputs additionally feed the keto_shard_* counters (one halo
+        exchange per real hop). Returns ``(bool[nq], iters, trunc)``."""
+        if halo_bytes_per_round is not None:
+            bits, it, tr, fb = self._decode_packed_sharded(f, host_ans, nq)
+            self._note_sharded_stats(it, fb, halo_bytes_per_round)
+            return bits, it, tr
+        return self._decode_packed(f, host_ans, nq)
+
+    @staticmethod
+    def _raw_dev(part):
+        """The raw device array behind a slice part (``_ShardedSlice``
+        wraps one; everything else IS one)."""
+        return part.dev if isinstance(part, _ShardedSlice) else part
+
+    @staticmethod
+    def _bfs_halo(part) -> Optional[int]:
+        return (
+            part.halo_bytes_per_round
+            if isinstance(part, _ShardedSlice)
+            else None
+        )
+
+    def _decode_hybrid(self, lab, bfs, bfs_pos, host_ans, nq, bfs_halo=None):
         """Decode one label-routed slice from fetched arrays: label bits
         for the whole slice, BFS sub-batch bits scattered onto their
         positions. Only the BFS part can truncate."""
-        out = cls._decode_label_bits(lab, nq)
+        out = self._decode_label_bits(lab, nq)
         iters, trunc = 0, False
         if bfs is not None:
-            bits2, iters, trunc = cls._decode_packed(
-                bfs, host_ans[bfs_pos], bfs_pos.size
+            bits2, iters, trunc = self._decode_bfs(
+                bfs, host_ans[bfs_pos], bfs_pos.size, bfs_halo
             )
             out[bfs_pos] = bits2
         return out | host_ans[:nq], iters, trunc
 
-    @classmethod
-    def _unpack_slice(cls, dev, host_ans, nq):
+    def _unpack_slice(self, dev, host_ans, nq):
         """One slice's decisions. Returns ``(bool[nq], iters, truncated)``."""
         if dev is None:
             return host_ans[:nq], 0, False
@@ -2788,12 +3027,21 @@ class TpuCheckEngine:
                 else None
             )
             bfs = (
-                jax.device_get(dev.bfs_dev)
+                jax.device_get(self._raw_dev(dev.bfs_dev))
                 if dev.bfs_dev is not None
                 else None
             )
-            return cls._decode_hybrid(lab, bfs, dev.bfs_pos, host_ans, nq)
-        return cls._decode_packed(jax.device_get(dev), host_ans, nq)
+            return self._decode_hybrid(
+                lab, bfs, dev.bfs_pos, host_ans, nq,
+                bfs_halo=self._bfs_halo(dev.bfs_dev),
+            )
+        if isinstance(dev, _ShardedSlice):
+            bits, it, tr = self._decode_bfs(
+                jax.device_get(dev.dev), host_ans, nq,
+                dev.halo_bytes_per_round,
+            )
+            return bits, it, tr
+        return self._decode_packed(jax.device_get(dev), host_ans, nq)
 
     def _collect(self, results, n: int):
         """Fetch every dispatched slice in ONE device transfer and unpack.
@@ -2807,7 +3055,8 @@ class TpuCheckEngine:
             d = r[0]
             if d is None:
                 continue
-            devs.extend(d.parts() if isinstance(d, _HybridSlice) else [d])
+            parts = d.parts() if isinstance(d, _HybridSlice) else [d]
+            devs.extend(self._raw_dev(p) for p in parts)
         flat = None
         if devs:
             cat = jnp.concatenate(devs) if len(devs) > 1 else devs[0]
@@ -2830,9 +3079,14 @@ class TpuCheckEngine:
                 out[pos : pos + nq] = host_ans[:nq]
             elif isinstance(dev, _HybridSlice):
                 lab = take(dev.label_dev) if dev.label_dev is not None else None
-                bfs = take(dev.bfs_dev) if dev.bfs_dev is not None else None
+                bfs = (
+                    take(self._raw_dev(dev.bfs_dev))
+                    if dev.bfs_dev is not None
+                    else None
+                )
                 bits, it, tr = self._decode_hybrid(
-                    lab, bfs, dev.bfs_pos, host_ans, nq
+                    lab, bfs, dev.bfs_pos, host_ans, nq,
+                    bfs_halo=self._bfs_halo(dev.bfs_dev),
                 )
                 out[pos : pos + nq] = bits
                 if bfs is not None:
@@ -2841,7 +3095,10 @@ class TpuCheckEngine:
                 if tr:
                     trunc_idx.extend(range(pos, pos + nq))
             else:
-                bits, it, tr = self._decode_packed(take(dev), host_ans, nq)
+                bits, it, tr = self._decode_bfs(
+                    take(self._raw_dev(dev)), host_ans, nq,
+                    self._bfs_halo(dev),
+                )
                 out[pos : pos + nq] = bits
                 self.bfs_steps_stats.observe(float(it))
                 max_iters = max(max_iters, it)
@@ -2898,7 +3155,7 @@ class TpuCheckEngine:
           gaps), and over-fanout queries fall back.
         """
         idx = snap.labels
-        if idx is None or snap.device_labels is None:
+        if idx is None or self._labels_dev(snap) is None:
             # the eviction ladder dropped the labels between routing and
             # dispatch (concurrent OOM containment): BFS answers instead
             return self._device_batch(snap, sd, tg, multi, i0, i1, W, it_cap=it_cap)
@@ -2999,19 +3256,32 @@ class TpuCheckEngine:
                     np.concatenate([pq, np.zeros(pad, np.int64)]),
                 ]
             ).astype(np.int32)
-            if self._multiprocess:
-                from jax.sharding import NamedSharding, PartitionSpec as P_
+            dl = self._labels_dev(snap)
+            if self._sharded:
+                # row-sharded label arrays + replicated pairs: the kernel
+                # does the one-shot pair-row exchange internally
+                from keto_tpu.parallel import sharded as shard_mod
 
-                ebuf = jax.device_put(
-                    entries, NamedSharding(self._mesh, P_())
+                ebuf = jax.device_put(entries, self._shard_repl_sharding)
+                ldev = self._guard_alloc(
+                    "label-kernel",
+                    lambda: shard_mod.label_kernel(self._mesh)(
+                        dl[0], dl[1], ebuf, n_pairs=P, B=B, rl=dl[2]
+                    ),
                 )
             else:
-                ebuf = jnp.asarray(entries)
-            dl = snap.device_labels
-            ldev = self._guard_alloc(
-                "label-kernel",
-                lambda: _label_kernel(dl[0], dl[1], ebuf, n_pairs=P, B=B),
-            )
+                if self._multiprocess:
+                    from jax.sharding import NamedSharding, PartitionSpec as P_
+
+                    ebuf = jax.device_put(
+                        entries, NamedSharding(self._mesh, P_())
+                    )
+                else:
+                    ebuf = jnp.asarray(entries)
+                ldev = self._guard_alloc(
+                    "label-kernel",
+                    lambda: _label_kernel(dl[0], dl[1], ebuf, n_pairs=P, B=B),
+                )
 
         bfs_dev = None
         bfs_pos = None
@@ -3049,6 +3319,11 @@ class TpuCheckEngine:
             # no query in the chunk reaches the device: host_ans is the
             # whole answer
             return None, host_ans
+        if self._sharded and snap.device_shards is not None:
+            return (
+                self._dispatch_sharded(snap, packed, it_cap or self._it_cap),
+                host_ans,
+            )
         sharding = self._bitmap_sharding
         if self._mesh is not None:
             W = packed[-1].shape[0] // 32
@@ -3082,6 +3357,48 @@ class TpuCheckEngine:
             ),
         )
         return dev, host_ans
+
+    def _dispatch_sharded(self, snap: GraphSnapshot, packed, it_cap: int):
+        """Route one packed chunk's entries to their owning shards and
+        launch the shard_map BFS kernel (keto_tpu/parallel/sharded.py).
+        Returns a ``_ShardedSlice`` whose packed ``uint32[W+3]`` output
+        the collect paths decode — decisions bit-identical to the
+        single-device kernel, plus the halo/frontier stats words."""
+        from keto_tpu.parallel import sharded as shard_mod
+
+        spec = snap.shard_spec
+        B = packed[-1].shape[0]
+        entries, sizes = shard_mod.route_entries(spec, packed, B)
+        ebuf = jax.device_put(entries, self._shard_stack_sharding)
+        ov = snap.device_shard_overlay
+        dev = self._guard_alloc(
+            "check-kernel",
+            lambda: shard_mod.check_kernel(self._mesh)(
+                snap.device_shards[0],
+                snap.device_shards[1],
+                ebuf,
+                ov_nbrs=None if ov is None else ov[0],
+                ov_dst=None if ov is None else ov[1],
+                sizes=sizes,
+                rps=spec.rows_per_shard,
+                B=B,
+                it_cap=it_cap,
+                block_iters=self._block_iters,
+            ),
+        )
+        return _ShardedSlice(
+            dev, shard_mod.halo_bytes_per_round(spec, B // 32)
+        )
+
+    def _note_sharded_stats(self, iters: int, frontier_bits: int, halo_bytes_per_round: int) -> None:
+        """Turn one sharded slice's tail words into the keto_shard_*
+        counters: one halo exchange per real BFS hop."""
+        m = self.maintenance
+        if iters:
+            m.incr("shard_halo_rounds", by=iters)
+            m.incr("shard_halo_bytes", by=iters * halo_bytes_per_round)
+        if frontier_bits:
+            m.incr("shard_frontier_bits", by=frontier_bits)
 
     def subject_is_allowed(self, requested: RelationTuple) -> bool:
         """Single-query convenience with the oracle engine's signature

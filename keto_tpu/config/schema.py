@@ -170,6 +170,21 @@ CONFIG_SCHEMA = {
                     "default": 262144,
                     "description": "Rows per chunk of the streaming snapshot scan (the persisters' chunked-cursor seam): each chunk feeds the native intern worker pool while the cursor fetches the next, so store I/O overlaps interning during full rebuilds. Larger chunks amortize per-chunk overhead; smaller ones smooth the pipeline and bound buffered-chunk memory.",
                 },
+                "mesh_graph": {
+                    "type": "integer",
+                    "default": 1,
+                    "description": "Graph-axis size of the device mesh: how many shards the interior bitmap / bucket / label rows partition into by contiguous row range (keto_tpu/parallel/sharded.py). 1 (default) serves from a single device. Values > 1 require mesh_graph * mesh_data (or mesh_graph when mesh_data is auto) devices and enable multi-chip serving; decisions stay bit-identical to the single-device engine.",
+                },
+                "mesh_data": {
+                    "type": "integer",
+                    "default": 0,
+                    "description": "Data-axis size of the device mesh: query slices replicate along this axis. 0 = auto (every device not consumed by the graph axis). Only meaningful when mesh_graph > 1 or mesh_data > 1.",
+                },
+                "mesh_sharded": {
+                    "type": "boolean",
+                    "default": True,
+                    "description": "Mesh execution strategy: true (default) runs the explicit shard_map program — row-range shards with a per-hop halo exchange of the frontier bitmap slabs, per-shard HBM ledger, per-shard snapshot-cache segments, and the keto_shard_* metric families; false falls back to the legacy GSPMD path (XLA's partitioner infers the cross-shard traffic, no per-shard observability).",
+                },
                 "drain_timeout_s": {
                     "type": "number",
                     "default": 5.0,

@@ -171,6 +171,12 @@ class HbmGovernor:
     ):
         self._lock = threading.RLock()
         self._ledger: dict[str, int] = {}
+        # per-shard breakdown of tags the sharded engine mode registers
+        # (keto_tpu/parallel/sharded.py): tag → [bytes per shard]. Tags
+        # with no breakdown (replicated/transient state) spread evenly
+        # over the shards in the per-shard view.
+        self._n_shards = 1
+        self._shard_ledger: dict[str, list] = {}
         self._rungs: list[_Rung] = []
         self._depth = 0  # rungs currently evicted (prefix of _rungs)
         self._stats = stats  # MaintenanceStats or None
@@ -225,8 +231,50 @@ class HbmGovernor:
         """Drop ``tag`` from the ledger; returns the bytes released."""
         with self._lock:
             freed = self._ledger.pop(tag, 0)
+            self._shard_ledger.pop(tag, None)
             self._publish_locked()
             return freed
+
+    # -- per-shard ledger (sharded serving) ----------------------------------
+
+    def set_shard_count(self, n: int) -> None:
+        """Declare the graph-axis shard count the per-shard ledger and
+        per-shard budget slices divide by. Set once by the sharded
+        engine at construction."""
+        with self._lock:
+            self._n_shards = max(1, int(n))
+            self._shard_ledger = {}
+
+    def register_shards(self, tag: str, per_shard) -> None:
+        """Record ``tag``'s per-shard owned bytes (the unpadded rows each
+        shard actually holds). The global figure for ``tag`` is still
+        whatever ``register`` recorded — padding makes the two differ;
+        the per-shard view is the honest hot-shard account."""
+        with self._lock:
+            vals = [max(0, int(v)) for v in per_shard]
+            if len(vals) < self._n_shards:
+                vals += [0] * (self._n_shards - len(vals))
+            self._shard_ledger[tag] = vals[: self._n_shards]
+
+    def shard_resident_bytes(self) -> list:
+        """Per-shard resident bytes: tracked tags contribute their owned
+        slice, untracked tags spread evenly (replicated / transient
+        state is on every shard's devices)."""
+        with self._lock:
+            return self._shard_resident_locked()
+
+    def _shard_resident_locked(self) -> list:
+        n = self._n_shards
+        out = [0] * n
+        for tag, total in self._ledger.items():
+            per = self._shard_ledger.get(tag)
+            if per is None:
+                for s in range(n):
+                    out[s] += total // n
+            else:
+                for s in range(n):
+                    out[s] += per[s]
+        return out
 
     def resident_bytes(self) -> int:
         with self._lock:
@@ -296,15 +344,41 @@ class HbmGovernor:
         with self._lock:
             return self._evict_next_locked(reason or "oom")
 
-    def plan(self, nbytes: int, *, what: str = "", evict: bool = True) -> bool:
+    def plan(
+        self,
+        nbytes: int,
+        *,
+        what: str = "",
+        evict: bool = True,
+        per_shard=None,
+    ) -> bool:
         """Will ``nbytes`` more fit? Walks the eviction ladder (in order,
         at most once per rung) until it does; returns False only with
         every rung spent and the plan still over budget — the caller
         refuses the work (or, for optional work like warming one more
-        width, simply skips it with ``evict=False``)."""
+        width, simply skips it with ``evict=False``).
+
+        ``per_shard`` (sharded serving) additionally holds each shard's
+        incoming bytes against that shard's slice of the budget — the
+        HOTTEST shard is the binding constraint, and any rung the walk
+        evicts is MESH-WIDE (one ladder for every shard), so a single
+        over-full shard can never silently diverge the ladder."""
         need = max(0, int(nbytes))
+
+        def over_locked() -> bool:
+            if sum(self._ledger.values()) + need > self.budget_bytes:
+                return True
+            if per_shard is not None and self._n_shards > 1:
+                shard_budget = self.budget_bytes // self._n_shards
+                resident = self._shard_resident_locked()
+                for s in range(self._n_shards):
+                    add = int(per_shard[s]) if s < len(per_shard) else 0
+                    if resident[s] + add > shard_budget:
+                        return True
+            return False
+
         with self._lock:
-            while sum(self._ledger.values()) + need > self.budget_bytes:
+            while over_locked():
                 if not evict or self._evict_next_locked(f"planning {what or 'allocation'}") is None:
                     return False
             return True
@@ -381,6 +455,10 @@ class HbmGovernor:
                 "configured_budget_bytes": self.configured_budget,
                 "resident_bytes": sum(self._ledger.values()),
                 "ledger": dict(self._ledger),
+                "shards": (
+                    self._shard_resident_locked() if self._n_shards > 1 else []
+                ),
+                "shard_count": self._n_shards,
                 "rung": self._depth,
                 "rungs": [r.name for r in self._rungs],
                 "evicted": [r.name for r in self._rungs if r.evicted],

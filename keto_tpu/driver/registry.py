@@ -132,9 +132,31 @@ class Registry:
                         )
                 from keto_tpu.check.tpu_engine import TpuCheckEngine
 
+                # multi-chip serving (keto_tpu/parallel/sharded.py): a
+                # (graph, data) mesh over the local devices; graph > 1
+                # partitions the CSR/bitmap/label rows into row-range
+                # shards served by the explicit shard_map program
+                # (serve.mesh_sharded=false keeps the legacy GSPMD path)
+                mesh = None
+                mesh_sharded = False
+                mesh_graph = int(self._config.get("serve.mesh_graph", 1))
+                mesh_data = int(self._config.get("serve.mesh_data", 0))
+                if mesh_graph > 1 or mesh_data > 1:
+                    from keto_tpu.parallel import make_mesh
+
+                    mesh = make_mesh(
+                        graph=max(1, mesh_graph),
+                        data=mesh_data if mesh_data > 0 else None,
+                    )
+                    mesh_sharded = bool(
+                        self._config.get("serve.mesh_sharded", True)
+                    )
                 engine = TpuCheckEngine(
                     store,
                     self.namespaces_source(),
+                    mesh=mesh,
+                    shard_rows=mesh is not None,
+                    sharded=mesh_sharded,
                     it_cap=int(self._config.get("engine.it_cap", 4096)),
                     peel_seed_cap=float(self._config.get("engine.peel_seed_cap", 4.0)),
                     sync_rebuild_budget_s=float(
@@ -703,6 +725,54 @@ class Registry:
             "once successfully (the remainder escalate to the CPU "
             "fallback or a supervised refresh retry — never a crash).",
             hbm_scalar("oom_recoveries"),
+        )
+
+        # sharded serving (keto_tpu/parallel/sharded.py): the per-shard
+        # residency ledger and the halo-exchange / frontier counters the
+        # shard_map kernel's stats words feed
+        def shard_hbm():
+            snap = hbm_snapshot()
+            shards = snap.get("shards") or []
+            return [
+                ((str(s),), float(v)) for s, v in enumerate(shards)
+            ] or [(("0",), 0.0)]
+
+        m.register_callback(
+            "keto_shard_hbm_resident_bytes", "gauge",
+            "Per-shard device bytes resident under the governor's "
+            "per-shard ledger (owned bucket/overlay/label rows; "
+            "replicated state spreads evenly) — the hottest shard is "
+            "the binding constraint of every mesh-wide plan.",
+            shard_hbm, ("shard",),
+        )
+
+        def maint_counter(key):
+            def read():
+                counters, _, _ = maintenance_raw()
+                yield (), float(counters.get(key, 0))
+
+            return read
+
+        m.register_callback(
+            "keto_shard_halo_rounds_total", "counter",
+            "Halo-exchange rounds executed by the sharded BFS kernel: "
+            "one all-gather of every shard's frontier bitmap slab over "
+            "the graph axis per real BFS hop.",
+            maint_counter("shard_halo_rounds"),
+        )
+        m.register_callback(
+            "keto_shard_halo_bytes_total", "counter",
+            "Frontier-slab bytes received per device across all halo "
+            "rounds ((shards-1) x slab bytes per round) — the "
+            "interconnect cost of cross-shard reachability.",
+            maint_counter("shard_halo_bytes"),
+        )
+        m.register_callback(
+            "keto_shard_frontier_bits_total", "counter",
+            "Set bits in the fixpoint frontier bitmaps summed over "
+            "shards and dispatches — the reachability work the mesh "
+            "actually performed.",
+            maint_counter("shard_frontier_bits"),
         )
 
         # sampled shadow-parity auditor (serve.audit_sample_rate)

@@ -195,6 +195,23 @@ class GovernedSorter:
                 gov.release("build")
 
 
+def shard_row_ranges(n_rows: int, n_shards: int) -> list:
+    """Contiguous ``[lo, hi)`` row ranges assigning ``n_rows`` rows to
+    ``n_shards`` equal slabs of ``ceil(n_rows / n_shards)`` rows each (the
+    last may be short). This is THE shard assignment of the sharded
+    serving path: keto_tpu/parallel/sharded.py partitions the bitmap /
+    bucket / label rows with it at upload time, and the snapshot cache
+    (keto_tpu/graph/snapcache.py FORMAT_VERSION 6) stripes its bucket
+    segments with the same ranges so a mesh cold-starts by loading each
+    shard's stripe in parallel — one function, one assignment, no drift."""
+    n_shards = max(1, int(n_shards))
+    rps = -(-max(1, int(n_rows)) // n_shards)  # ceil div; ≥ 1
+    return [
+        (min(s * rps, n_rows), min((s + 1) * rps, n_rows))
+        for s in range(n_shards)
+    ]
+
+
 def make_device_sorter() -> Optional[DeviceSorter]:
     """A ``DeviceSorter`` when a backend is present, else None. The
     caller gates on size and on the HBM governor's plan; this only

@@ -491,6 +491,8 @@ def apply_delta(
         lst_dirty=lst_dirty,
         lab_dirty=lab_dirty or None,
         device_overlay=None,  # engine re-uploads (cheap: overlay is small)
+        device_shard_overlay=None,  # same contract for the sharded route
+
         _pattern_cache={},
         _cache_lock=__import__("threading").Lock(),
     )

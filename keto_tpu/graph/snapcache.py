@@ -61,8 +61,16 @@ from keto_tpu.graph.snapshot import Bucket, GraphSnapshot
 #: pipeline stage that produces them (``meta.json`` ``groups``), written
 #: in stage order at save time and verified+mapped in parallel at load,
 #: so a mesh cold-starts shards concurrently and a single process's
-#: reload is bounded by the slowest group, not the sum.
-FORMAT_VERSION = 5
+#: reload is bounded by the slowest group, not the sum. v6: PER-SHARD
+#: bucket segments — a sharded engine (keto_tpu/parallel/sharded.py)
+#: saves each bucket matrix striped by the serve-time shard row ranges
+#: (``graph/device_build.shard_row_ranges`` — the same assignment the
+#: upload partitions by), one ``bucket_<i>_s<j>.npy`` per shard in its
+#: own ``shard<j>`` segment group, so a mesh cold start verifies and
+#: loads every shard's stripe in parallel and reassembles the exact
+#: single-device byte layout. Single-shard saves keep whole-file
+#: buckets (and their lazy mmap reload).
+FORMAT_VERSION = 6
 
 #: caches kept per format version within a directory (newest watermarks
 #: win). Retention never reaches across versions: a v(N-1) cache written
@@ -79,7 +87,13 @@ QUARANTINE_KEEP = 2
 #: renumbering), "interner" with the string tables, "reverse" with the
 #: transposed orientation, "labels" with the 2-hop index. The loader
 #: verifies and maps groups concurrently.
+_SHARD_SEG_RE = re.compile(r"^bucket_\d+_s(\d+)\.npy$")
+
+
 def _group_of(name: str) -> str:
+    m = _SHARD_SEG_RE.match(name)
+    if m is not None:
+        return f"shard{int(m.group(1))}"
     if name.startswith(("rev_",)):
         return "reverse"
     if name.startswith("lab_"):
@@ -291,13 +305,21 @@ class CachedInterned:
         return self._str_of("leaf", idx)
 
 
-def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
+def save_snapshot(
+    snap: GraphSnapshot, cache_dir: str, shards: int = 1
+) -> Optional[str]:
     """Serialize ``snap`` under ``cache_dir``; returns the cache path, or
     None when the snapshot isn't cacheable (pending overlay, an interner
     without code-table sizes, or key codes outside the packed-index
-    range). Atomic: written to a temp dir and renamed into place."""
+    range). Atomic: written to a temp dir and renamed into place.
+
+    ``shards > 1`` (the sharded engine passes its graph-axis count)
+    stripes each bucket matrix into per-shard row segments along the
+    serve-time shard assignment, so a mesh cold start loads shards in
+    parallel; reassembly is byte-identical to the single-file layout."""
     if snap.has_overlay:
         return None
+    shards = max(1, int(shards))
     interned = snap.interned
     n_obj = getattr(interned, "num_obj_codes", lambda: None)()
     n_rel = getattr(interned, "num_rel_codes", lambda: None)()
@@ -336,8 +358,31 @@ def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
         if snap.rev_indptr is not None:
             sv("rev_indptr", snap.rev_indptr)
             sv("rev_indices", snap.rev_indices)
-        for i, b in enumerate(snap.buckets):
-            sv(f"bucket_{i}", b.nbrs)
+        if shards > 1:
+            # per-shard bucket stripes: rows split by the SERVE-TIME
+            # shard ownership (graph/device_build.shard_row_ranges over
+            # the global bitmap rows — bucket rows are contiguous bitmap
+            # rows starting at the bucket offset); the last stripe also
+            # carries the bucket's pow2 padding rows so concatenating
+            # stripes in shard order reproduces the exact matrix
+            from keto_tpu.graph.device_build import shard_row_ranges
+
+            ranges = shard_row_ranges(snap.num_int + 1, shards)
+            rps = max(1, ranges[0][1] - ranges[0][0])
+            for i, b in enumerate(snap.buckets):
+                nbrs = np.asarray(b.nbrs)
+                n_pad = nbrs.shape[0]
+                cuts = [0]
+                for s in range(shards - 1):
+                    cuts.append(
+                        int(np.clip((s + 1) * rps - b.offset, 0, b.n))
+                    )
+                cuts.append(n_pad)
+                for s in range(shards):
+                    sv(f"bucket_{i}_s{s}", nbrs[cuts[s] : cuts[s + 1]])
+        else:
+            for i, b in enumerate(snap.buckets):
+                sv(f"bucket_{i}", b.nbrs)
         sv("key_ns", key_ns)
         sv("key_obj", key_obj)
         sv("key_rel", key_rel)
@@ -401,6 +446,7 @@ def save_snapshot(snap: GraphSnapshot, cache_dir: str) -> Optional[str]:
             "num_live": int(snap.num_live),
             "n_peeled": int(snap.n_peeled),
             "buckets": [{"offset": int(b.offset), "n": int(b.n)} for b in snap.buckets],
+            "shards": shards,
             "n_obj": int(n_obj),
             "n_rel": int(n_rel),
             "labels": lab_meta,
@@ -570,10 +616,26 @@ def load_snapshot(path: str, verify: bool = True, sorter=None) -> GraphSnapshot:
         _verify_segments(d, meta)
     interned = CachedInterned(d, meta)
     mm = lambda name: np.load(d / name, mmap_mode="r")  # noqa: E731
-    buckets = [
-        Bucket(offset=int(b["offset"]), n=int(b["n"]), nbrs=mm(f"bucket_{i}.npy"))
-        for i, b in enumerate(meta["buckets"])
-    ]
+    n_shards = int(meta.get("shards", 1))
+    if n_shards > 1:
+        # per-shard stripes reassemble concurrently — the mesh cold
+        # start's parallel-shard load; concatenation in shard order is
+        # byte-identical to the single-file layout by construction
+        def load_bucket(i):
+            stripes = [mm(f"bucket_{i}_s{s}.npy") for s in range(n_shards)]
+            return np.concatenate([np.asarray(a) for a in stripes], axis=0)
+
+        with ThreadPoolExecutor(max_workers=VERIFY_WORKERS) as pool:
+            nbrs_list = list(pool.map(load_bucket, range(len(meta["buckets"]))))
+        buckets = [
+            Bucket(offset=int(b["offset"]), n=int(b["n"]), nbrs=nbrs_list[i])
+            for i, b in enumerate(meta["buckets"])
+        ]
+    else:
+        buckets = [
+            Bucket(offset=int(b["offset"]), n=int(b["n"]), nbrs=mm(f"bucket_{i}.npy"))
+            for i, b in enumerate(meta["buckets"])
+        ]
     labels = None
     lm = meta.get("labels")
     if lm is not None:

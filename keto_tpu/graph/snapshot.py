@@ -312,6 +312,23 @@ class GraphSnapshot:
     lst_dirty: bool = False
     device_list: Any = None  # per-orientation jnp arrays, list-engine-set
 
+    # -- sharded serving (keto_tpu/parallel/sharded.py) ----------------------
+    #: row-range shard partitioning of the bucket matrices, built at
+    #: upload time by the sharded engine mode; None on single-device /
+    #: GSPMD engines. Deltas carry it (the base layout is unchanged);
+    #: compaction and rebuilds re-derive it with the fresh buckets.
+    shard_spec: Any = None
+    #: stacked per-shard device arrays: (bucket nbrs tuple, bucket dst
+    #: tuple), each [n_shards, ...] sharded over the mesh's graph axis
+    device_shards: Any = None
+    #: per-shard overlay-ELL gather arrays (nbrs, dst), routed by
+    #: destination-row ownership; reset to None by apply_delta exactly
+    #: like device_overlay (the engine re-routes + re-uploads)
+    device_shard_overlay: Any = None
+    #: row-striped label arrays (out, in, rows_per_shard) for the sharded
+    #: label-intersection kernel
+    device_shard_labels: Any = None
+
     # -- 2-hop reachability labels (keto_tpu/graph/labels.py) ----------------
     #: pruned-landmark label index over interior rows, built at snapshot
     #: build time; None when disabled or not yet built

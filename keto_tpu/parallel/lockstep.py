@@ -39,25 +39,36 @@ import numpy as np
 from keto_tpu.relationtuple.model import RelationTuple
 
 
-def batch_fingerprint(snapshot_id: int, tuples: Sequence[RelationTuple]) -> int:
-    """Order-sensitive 64-bit fingerprint of (snapshot id, batch) — stable
-    across hosts and processes (no Python hash randomization)."""
+def batch_fingerprint(
+    snapshot_id: int, tuples: Sequence[RelationTuple], shards: int = 0
+) -> int:
+    """Order-sensitive 64-bit fingerprint of (snapshot id, batch, shard
+    geometry) — stable across hosts and processes (no Python hash
+    randomization). ``shards`` covers the sharded program's graph-axis
+    partition count: hosts dispatching the same batch over different
+    shard geometries would hang mismatched collectives, so the geometry
+    is part of the agreement the fingerprint proves."""
     h = hashlib.blake2b(digest_size=8)
     h.update(str(snapshot_id).encode())
-    h.update(b"\x00")  # unambiguous (id, batch) framing
+    h.update(b"\x00")  # unambiguous (id, shards, batch) framing
+    if shards:
+        h.update(b"s%d" % shards)
+        h.update(b"\x00")
     for t in tuples:
         h.update(str(t).encode())
         h.update(b"\x00")
     return int.from_bytes(h.digest(), "little")
 
 
-def verify_lockstep(snapshot_id: int, tuples: Sequence[RelationTuple]) -> None:
+def verify_lockstep(
+    snapshot_id: int, tuples: Sequence[RelationTuple], shards: int = 0
+) -> None:
     """All-gather the batch fingerprint across processes; raise with every
     host's value when they disagree (the loud alternative to a hang)."""
     import jax
     from jax.experimental import multihost_utils
 
-    fp = batch_fingerprint(snapshot_id, tuples)
+    fp = batch_fingerprint(snapshot_id, tuples, shards=shards)
     gathered = np.asarray(
         multihost_utils.process_allgather(np.asarray([fp], np.uint64))
     ).reshape(-1)
@@ -87,6 +98,45 @@ def _bcast_payload(payload: Optional[bytes]) -> bytes:
     return out.tobytes()
 
 
+class LocalTransport:
+    """In-process replication transport: N endpoints linked by queues,
+    with the same broadcast contract as the jax multihost path (primary
+    passes the payload, followers pass None and receive it).
+
+    Exists because jax's CPU backend cannot run true multiprocess
+    collectives (``Multiprocess computations aren't implemented on the
+    CPU backend``) — the long-standing reason the multihost tier-1 tests
+    could only env-skip. With the transport seam, the LockstepFrontend's
+    replication logic (serialization, ordering, follower execution) is
+    exercised for real on a virtual-device mesh; the jax transport stays
+    the production path on an actual pod.
+    """
+
+    @classmethod
+    def make(cls, n: int) -> list:
+        import queue
+
+        qs = [queue.Queue() for _ in range(n - 1)]
+        return [cls(i, qs) for i in range(n)]
+
+    def __init__(self, index: int, queues: list):
+        self._index = index
+        self._queues = queues
+
+    @property
+    def process_index(self) -> int:
+        return self._index
+
+    def broadcast(self, payload: Optional[bytes]) -> bytes:
+        if self._index == 0:
+            assert payload is not None
+            for q in self._queues:
+                q.put(payload)
+            return payload
+        assert payload is None
+        return self._queues[self._index - 1].get()
+
+
 class LockstepFrontend:
     """Request-replicating ingress for a multi-controller engine.
 
@@ -95,14 +145,22 @@ class LockstepFrontend:
     All hosts execute every op identically — only host 0 takes external
     traffic, yet every host's store and device snapshot advance in
     lockstep (the 2-process test asserts identical decision streams).
+
+    ``transport`` overrides the replication channel: None (default) uses
+    the jax multihost broadcast (real pods); a ``LocalTransport``
+    endpoint wires frontends within one process (virtual-mesh tests).
     """
 
-    def __init__(self, engine, store):
-        import jax
-
+    def __init__(self, engine, store, transport=None):
         self._engine = engine
         self._store = store
-        self._primary = jax.process_index() == 0
+        self._transport = transport
+        if transport is not None:
+            self._primary = transport.process_index == 0
+        else:
+            import jax
+
+            self._primary = jax.process_index() == 0
 
     # -- primary API ---------------------------------------------------------
 
@@ -159,7 +217,10 @@ class LockstepFrontend:
         return result
 
     def _recv_and_run(self, payload: Optional[bytes]):
-        raw = _bcast_payload(payload)
+        if self._transport is not None:
+            raw = self._transport.broadcast(payload)
+        else:
+            raw = _bcast_payload(payload)
         op_dict = json.loads(raw.rstrip(b"\0").decode())
         op = op_dict["op"]
         if op == "stop":

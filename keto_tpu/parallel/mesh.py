@@ -25,6 +25,22 @@ GRAPH_AXIS = "graph"
 DATA_AXIS = "data"
 
 
+def _backend_initialized() -> bool:
+    """Has jax already initialized a backend in this process? After that
+    point, platform/device-count configuration is dead weight — the
+    backend snapshotted the flags — so ``init_distributed`` must fail
+    loudly instead of silently no-opping into a mis-provisioned mesh."""
+    try:
+        from jax._src import xla_bridge
+
+        probe = getattr(xla_bridge, "backends_are_initialized", None)
+        if probe is not None:
+            return bool(probe())
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return False
+
+
 def init_distributed(
     coordinator_address: str,
     num_processes: int,
@@ -49,6 +65,18 @@ def init_distributed(
     front-end that replicates requests to every host in order provides
     this; independently load-balanced traffic does NOT.
     """
+    if (platform or local_device_count is not None) and _backend_initialized():
+        # both knobs apply via config/flags read at BACKEND initialization;
+        # once a backend exists they are silently inert — which previously
+        # produced a mesh over the wrong platform/device count with no
+        # error until collectives hung. Fail loudly at the call site.
+        raise RuntimeError(
+            "init_distributed(platform=..., local_device_count=...) called "
+            "after the jax backend was already initialized: the settings "
+            "cannot take effect. Call init_distributed before any device "
+            "use (jax.devices(), device_put, jit execution) in this "
+            "process, or drop the platform/local_device_count overrides."
+        )
     if platform:
         # env-var writes are useless here — jax snapshots JAX_PLATFORMS at
         # import — but the config entry is read at backend init

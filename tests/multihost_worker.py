@@ -1,36 +1,59 @@
-"""Worker for the two-process multi-host test (tests/test_multihost.py).
+"""Worker for the cross-process mesh tests (tests/test_multihost.py).
 
-Usage: python multihost_worker.py <process_id> <coordinator_port>
+Usage: python multihost_worker.py <process_id> [graph_axis]
 
-Joins a 2-process multi-controller runtime (4 virtual CPU devices per
-"host" → one global 8-device mesh), builds the SAME seeded store in each
-process (the analog of the reference's replicas sharing one database),
-answers an identical check batch over the pod-wide (graph=2, data=4)
-mesh, and compares every decision with the local recursive oracle.
+Each invocation poses as one independent serving host: a single-process
+jax runtime over 8 VIRTUAL CPU devices (``--xla_force_host_platform_
+device_count`` — set here, before jax imports), a ``(graph, data)`` mesh
+over them, and the SHARDED check engine (keto_tpu/parallel/sharded.py)
+answering a seeded workload — fuzzing the shard_map halo-exchange program
+against the local recursive oracle, including a post-write refresh
+(delta overlay) and a tombstone delete.
+
+Why not ``jax.distributed``: the CPU backend cannot run true
+multiprocess computations ("Multiprocess computations aren't implemented
+on the CPU backend"), which is why these tests could only env-skip for
+eleven PRs. What a multi-controller pod REQUIRES of each host is that
+the same inputs produce the same decision stream — the lockstep
+contract's precondition — so the parent test runs two of these workers
+as separate OS processes and asserts their decision-stream digests are
+IDENTICAL, alongside the per-decision oracle parity each asserts itself.
+Set ``KETO_MULTIHOST_DISTRIBUTED=1`` (and pass a coordinator port as
+argv[2]) on a real pod to exercise the true ``jax.distributed`` runtime
+instead.
 """
 
+import hashlib
 import os
 import random
 import sys
 
 
+def _virtual_devices(n: int) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n}".strip()
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
 def main() -> int:
-    pid, port = int(sys.argv[1]), sys.argv[2]
+    pid = int(sys.argv[1])
+    graph_axis = int(sys.argv[2]) if len(sys.argv) > 2 else 2
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-    from keto_tpu.parallel.mesh import init_distributed
+    if os.environ.get("KETO_MULTIHOST_DISTRIBUTED") == "1":
+        # real pod / backend with multiprocess support: join a genuine
+        # 2-process multi-controller runtime (argv[3] = coordinator port)
+        from keto_tpu.parallel.mesh import init_distributed
 
-    # platform/device-count go through init_distributed itself (applied
-    # via jax config/flags, which are read at backend init — after import
-    # is fine, before first device use is required)
-    init_distributed(
-        f"127.0.0.1:{port}", num_processes=2, process_id=pid,
-        local_device_count=4, platform="cpu",
-    )
+        init_distributed(
+            f"127.0.0.1:{sys.argv[3]}", num_processes=2, process_id=pid,
+            local_device_count=4, platform="cpu",
+        )
+    else:
+        _virtual_devices(8)
     import jax
-
-    assert jax.process_count() == 2, jax.process_count()
-    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
 
     from keto_tpu import namespace as namespace_pkg
     from keto_tpu.check import CheckEngine
@@ -42,7 +65,7 @@ def main() -> int:
     def T(ns, obj, rel, sub):
         return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
 
-    # deterministic store — identical in both processes
+    # deterministic store — identical in every process
     rng = random.Random(7)
     nm = namespace_pkg.MemoryManager(
         [namespace_pkg.Namespace(id=1, name="g"), namespace_pkg.Namespace(id=2, name="d")]
@@ -58,11 +81,25 @@ def main() -> int:
             else SubjectSet(rng.choice(names), rng.choice(objs), rng.choice(rels))
         )
         tuples.append(T(rng.choice(names), rng.choice(objs), rng.choice(rels), sub))
+    # nesting chains so the sharded program has real interior buckets
+    for i in range(6):
+        tuples.append(T("g", f"o{i}", "r0", SubjectSet("g", f"o{(i + 1) % 10}", "r0")))
     p.write_relation_tuples(*tuples)
 
-    mesh = make_mesh(graph=2)  # pod-wide: 2×4 over both processes
-    engine = TpuCheckEngine(p, p.namespaces, mesh=mesh, shard_rows=True)
-    assert engine._multiprocess
+    mesh = make_mesh(graph=graph_axis)
+    engine = TpuCheckEngine(p, p.namespaces, mesh=mesh, sharded=True)
+    assert engine.shard_count == graph_axis
+
+    digest = hashlib.blake2b(digest_size=16)
+    oracle = CheckEngine(p)
+
+    def run_batch(queries):
+        got, token = engine.batch_check_with_token(queries)
+        for q, g in zip(queries, got):
+            w = oracle.subject_is_allowed(q)
+            assert g == w, f"p{pid} divergence on {q}: sharded={g} oracle={w}"
+        digest.update(bytes(got))
+        digest.update(str(token).encode())
 
     queries = []
     for _ in range(100):
@@ -72,18 +109,24 @@ def main() -> int:
             else SubjectSet(rng.choice(names), rng.choice(objs), rng.choice(rels))
         )
         queries.append(T(rng.choice(names + ["nope"]), rng.choice(objs), rng.choice(rels), sub))
+    run_batch(queries)
 
-    got = engine.batch_check(queries)
-    oracle = CheckEngine(p)
-    for q, g in zip(queries, got):
-        w = oracle.subject_is_allowed(q)
-        assert g == w, f"p{pid} divergence on {q}: mesh={g} oracle={w}"
-
-    # write path: both processes apply the same delta, snapshot refreshes
-    # (delta overlay or rebuild), answers flip identically pod-wide
+    # write path: a delta applies, the sharded overlay stage serves it
     p.write_relation_tuples(T("g", "o0", "r0", SubjectID("newbie")))
     assert engine.subject_is_allowed(T("g", "o0", "r0", SubjectID("newbie")))
+    run_batch(queries)
 
+    # tombstone delete rides the same delta/patch routing
+    p.delete_relation_tuples(T("g", "o0", "r0", SubjectID("newbie")))
+    run_batch(queries)
+
+    # halo exchange actually ran (the 2-shard program crossed the axis)
+    counters, _, _ = engine.maintenance.raw()
+    if graph_axis > 1:
+        assert counters.get("shard_halo_rounds", 0) > 0
+        assert counters.get("shard_halo_bytes", 0) > 0
+
+    print(f"MULTIHOST_DIGEST p{pid} {digest.hexdigest()}", flush=True)
     print(f"MULTIHOST_OK p{pid}", flush=True)
     return 0
 

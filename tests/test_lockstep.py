@@ -41,3 +41,48 @@ def test_verify_lockstep_raises_on_divergence(monkeypatch):
     )
     with pytest.raises(RuntimeError, match="lockstep divergence"):
         lockstep.verify_lockstep(5, [T("g", "o", "r", SubjectID("u"))])
+
+
+def test_fingerprint_covers_shard_geometry():
+    """Hosts dispatching the same batch over different shard counts would
+    hang mismatched collectives — the geometry is part of the agreement."""
+    batch = [T("g", "o", "r", SubjectID("u"))]
+    f0 = lockstep.batch_fingerprint(7, batch)
+    assert f0 == lockstep.batch_fingerprint(7, batch, shards=0)  # back-compat
+    f2 = lockstep.batch_fingerprint(7, batch, shards=2)
+    f4 = lockstep.batch_fingerprint(7, batch, shards=4)
+    assert len({f0, f2, f4}) == 3
+
+
+def test_local_transport_broadcast_order():
+    """The in-process replication transport delivers the primary's
+    payloads to every follower in order, matching the jax broadcast
+    contract (primary passes bytes, followers pass None)."""
+    eps = lockstep.LocalTransport.make(3)
+    assert [e.process_index for e in eps] == [0, 1, 2]
+    for payload in (b"alpha", b"beta"):
+        assert eps[0].broadcast(payload) == payload
+    for f in eps[1:]:
+        assert f.broadcast(None) == b"alpha"
+        assert f.broadcast(None) == b"beta"
+
+
+def test_init_distributed_fails_loudly_after_backend_init():
+    """Regression: platform/local_device_count apply via flags read at
+    backend initialization; calling init_distributed after a backend
+    exists used to silently no-op into a mis-provisioned mesh. It must
+    raise instead. (The conftest already initialized the CPU backend.)"""
+    import jax
+
+    from keto_tpu.parallel import mesh
+
+    jax.devices()  # ensure the backend is up (conftest usually did)
+    assert mesh._backend_initialized()
+    with pytest.raises(RuntimeError, match="already initialized"):
+        mesh.init_distributed(
+            "127.0.0.1:1", num_processes=1, process_id=0, platform="cpu"
+        )
+    with pytest.raises(RuntimeError, match="already initialized"):
+        mesh.init_distributed(
+            "127.0.0.1:1", num_processes=1, process_id=0, local_device_count=4
+        )

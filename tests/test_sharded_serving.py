@@ -1,0 +1,344 @@
+"""Sharded serving (keto_tpu/parallel/sharded.py): bit-parity fuzz,
+per-shard HBM ledger, per-shard snapshot-cache segments, halo counters.
+
+The acceptance bar: the sharded engine on a ≥4-virtual-device CPU mesh is
+bit-identical to the single-device engine AND the CPU oracle under fuzz —
+overlay churn, tombstones, wildcards, compactions, label hits and BFS
+fallbacks — the per-shard cache segments cold-start, and an injected
+single-shard OOM walks the MESH-WIDE eviction ladder without a wrong
+answer.
+"""
+
+import os
+import random
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from keto_tpu.check import CheckEngine
+from keto_tpu.check.tpu_engine import TpuCheckEngine
+from keto_tpu.parallel import make_mesh
+from keto_tpu.parallel.sharded import make_shard_spec, route_entries, shard_row_ranges
+from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU mesh"
+)
+
+
+def T(ns, obj, rel, sub):
+    return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+
+def _nested_store(make_persister, rng, n_random=150):
+    """A store with real interior chains (docs→leaf→mid→top groups) so
+    the sharded program has active buckets, plus random noise tuples."""
+    p = make_persister([("g", 1), ("d", 2), ("", 3)])
+    objs = [f"o{i}" for i in range(10)]
+    users = [f"u{i}" for i in range(8)]
+    tuples = []
+    for i, o in enumerate(objs):
+        tuples.append(T("d", o, "view", SubjectSet("g", f"leaf{i % 4}", "m")))
+    for i in range(4):
+        tuples.append(T("g", f"leaf{i}", "m", SubjectSet("g", f"mid{i % 2}", "m")))
+    for i in range(2):
+        tuples.append(T("g", f"mid{i}", "m", SubjectSet("g", "top", "m")))
+    for i, u in enumerate(users):
+        tuples.append(
+            T("g", "top", "m", SubjectID(u))
+            if i < 4
+            else T("g", f"leaf{i % 4}", "m", SubjectID(u))
+        )
+    names = ["g", "d", ""]
+    rels = ["m", "view", ""]
+    for _ in range(n_random):
+        sub = (
+            SubjectID(rng.choice(users))
+            if rng.random() < 0.4
+            else SubjectSet(rng.choice(names), rng.choice(objs), rng.choice(rels))
+        )
+        tuples.append(
+            T(rng.choice(names), rng.choice(objs), rng.choice(rels), sub)
+        )
+    p.write_relation_tuples(*tuples)
+    return p, objs, users
+
+
+def _queries(rng, objs, users, n=120):
+    """A mix that exercises label hits, BFS fallbacks, wildcards, ghosts."""
+    out = []
+    for _ in range(n):
+        r = rng.random()
+        if r < 0.5:
+            out.append(T("d", rng.choice(objs), "view", SubjectID(rng.choice(users + ["ghost"]))))
+        elif r < 0.7:
+            out.append(T("g", rng.choice(["leaf0", "top", "mid1"]), "m", SubjectID(rng.choice(users))))
+        elif r < 0.85:
+            out.append(T("", rng.choice(objs), "", SubjectID(rng.choice(users))))
+        else:
+            out.append(T("d", "", "view", SubjectSet("g", rng.choice(["leaf1", "top"]), "m")))
+    return out
+
+
+def _assert_parity(tag, store, queries, sharded, single):
+    oracle = CheckEngine(store)
+    got = sharded.batch_check(queries)
+    ref = single.batch_check(queries)
+    for q, a, b in zip(queries, got, ref):
+        w = oracle.subject_is_allowed(q)
+        assert a == w == b, f"{tag}: {q}: sharded={a} single={b} oracle={w}"
+
+
+@needs_mesh
+@pytest.mark.parametrize("graph_axis", [2, 4, 8])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_sharded_engine_matches_single_and_oracle(make_persister, graph_axis, seed):
+    rng = random.Random(seed)
+    p, objs, users = _nested_store(make_persister, rng)
+    mesh = make_mesh(devices=jax.devices()[:graph_axis], graph=graph_axis, data=1)
+    single = TpuCheckEngine(p, p.namespaces)
+    sharded = TpuCheckEngine(p, p.namespaces, mesh=mesh, sharded=True)
+    assert sharded.shard_count == graph_axis
+    _assert_parity(f"g={graph_axis}", p, _queries(rng, objs, users), sharded, single)
+    counters, _, _ = sharded.maintenance.raw()
+    if graph_axis > 1:
+        assert counters.get("shard_halo_rounds", 0) > 0
+        assert counters.get("shard_halo_bytes", 0) > 0
+    assert counters.get("shard_frontier_bits", 0) > 0
+
+
+@needs_mesh
+def test_sharded_fuzz_overlay_tombstone_compaction(make_persister):
+    """The acceptance fuzz: delta overlays (incl. interior inserts that
+    dirty the label index → BFS fallback), tombstone deletes, and a
+    forced compaction, with parity re-asserted at every stage on a
+    (2, 4) mesh — data axis replicating, graph axis sharding."""
+    rng = random.Random(42)
+    p, objs, users = _nested_store(make_persister, rng)
+    mesh = make_mesh(graph=2)
+    single = TpuCheckEngine(
+        p, p.namespaces, overlay_edge_budget=8, compact_after_s=3600
+    )
+    sharded = TpuCheckEngine(
+        p, p.namespaces, mesh=mesh, sharded=True,
+        overlay_edge_budget=8, compact_after_s=3600,
+    )
+    _assert_parity("base", p, _queries(rng, objs, users), sharded, single)
+    c0 = sharded.maintenance.raw()[0]
+    assert c0.get("label_checks", 0) > 0, "label fast path never exercised"
+    assert c0.get("label_fallbacks", 0) > 0, "BFS fallback never exercised"
+
+    # delta overlay: sink insert + direct grant
+    p.write_relation_tuples(
+        T("g", "leaf2", "m", SubjectID("newbie")),
+        T("d", "o3", "view", SubjectID("direct")),
+    )
+    _assert_parity(
+        "delta", p,
+        _queries(rng, objs, users) + [T("d", "o0", "view", SubjectID("newbie"))],
+        sharded, single,
+    )
+    # interior→interior insert: overlay-ELL stage + label invalidation
+    p.write_relation_tuples(T("g", "mid0", "m", SubjectSet("g", "leaf3", "m")))
+    _assert_parity("delta-interior", p, _queries(rng, objs, users), sharded, single)
+
+    # tombstones (device-bucket patch routing to the owning shard)
+    p.delete_relation_tuples(T("g", "top", "m", SubjectID(users[0])))
+    p.delete_relation_tuples(T("d", "o0", "view", SubjectSet("g", "leaf0", "m")))
+    _assert_parity(
+        "tombstone", p,
+        _queries(rng, objs, users) + [T("d", "o0", "view", SubjectID(users[5]))],
+        sharded, single,
+    )
+
+    # burst past the overlay budget → compaction folds; parity holds
+    for i in range(20):
+        p.write_relation_tuples(T("g", f"leaf{i % 4}", "m", SubjectID(f"bulk{i}")))
+    sharded.snapshot()
+    single.snapshot()
+    _assert_parity(
+        "compacted", p,
+        _queries(rng, objs, users) + [T("d", "o1", "view", SubjectID("bulk3"))],
+        sharded, single,
+    )
+    c = sharded.maintenance.raw()[0]
+    assert c.get("compactions", 0) >= 1
+    assert c.get("delta_applies", 0) >= 2
+
+
+@needs_mesh
+def test_sharded_stream_and_warm_compile(make_persister):
+    rng = random.Random(5)
+    p, objs, users = _nested_store(make_persister, rng)
+    mesh = make_mesh(graph=4, data=2)
+    sharded = TpuCheckEngine(p, p.namespaces, mesh=mesh, sharded=True)
+    qs = _queries(rng, objs, users, n=90)
+    got = [bool(b) for arr in sharded.batch_check_stream(iter(qs), slice_cap=32) for b in arr]
+    oracle = CheckEngine(p)
+    assert got == [oracle.subject_is_allowed(q) for q in qs]
+    assert sharded.warm_compile() > 0
+
+
+@needs_mesh
+def test_per_shard_hbm_ledger_and_injected_oom(make_persister):
+    """The per-shard ledger sums to sensible figures, and an injected
+    single-shard OOM during a sharded dispatch walks ONE mesh-wide rung
+    (labels drop on every shard at once) and the batch still answers
+    correctly — never a wrong answer, never a crash."""
+    from keto_tpu.x import faults
+
+    rng = random.Random(9)
+    p, objs, users = _nested_store(make_persister, rng)
+    mesh = make_mesh(graph=4, data=2)
+    eng = TpuCheckEngine(p, p.namespaces, mesh=mesh, sharded=True)
+    eng.snapshot()
+    shards = eng.hbm.shard_resident_bytes()
+    assert len(shards) == 4 and sum(shards) > 0
+    snap = eng.hbm.snapshot()
+    assert snap["shard_count"] == 4 and len(snap["shards"]) == 4
+
+    qs = _queries(rng, objs, users, n=40)
+    oracle = CheckEngine(p)
+    want = [oracle.subject_is_allowed(q) for q in qs]
+    faults.inject("device-alloc", exc=faults.OomInjected, count=1)
+    try:
+        got = eng.batch_check(qs)
+    finally:
+        faults.clear("device-alloc")
+    assert got == want
+    assert eng.hbm.oom_events >= 1
+    assert eng.hbm.rung_depth >= 1  # a mesh-wide rung descended
+    # pressure clears: the supervised refresh restores the ladder
+    eng.hbm.maybe_restore()
+    assert eng.batch_check(qs) == want
+
+
+@needs_mesh
+def test_sharded_snapcache_segments_cold_start(make_persister):
+    """FORMAT_VERSION 6: a sharded engine saves per-shard bucket
+    segments (one group per shard, verified+loaded in parallel), a fresh
+    sharded engine cold-starts from them, and a SINGLE-device engine
+    reads the same cache (reassembly is byte-exact)."""
+    import json
+
+    from keto_tpu.graph import snapcache
+
+    rng = random.Random(3)
+    p, objs, users = _nested_store(make_persister, rng)
+    cache = tempfile.mkdtemp(prefix="keto-shard-cache")
+    mesh = make_mesh(graph=4, data=2)
+    eng = TpuCheckEngine(
+        p, p.namespaces, mesh=mesh, sharded=True, snapshot_cache_dir=cache
+    )
+    snap = eng.snapshot()
+    path = eng.save_snapshot_cache()
+    assert path is not None
+    names = os.listdir(path)
+    stripes = [n for n in names if n.startswith("bucket_") and "_s" in n]
+    assert len(stripes) == 4 * len(snap.buckets)
+    meta = json.loads(open(os.path.join(path, "meta.json")).read())
+    assert meta["shards"] == 4
+    shard_groups = [g for g in meta["groups"] if g.startswith("shard")]
+    assert sorted(shard_groups) == ["shard0", "shard1", "shard2", "shard3"]
+
+    # byte-exact reassembly
+    re_snap = snapcache.load_snapshot(path)
+    for a, b in zip(re_snap.buckets, snap.buckets):
+        assert np.array_equal(np.asarray(a.nbrs), np.asarray(b.nbrs))
+
+    qs = _queries(rng, objs, users, n=60)
+    oracle = CheckEngine(p)
+    want = [oracle.subject_is_allowed(q) for q in qs]
+    cold = TpuCheckEngine(
+        p, p.namespaces, mesh=mesh, sharded=True, snapshot_cache_dir=cache
+    )
+    assert cold.batch_check(qs) == want
+    assert cold.maintenance.raw()[0].get("cache_loads") == 1
+    cold_single = TpuCheckEngine(p, p.namespaces, snapshot_cache_dir=cache)
+    assert cold_single.batch_check(qs) == want
+    assert cold_single.maintenance.raw()[0].get("cache_loads") == 1
+
+
+@needs_mesh
+def test_registry_wires_mesh_config():
+    """serve.mesh_graph/mesh_data/mesh_sharded construct a sharded engine
+    through the registry — the daemon's path, not just the test harness's."""
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "docs"}],
+            "dsn": "memory",
+            "serve.mesh_graph": 2,
+            "serve.mesh_data": 4,
+        }
+    )
+    reg = Registry(cfg)
+    eng = reg.permission_engine()
+    assert eng.shard_count == 2
+    assert dict(eng._mesh.shape) == {"graph": 2, "data": 4}
+    # mesh_sharded=false keeps the legacy GSPMD path
+    cfg2 = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "docs"}],
+            "dsn": "memory",
+            "serve.mesh_graph": 2,
+            "serve.mesh_sharded": False,
+        }
+    )
+    eng2 = Registry(cfg2).permission_engine()
+    assert eng2.shard_count == 0 and eng2._mesh is not None
+
+
+def test_shard_row_ranges_assignment():
+    assert shard_row_ranges(10, 4) == [(0, 3), (3, 6), (6, 9), (9, 10)]
+    assert shard_row_ranges(8, 2) == [(0, 4), (4, 8)]
+    assert shard_row_ranges(1, 4) == [(0, 1), (1, 1), (1, 1), (1, 1)]
+    assert shard_row_ranges(0, 2) == [(0, 0), (0, 0)]
+
+
+@needs_mesh
+def test_shard_spec_partition_covers_every_bucket_row(make_persister):
+    """Every valid bucket row lands in exactly one shard's slice, local
+    scatter rows stay inside the slab, and entry routing conserves valid
+    entries."""
+    rng = random.Random(1)
+    p, objs, users = _nested_store(make_persister, rng)
+    eng = TpuCheckEngine(p, p.namespaces)
+    snap = eng.snapshot()
+    for g in (2, 4, 8):
+        spec = make_shard_spec(snap, g)
+        rps = spec.rows_per_shard
+        assert rps * g >= snap.num_int + 1
+        for bi, b in enumerate(snap.buckets):
+            seen = []
+            for s in range(g):
+                dst = spec.dst_sh[bi][s]
+                valid = dst < rps
+                seen.extend((dst[valid] + s * rps).tolist())
+            assert sorted(seen) == list(range(b.offset, b.offset + b.n))
+        # entry routing round-trip: every non-sentinel entry routed once
+        ni = snap.num_int
+        e1r = np.asarray([0, ni - 1, ni + 1, 1], np.int32)
+        e1q = np.asarray([0, 1, 0, 2], np.int32)
+        B = 32
+        packed = (
+            e1r, e1q,
+            np.full(4, ni + 1, np.int32), np.zeros(4, np.int32),
+            np.full(4, ni, np.int32), np.zeros(4, np.int32),
+            np.full(B, ni, np.int32),
+        )
+        entries, sizes = route_entries(spec, packed, B)
+        S1 = sizes[0]
+        routed = 0
+        for s in range(g):
+            rows = entries[s, :S1]
+            qs_ = entries[s, S1 : 2 * S1]
+            valid = rows < rps
+            routed += int(np.count_nonzero(valid))
+            for r, q in zip(rows[valid] + s * rps, qs_[valid]):
+                assert (r, q) in {(0, 0), (ni - 1, 1), (1, 2)}
+        assert routed == 3
