@@ -1694,6 +1694,307 @@ def run_reverse_query(rng):
     return out
 
 
+def run_replica(rng):
+    """Read-replica tier rounds: aggregate REST check throughput at
+    primary-only and 1/2/3 Watch-fed replicas (the primary in-process,
+    each replica a REAL subprocess daemon so the scaling measured is
+    process-level, not GIL-shared), replication delta p50/p99 (write
+    acknowledgement → the committed snaptoken becoming VISIBLE on a
+    replica through the 412 gate), and the Watch-invalidated check
+    cache's hit rate under an 80/2 hot-key skew with a background write
+    trickle."""
+    import itertools
+    import re as _re
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+    from keto_tpu.httpclient import KetoClient
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    n_users = int(os.environ.get("BENCH_REPLICA_USERS", 2000))
+    n_groups = int(os.environ.get("BENCH_REPLICA_GROUPS", 64))
+    n_docs = int(os.environ.get("BENCH_REPLICA_DOCS", 5000))
+    n_checks = int(os.environ.get("BENCH_REPLICA_CHECKS", 4000))
+    n_workers = int(os.environ.get("BENCH_REPLICA_WORKERS", 16))
+    n_deltas = int(os.environ.get("BENCH_REPLICA_DELTA_WRITES", 40))
+    max_replicas = int(os.environ.get("BENCH_REPLICA_MAX", 3))
+    ns_json = [{"id": 0, "name": "docs"}, {"id": 1, "name": "groups"}]
+
+    primary_cfg = Config(
+        overrides={
+            "namespaces": ns_json,
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "serve.watch_poll_ms": 10,
+            "log.level": "error",
+        }
+    )
+    primary = Daemon(Registry(primary_cfg))
+    primary.serve_all(block=False)
+    procs = []
+    out = {}
+    tmp_root = tempfile.mkdtemp(prefix="keto-bench-replica-")
+    try:
+        store = primary.registry.relation_tuple_manager()
+        rows = [
+            RelationTuple(
+                namespace="groups", object=f"g{u % n_groups}", relation="member",
+                subject=SubjectID(f"user-{u}"),
+            )
+            for u in range(n_users)
+        ]
+        rows += [
+            RelationTuple(
+                namespace="docs", object=f"d{d}", relation="view",
+                subject=SubjectSet("groups", f"g{d % n_groups}", "member"),
+            )
+            for d in range(n_docs)
+        ]
+        store.write_relation_tuples(*rows)
+        primary_base = f"http://127.0.0.1:{primary.read_port}"
+        wclient = KetoClient(primary_base, f"http://127.0.0.1:{primary.write_port}")
+
+        def boot_replica(i):
+            """One replica daemon in its OWN process (tests/chaos_runner
+            with --role replica): returns its read-API base URL."""
+            port_file = os.path.join(tmp_root, f"ports-{i}.json")
+            env = dict(os.environ)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            logf = open(os.path.join(tmp_root, f"replica-{i}.log"), "wb")
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    os.path.join(os.path.dirname(__file__), "tests", "chaos_runner.py"),
+                    "--dsn", "memory",  # ignored: replicas hold no store
+                    "--cache-dir", os.path.join(tmp_root, f"rcache-{i}"),
+                    "--port-file", port_file,
+                    "--role", "replica",
+                    "--primary-url", primary_base,
+                    "--replica-dir", os.path.join(tmp_root, f"r{i}"),
+                    "--staleness-wait-ms", "2000",
+                ],
+                env=env,
+                stdout=logf,
+                stderr=logf,
+            )
+            procs.append(proc)
+            deadline = time.monotonic() + 180
+            ports = None
+            while time.monotonic() < deadline and ports is None:
+                if os.path.exists(port_file):
+                    try:
+                        ports = json.loads(open(port_file).read())
+                    except json.JSONDecodeError:
+                        pass
+                if proc.poll() is not None:
+                    raise RuntimeError(f"replica {i} died at boot")
+                time.sleep(0.05)
+            if ports is None:
+                raise RuntimeError(f"replica {i} never published ports")
+            # wait until bootstrapped + caught up with the primary
+            wm = store.watermark()
+            while time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{ports['read']}/health/ready",
+                        timeout=5,
+                    ) as resp:
+                        body = json.loads(resp.read())
+                    if body.get("role") == "replica" and int(
+                        body.get("watermark", -1)
+                    ) >= wm:
+                        return f"http://127.0.0.1:{ports['read']}"
+                except Exception:  # keto-analyze: ignore[KTA401] readiness poll: a booting replica refuses connections until it doesn't; the deadline raises below
+                    pass
+                time.sleep(0.05)
+            raise RuntimeError(f"replica {i} never caught up")
+
+        # the 80/2 hot-key skew: 80% of reads hit 2% of (doc, user) pairs
+        hot = [
+            (rng.randrange(n_docs), rng.randrange(n_users))
+            for _ in range(max(1, (n_docs * 2) // 100))
+        ]
+
+        def query_url(base):
+            if rng.random() < 0.8:
+                d, u = hot[rng.randrange(len(hot))]
+            else:
+                d, u = rng.randrange(n_docs), rng.randrange(n_users)
+            return (
+                f"{base}/check?namespace=docs&object=d{d}&relation=view"
+                f"&subject_id=user-{u}"
+            )
+
+        def throughput(bases):
+            urls = [query_url(bases[i % len(bases)]) for i in range(n_checks)]
+            done = [0] * n_workers
+            cursor = itertools.count()
+
+            def worker(wi):
+                while True:
+                    i = next(cursor)
+                    if i >= len(urls):
+                        return
+                    try:
+                        urllib.request.urlopen(urls[i], timeout=30).read()
+                    except urllib.error.HTTPError:
+                        pass  # 403 = denied, still an answered check
+                    done[wi] += 1
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=worker, args=(wi,)) for wi in range(n_workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            return round(sum(done) / wall, 1)
+
+
+        def warm(base, n=40):
+            # a fresh daemon pays its kernel compiles on the first checks
+            # of each slice geometry; measuring those as throughput would
+            # charge XLA compile time to the serving tier
+            for _ in range(n):
+                try:
+                    urllib.request.urlopen(query_url(base), timeout=60).read()
+                except urllib.error.HTTPError:
+                    pass
+
+        warm(primary_base)
+        scaling = {"primary_only": throughput([primary_base])}
+        replica_bases = []
+        for i in range(max_replicas):
+            replica_bases.append(boot_replica(i))
+            warm(replica_bases[-1])
+            # replicas only: the aggregate read tier the primary fronts
+            scaling[f"replicas_{i + 1}"] = throughput(list(replica_bases))
+
+        # replication delta: ack → replica-visible through the 412 gate
+        deltas = []
+        probe_base = replica_bases[0]
+        for i in range(n_deltas):
+            r = wclient.patch_relation_tuples(
+                insert=[
+                    RelationTuple(
+                        namespace="docs", object=f"rb{i}", relation="view",
+                        subject=SubjectID(f"rbu-{i}"),
+                    )
+                ]
+            )
+            t_ack = time.perf_counter()
+            url = (
+                f"{probe_base}/check?namespace=docs&object=rb{i}&relation=view"
+                f"&subject_id=rbu-{i}&snaptoken={r.snaptoken}"
+            )
+            while True:
+                try:
+                    urllib.request.urlopen(url, timeout=30).read()
+                    break
+                except urllib.error.HTTPError as e:
+                    if e.code == 403:
+                        break  # answered (denied) — visible either way
+                    if e.code != 412:
+                        raise
+            deltas.append(time.perf_counter() - t_ack)
+
+        # check-cache hit rate under the skew with a write trickle
+        # (counters scraped from the subprocess replica's /metrics)
+        cc_re = _re.compile(
+            r"^keto_checkcache_(hits|misses|invalidations)_total\s+([0-9.e+]+)",
+            _re.M,
+        )
+
+        def cc_counters(base):
+            with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+                text = resp.read().decode()
+            return {k: float(v) for k, v in cc_re.findall(text)}
+
+        before = cc_counters(replica_bases[0])
+        stop_writes = threading.Event()
+
+        def trickle():
+            i = 0
+            while not stop_writes.is_set():
+                wclient.patch_relation_tuples(
+                    insert=[
+                        RelationTuple(
+                            namespace="docs", object=f"tr{i}", relation="view",
+                            subject=SubjectID(f"tru-{i}"),
+                        )
+                    ]
+                )
+                i += 1
+                time.sleep(0.05)
+
+        tw = threading.Thread(target=trickle, daemon=True)
+        tw.start()
+        cache_qps = throughput([replica_bases[0]])
+        stop_writes.set()
+        tw.join(timeout=10)
+        after = cc_counters(replica_bases[0])
+        hits = int(after.get("hits", 0) - before.get("hits", 0))
+        misses = int(after.get("misses", 0) - before.get("misses", 0))
+        out = {
+            "graph": {"users": n_users, "groups": n_groups, "docs": n_docs},
+            "checks_per_round": n_checks,
+            # every daemon here is a real OS process: aggregate scaling
+            # is honest ONLY when the host has cores to give them —
+            # record the budget so a 1-core smoke box's flat numbers are
+            # read as host saturation, not a replication bottleneck
+            "host_cpus": os.cpu_count(),
+            "aggregate_checks_per_s": scaling,
+            "replication_delta": {**_pctls(deltas), "writes": n_deltas},
+            "checkcache": {
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": round(hits / max(1, hits + misses), 3),
+                "invalidations": int(
+                    after.get("invalidations", 0) - before.get("invalidations", 0)
+                ),
+                "skewed_checks_per_s": cache_qps,
+            },
+        }
+        log(
+            f"[replica] aggregate checks/s: "
+            + ", ".join(f"{k}={v:,}" for k, v in scaling.items())
+            + f"; replication delta p50={out['replication_delta']['p50_ms']}ms "
+            f"p99={out['replication_delta']['p99_ms']}ms; "
+            f"cache hit rate {out['checkcache']['hit_rate']:.0%} under 80/2 skew"
+        )
+    finally:
+        import signal as _signal
+
+        for proc in procs:
+            try:
+                if proc.poll() is None:
+                    proc.send_signal(_signal.SIGTERM)
+            except Exception:  # keto-analyze: ignore[KTA401] teardown best-effort: signaling an already-exited subprocess is a benign race
+                pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=20)
+            except Exception:
+                proc.kill()
+        try:
+            primary.shutdown()
+        except Exception:  # keto-analyze: ignore[KTA401] teardown best-effort: the measured section already returned; a shutdown race must not fail the bench
+            pass
+        import shutil
+
+        shutil.rmtree(tmp_root, ignore_errors=True)
+    return out
+
+
 def ensure_native():
     """Build the C++ host path if the shared objects are missing — the
     interner/layout and query resolution otherwise silently fall back to
@@ -1985,6 +2286,17 @@ def main():
             log(f"[sharded] FAILED: {e!r}")
             sharded = {"error": repr(e)}
 
+    # read-replica tier: aggregate checks/s at 1/2/3 Watch-fed replicas,
+    # replication delta p50/p99, check-cache hit rate under hot-key skew
+    # (failures degrade to an error field)
+    replica = None
+    if os.environ.get("BENCH_REPLICA", "1") != "0":
+        try:
+            replica = run_replica(random.Random(7042))
+        except Exception as e:  # pragma: no cover - diagnostic path
+            log(f"[replica] FAILED: {e!r}")
+            replica = {"error": repr(e)}
+
     # BASELINE configs 2/4/5 — failures must not lose the headline JSON line
     config2 = None
     if os.environ.get("BENCH_CONFIG2", "1") != "0":
@@ -2047,6 +2359,7 @@ def main():
                     "depth_sweep": depth_sweep,
                     "reverse_query": reverse_query,
                     "sharded": sharded,
+                    "replica": replica,
                     "config2_flat_acl": config2,
                     "config4_10m_depth8": config4,
                     "config5_50m_stream": config5,

@@ -185,6 +185,42 @@ CONFIG_SCHEMA = {
                     "default": True,
                     "description": "Mesh execution strategy: true (default) runs the explicit shard_map program — row-range shards with a per-hop halo exchange of the frontier bitmap slabs, per-shard HBM ledger, per-shard snapshot-cache segments, and the keto_shard_* metric families; false falls back to the legacy GSPMD path (XLA's partitioner infers the cross-shard traffic, no per-shard observability).",
                 },
+                "role": {
+                    "type": "string",
+                    "enum": ["primary", "replica"],
+                    "default": "primary",
+                    "description": "Serving role. 'primary' owns the SQL store and the write path. 'replica' holds NO SQL access: it bootstraps its tuple state from the primary's GET /snapshot/export (riding the primary's snapshot-cache segments when their watermarks line up), tails the primary's /watch changefeed applying each commit group at the primary's own snaptoken through the delta-overlay path, keeps a durable applied-watermark (serve.replica_dir) for exactly-once resume after SIGKILL, and serves check/expand/list at any snaptoken <= its watermark. Writes to a replica answer 403; reads pinned above the watermark block up to serve.staleness_wait_ms then answer 412 + Retry-After with the current watermark.",
+                },
+                "primary_url": {
+                    "type": "string",
+                    "default": "",
+                    "description": "Replica mode: base URL of the primary's READ API (http://host:4466) — the source of /snapshot/export bootstraps and the /watch feed. Required when serve.role=replica.",
+                },
+                "replica_dir": {
+                    "type": "string",
+                    "default": "",
+                    "description": "Replica mode: directory for the durable applied-watermark file. With it set, a SIGKILL'd replica resumes its Watch feed from the last applied snaptoken with exactly-once re-application (the store's watermark guard skips re-delivered groups); empty keeps the watermark in memory only (a restart re-bootstraps from scratch — still correct, just slower).",
+                },
+                "staleness_wait_ms": {
+                    "type": "number",
+                    "default": 200.0,
+                    "description": "Replica mode: how long a read pinned to a snaptoken ABOVE the replica's applied watermark blocks on the feed before answering 412 Precondition Failed (+ Retry-After and the current watermark). The feed normally closes small gaps within one watch poll period, so this bounds the tail, not the common case.",
+                },
+                "replica_staleness_budget_s": {
+                    "type": "number",
+                    "default": 30.0,
+                    "description": "Replica mode: how long the replica may go without confirming it is caught up with the primary (feed lagging, or the primary unreachable — indistinguishable and handled the same) before health reports DEGRADED(replication_lag). The replica keeps serving at its watermark throughout; the budget bounds the staleness consumers will tolerate.",
+                },
+                "checkcache_entries": {
+                    "type": "integer",
+                    "default": 65536,
+                    "description": "Replica mode: capacity of the Watch-invalidated check cache (positive AND negative decisions, keyed by tuple + snaptoken window, LRU). Any applied delta closes every open window — globally, because reachability is transitive across namespaces — so the cache can never serve a hit an applied delta invalidated; snaptoken-pinned reads below a closed window still hit. 0 disables.",
+                },
+                "watch_log_retention_s": {
+                    "type": "number",
+                    "default": 3600.0,
+                    "description": "How long (seconds, wall clock) the durable change logs feeding /watch and the delta-overlay path retain entries before GC (memory and SQL stores; on SQL the tuple rows themselves also serve insert replay and are never GC'd — this bounds the delete log). A watch resume (or replica feed) older than the retained horizon answers 410/ErrWatchExpired; replicas recover by automatic full re-bootstrap. 0 disables time-based GC (the count-based caps still apply).",
+                },
                 "drain_timeout_s": {
                     "type": "number",
                     "default": 5.0,

@@ -91,7 +91,16 @@ class Daemon:
         # very first /health/ready or grpc.health.v1 Watch reads a live
         # state instead of constructing the monitor mid-request
         self.registry.health_monitor()
-        self._warm_snapshot()
+        rep = self.registry.replica_controller()
+        if rep is not None:
+            # replica mode: the controller's supervised feed bootstraps
+            # the store from the primary and builds the first snapshot
+            # itself (the boot warm below would only build an EMPTY
+            # pre-bootstrap snapshot); reads are gated 503 until the
+            # first bootstrap completes
+            rep.start()
+        else:
+            self._warm_snapshot()
         read_host, read_port = cfg.read_api_address()
         write_host, write_port = cfg.write_api_address()
         self._roles[READ] = self._start_role(READ, read_host, read_port)
@@ -163,6 +172,20 @@ class Daemon:
                 exc_info=True,
             )
         deadline = time.monotonic() + drain_s
+        # replica feed first: stop applying new commit groups before the
+        # read plane drains, so in-flight reads resolve against a stable
+        # watermark (the durable applied-watermark already covers every
+        # applied group — a later restart resumes exactly-once)
+        rep = self.registry.peek("replica")
+        if rep is not None:
+            try:
+                rep.stop()
+            except Exception:
+                self._count_shutdown_failure("drain_replica_stop_failures")
+                self.registry.logger().warning(
+                    "replica feed stop failed during drain; continuing "
+                    "shutdown", exc_info=True,
+                )
         # watch streams are long-lived BY DESIGN: close the hub first so
         # every changefeed generator ends at its next poll tick and the
         # REST backends' drains below aren't held open by subscribers

@@ -77,8 +77,15 @@ class HealthMonitor:
         engine=None,
         *,
         staleness_budget_s: float = 60.0,
+        replica=None,
     ):
+        """``replica`` is a ReplicaController (keto_tpu/replica/) on a
+        read replica, else None: pre-bootstrap reads as STARTING, and
+        feed lag (or primary loss — indistinguishable) past the
+        controller's staleness budget reads as DEGRADED(replication_lag).
+        The replica keeps serving at its watermark throughout."""
         self._engine = engine
+        self._replica = replica
         self._budget = float(staleness_budget_s)
         self._lock = threading.Lock()  # guards: _last_state, _last_reason, _override, _transitions
         self._last_state: Optional[HealthState] = None
@@ -178,6 +185,27 @@ class HealthMonitor:
                 "device path failing; serving bit-identical decisions "
                 "from the CPU fallback engine",
             )
+        rep = self._replica
+        if rep is not None:
+            if not rep.bootstrapped:
+                return (
+                    HealthState.STARTING,
+                    "replica bootstrapping from the primary "
+                    f"({rep.primary_url})",
+                )
+            lag = rep.lag_s()
+            if lag > rep.staleness_budget_s:
+                detail = (
+                    "primary unreachable"
+                    if not rep.primary_connected
+                    else "watch feed behind"
+                )
+                return (
+                    HealthState.DEGRADED,
+                    f"replication_lag: {detail} — last confirmed caught up "
+                    f"{lag:.1f}s ago (budget {rep.staleness_budget_s:.1f}s); "
+                    f"serving at applied watermark {rep.watermark}",
+                )
         if h.get("memory_pressure"):
             # the HBM governor refused the last refresh with every
             # eviction rung spent: answers stay correct but bounded-stale

@@ -73,8 +73,32 @@ class Registry:
 
     # -- storage -------------------------------------------------------------
 
+    def is_replica(self) -> bool:
+        """True when this process serves as a read replica
+        (``serve.role: replica``): no SQL access, state fed by the
+        primary's Watch changefeed (keto_tpu/replica/)."""
+        return str(self._config.get("serve.role", "primary")) == "replica"
+
     def relation_tuple_manager(self):
         def build():
+            if self.is_replica():
+                # replicas hold NO SQL access: the store is a local
+                # materialization of the primary's commit log, installed
+                # by the replica controller (dsn is ignored by design)
+                from keto_tpu.replica.store import ReplicaStore
+
+                store = ReplicaStore(
+                    self.namespaces_source(), network_id=self._network_id
+                )
+                store.idempotency_ttl_s = float(
+                    self._config.get("serve.idempotency_ttl_s", 86400.0)
+                )
+                # a replica's own logs feed chained watchers and its
+                # engine's delta path: same retention hygiene as primary
+                store.watch_log_retention_s = float(
+                    self._config.get("serve.watch_log_retention_s", 3600.0)
+                )
+                return store
             dsn = self._config.dsn
             if dsn == "memory":
                 store = MemoryPersister(
@@ -98,9 +122,53 @@ class Registry:
             store.idempotency_ttl_s = float(
                 self._config.get("serve.idempotency_ttl_s", 86400.0)
             )
+            # time-based GC of the durable change logs feeding /watch and
+            # the delta path (serve.watch_log_retention_s; 0 disables)
+            store.watch_log_retention_s = float(
+                self._config.get("serve.watch_log_retention_s", 3600.0)
+            )
             return store
 
         return self._memo("manager", build)
+
+    def replica_controller(self):
+        """The replica lifecycle owner (keto_tpu/replica/controller.py):
+        bootstrap from the primary's /snapshot/export, the supervised
+        Watch feed with its durable applied-watermark, the 412 read gate,
+        and the Watch-invalidated check cache. ``None`` on a primary —
+        serving layers branch on that."""
+        if not self.is_replica():
+            return None
+
+        def build():
+            from keto_tpu.replica.controller import ReplicaController
+
+            engine = self.permission_engine()
+            return ReplicaController(
+                self.relation_tuple_manager(),
+                self.permission_engine,
+                str(self._config.get("serve.primary_url", "")),
+                replica_dir=str(self._config.get("serve.replica_dir", "") or ""),
+                snapshot_cache_dir=str(
+                    self._config.get("serve.snapshot_cache_dir", "") or ""
+                ),
+                staleness_wait_ms=float(
+                    self._config.get("serve.staleness_wait_ms", 200.0)
+                ),
+                staleness_budget_s=float(
+                    self._config.get("serve.replica_staleness_budget_s", 30.0)
+                ),
+                checkcache_entries=int(
+                    self._config.get("serve.checkcache_entries", 65536)
+                ),
+                probe_s=max(
+                    0.25,
+                    float(self._config.get("serve.watch_poll_ms", 100.0)) / 1e3,
+                ),
+                stats=getattr(engine, "maintenance", None),
+            )
+
+        return self._memo("replica", build)
 
     # -- engines -------------------------------------------------------------
 
@@ -344,6 +412,10 @@ class Registry:
                 staleness_budget_s=float(
                     self._config.get("serve.staleness_budget_s", 60.0)
                 ),
+                # replica mode: feed lag / primary loss past the budget
+                # reports DEGRADED(replication_lag); pre-bootstrap reads
+                # as STARTING (keto_tpu/replica/controller.py)
+                replica=self.replica_controller(),
             ),
         )
 
@@ -854,6 +926,74 @@ class Registry:
             watch_stat("expired_total"),
         )
 
+        # replica tier (keto_tpu/replica/): replication lag, feed apply
+        # and bootstrap counters, and the Watch-invalidated check cache —
+        # read from the controller's snapshot at scrape time; a primary
+        # (peek returns None) exposes the families at zero so one scrape
+        # config and one dashboard cover both roles
+        def replica_snapshot():
+            rep = self.peek("replica")
+            return rep.snapshot() if rep is not None else {}
+
+        def replica_stat(key):
+            def read():
+                yield (), float(replica_snapshot().get(key, 0) or 0)
+
+            return read
+
+        m.register_callback(
+            "keto_replica_lag_seconds", "gauge",
+            "Replica mode: seconds since this replica last confirmed it "
+            "was caught up with the primary (feed lagging or primary "
+            "unreachable — handled the same); past "
+            "serve.replica_staleness_budget_s health reports "
+            "DEGRADED(replication_lag). 0 on a primary.",
+            replica_stat("lag_s"),
+        )
+        m.register_callback(
+            "keto_replica_applied_commits_total", "counter",
+            "Watch commit groups this replica applied at their primary "
+            "snaptoken through the delta-overlay path (exactly-once: "
+            "re-delivered groups are skipped by the watermark guard).",
+            replica_stat("applied_commits"),
+        )
+        m.register_callback(
+            "keto_replica_bootstraps_total", "counter",
+            "Full-state installs from the primary's /snapshot/export: "
+            "the cold start plus every watch-horizon-loss recovery "
+            "(410-triggered automatic re-bootstrap, never silent "
+            "divergence).",
+            replica_stat("bootstraps"),
+        )
+
+        def checkcache_stat(key):
+            def read():
+                cc = replica_snapshot().get("checkcache") or {}
+                yield (), float(cc.get(key, 0) or 0)
+
+            return read
+
+        m.register_callback(
+            "keto_checkcache_hits_total", "counter",
+            "Replica check-cache hits: decisions served from a (tuple, "
+            "snaptoken-window) entry still valid for the requested "
+            "freshness.",
+            checkcache_stat("hits"),
+        )
+        m.register_callback(
+            "keto_checkcache_misses_total", "counter",
+            "Replica check-cache misses (no entry, window closed by an "
+            "applied delta, or requested snaptoken above the window).",
+            checkcache_stat("misses"),
+        )
+        m.register_callback(
+            "keto_checkcache_invalidations_total", "counter",
+            "Check-cache entries whose windows were closed by applied "
+            "Watch deltas (global invalidation: reachability is "
+            "transitive, so any delta may flip any decision).",
+            checkcache_stat("invalidations"),
+        )
+
         def health_states():
             from keto_tpu.driver.health import HealthState
 
@@ -944,6 +1084,9 @@ class Registry:
         return VERSION
 
     def close(self) -> None:
+        rep = self._singletons.get("replica")
+        if rep is not None:
+            rep.stop()
         hub = self._singletons.get("watch_hub")
         if hub is not None:
             hub.close()

@@ -475,6 +475,53 @@ def save_snapshot(
     return str(final)
 
 
+def export_manifest(cache_dir: str, max_watermark: Optional[int] = None) -> Optional[dict]:
+    """Segment listing of the newest CURRENT-format cache under
+    ``cache_dir`` with watermark ≤ ``max_watermark`` — the
+    ``GET /snapshot/export`` manifest a replica mirrors segments from
+    (keto_tpu/replica/controller.py). Returns ``{"tag", "watermark",
+    "format_version", "segments": [{"name", "size", "crc32"}, …]}`` with
+    ``meta.json`` itself included (crc32 null — its integrity is the
+    loader's JSON parse + the per-segment checksums it declares), or
+    None when no loadable-by-this-binary cache exists."""
+    base = Path(cache_dir)
+    if not cache_dir or not base.is_dir():
+        return None
+    candidates = []
+    for d in base.iterdir():
+        wm = _parse_tag(d.name) if d.is_dir() else None
+        if wm is None:
+            continue
+        if max_watermark is not None and wm > max_watermark:
+            continue
+        candidates.append((wm, d))
+    for wm, d in sorted(candidates, reverse=True):
+        try:
+            meta = json.loads((d / "meta.json").read_text())
+        except Exception:
+            continue  # torn/in-flight save: try the next-newest
+        if meta.get("format") != FORMAT_VERSION:
+            continue
+        segments = [
+            {"name": name, "size": int(info["size"]), "crc32": int(info["crc32"])}
+            for name, info in sorted(meta.get("segments", {}).items())
+        ]
+        segments.append(
+            {
+                "name": "meta.json",
+                "size": (d / "meta.json").stat().st_size,
+                "crc32": None,
+            }
+        )
+        return {
+            "tag": d.name,
+            "watermark": int(wm),
+            "format_version": FORMAT_VERSION,
+            "segments": segments,
+        }
+    return None
+
+
 def _prune(base: Path, keep: int) -> None:
     """Drop all but the ``keep`` newest caches PER FORMAT VERSION.
 

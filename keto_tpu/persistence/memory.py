@@ -191,6 +191,10 @@ class _SharedState:
         # key7) per delete key, per network, bounded like the insert log
         self.delete_log: dict[str, list[tuple[int, tuple]]] = {}
         self.del_floor: dict[str, int] = {}
+        # (watermark, wall time) per commit, per network — the time axis
+        # for watch-log retention GC (only tracked while a retention
+        # window is configured; trimmed by the same GC)
+        self.commit_times: dict[str, list[tuple[int, float]]] = {}
         # sorted column-array bundle from a bulk load into an empty store,
         # keyed by the watermark it is valid at — the snapshot builder's
         # zero-copy interning input (keto_tpu/graph/native.py
@@ -219,6 +223,9 @@ class MemoryPersister(Manager):
         self._shared = _shared or _SharedState()
         #: how long idempotency keys dedup retries before GC forgets them
         self.idempotency_ttl_s = 86400.0
+        #: time-based watch-log retention (serve.watch_log_retention_s);
+        #: 0 disables — only the count-based LOG_CAP bounds apply
+        self.watch_log_retention_s = 0.0
         #: keyed write retries answered from the dedup map instead of
         #: re-applying (the /metrics replay counter, matching sql_base)
         self.idempotent_replays = 0
@@ -651,12 +658,69 @@ class MemoryPersister(Manager):
                 expired = [k for k, (_, t) in dedup.items() if t <= now - ttl]
                 for k in expired:
                     del dedup[k]
+            if self.watch_log_retention_s > 0:
+                # time axis + opportunistic horizon GC (cheap list work;
+                # the SQL stores interval-guard the same piggyback)
+                self._shared.commit_times.setdefault(nid, []).append(
+                    (wm, time.time())
+                )
+                self._gc_watch_logs_locked(nid, time.time())
             faults.check("transact-ack")
             return TransactResult(snaptoken=wm)
 
     def watermark(self) -> int:
         with self._shared.lock:
             return self._shared.watermark
+
+    # -- watch-log horizon hygiene -------------------------------------------
+
+    def _gc_watch_logs_locked(self, nid: str, now: float) -> int:
+        """Prune insert/delete-log entries whose commits fell out of the
+        retention window and raise both floors beneath them — a watch
+        (or delta) resume from below the risen floor answers
+        expired/rebuild instead of silently missing history. Caller
+        holds the shared lock. Returns entries pruned."""
+        ret = self.watch_log_retention_s
+        if ret <= 0:
+            return 0
+        times = self._shared.commit_times.get(nid)
+        if not times:
+            return 0
+        cutoff = now - ret
+        i = 0
+        floor_wm = 0
+        while i < len(times) and times[i][1] <= cutoff:
+            floor_wm = times[i][0]
+            i += 1
+        if i == 0:
+            return 0
+        del times[:i]
+        pruned = 0
+        log = self._shared.insert_log.get(nid)
+        if log:
+            kept = [(w, r) for w, r in log if w > floor_wm]
+            pruned += len(log) - len(kept)
+            self._shared.insert_log[nid] = kept
+        dlog = self._shared.delete_log.get(nid)
+        if dlog:
+            kept_d = [(w, k) for w, k in dlog if w > floor_wm]
+            pruned += len(dlog) - len(kept_d)
+            self._shared.delete_log[nid] = kept_d
+        if floor_wm > self._shared.log_floor.get(nid, 0):
+            self._shared.log_floor[nid] = floor_wm
+        if floor_wm > self._shared.del_floor.get(nid, 0):
+            self._shared.del_floor[nid] = floor_wm
+        return pruned
+
+    def gc_watch_logs(self, now: Optional[float] = None) -> int:
+        """Time-based GC of the change logs feeding /watch and the delta
+        path (``serve.watch_log_retention_s``; 0 disables). Also runs
+        piggybacked on every transact; this public form is for tests and
+        operators. Returns the number of pruned log entries."""
+        with self._shared.lock:
+            return self._gc_watch_logs_locked(
+                self.network_id, time.time() if now is None else now
+            )
 
     # -- snapshot support ----------------------------------------------------
 

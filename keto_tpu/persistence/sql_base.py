@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import uuid
 from typing import Callable, Optional, Sequence
 
@@ -194,6 +195,46 @@ MIGRATIONS: list[tuple[str, str, str]] = [
         """,
         "DROP INDEX keto_idempotency_created_idx",
     ),
+    (
+        # wall-clock stamp on delete-log entries: time-based watch-log
+        # retention (serve.watch_log_retention_s) GCs entries older than
+        # the window and raises del_log_floor beneath them — a watch (or
+        # replica feed) resuming from below the risen floor answers
+        # 410/ErrWatchExpired and re-bootstraps instead of silently
+        # missing deletes. Pre-migration rows carry 0 and age out on the
+        # first GC pass (their retention already exceeded any window).
+        "20260804000002_delete_log_created_at",
+        "ALTER TABLE keto_tuple_delete_log "
+        "ADD COLUMN created_at BIGINT NOT NULL DEFAULT 0",
+        # the down path rebuilds the table: DROP COLUMN needs
+        # sqlite >= 3.35, and the tier-1 floor is stock 3.34
+        (
+            """
+            CREATE TABLE keto_tuple_delete_log_down (
+                nid TEXT NOT NULL,
+                namespace_id INTEGER NOT NULL,
+                object TEXT NOT NULL,
+                relation TEXT NOT NULL,
+                subject_id TEXT NULL,
+                subject_set_namespace_id INTEGER NULL,
+                subject_set_object TEXT NULL,
+                subject_set_relation TEXT NULL,
+                commit_time BIGINT NOT NULL
+            )
+            """,
+            "INSERT INTO keto_tuple_delete_log_down "
+            "SELECT nid, namespace_id, object, relation, subject_id, "
+            "subject_set_namespace_id, subject_set_object, "
+            "subject_set_relation, commit_time FROM keto_tuple_delete_log",
+            "DROP TABLE keto_tuple_delete_log",
+            "ALTER TABLE keto_tuple_delete_log_down "
+            "RENAME TO keto_tuple_delete_log",
+            """
+            CREATE INDEX keto_tuple_delete_log_idx
+            ON keto_tuple_delete_log (nid, commit_time)
+            """,
+        ),
+    ),
 ]
 
 #: delete-log retention window in watermark units; older entries prune and
@@ -310,6 +351,12 @@ class SQLPersisterBase(Manager):
         self._dsn = dsn
         #: how long idempotency keys dedup retries before GC forgets them
         self.idempotency_ttl_s = DEFAULT_IDEMPOTENCY_TTL_S
+        #: time-based watch-log retention (serve.watch_log_retention_s);
+        #: 0 disables — only the count-based _DELETE_LOG_KEEP cap applies
+        self.watch_log_retention_s = 0.0
+        # opportunistic GC runs at most this often, piggybacked on writes
+        self._watch_gc_interval_s = 60.0
+        self._last_watch_gc = 0.0
         #: budget for reconnect+retry after a mid-query connection loss
         self.reconnect_max_wait_s = 30.0
         #: times the live connection was re-dialed after a detected loss
@@ -481,7 +528,7 @@ class SQLPersisterBase(Manager):
             for version, up, _ in self._all_migrations():
                 if version in applied:
                     continue
-                self._exec(up)
+                self._exec_migration(up)
                 self._exec(
                     "INSERT INTO keto_migrations (version, applied_at) "
                     f"VALUES (?, {self._epoch_expr()})",
@@ -499,10 +546,21 @@ class SQLPersisterBase(Manager):
                     break
                 if version not in applied:
                     continue
-                self._exec(down)
+                self._exec_migration(down)
                 self._exec("DELETE FROM keto_migrations WHERE version = ?", (version,))
                 n += 1
             return n
+
+    def _exec_migration(self, sql) -> None:
+        """One migration step: a single SQL statement, or a tuple of
+        statements for steps no single portable statement can express
+        (e.g. dropping a column without sqlite >= 3.35's DROP COLUMN —
+        rebuild, copy, rename, re-index)."""
+        if isinstance(sql, (tuple, list)):
+            for s in sql:
+                self._exec(s)
+        else:
+            self._exec(sql)
 
     # -- helpers -------------------------------------------------------------
 
@@ -719,8 +777,9 @@ class SQLPersisterBase(Manager):
                 self._executemany(
                     "INSERT INTO keto_tuple_delete_log (nid, namespace_id, "
                     "object, relation, subject_id, subject_set_namespace_id, "
-                    "subject_set_object, subject_set_relation, commit_time) "
-                    "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    "subject_set_object, subject_set_relation, commit_time, "
+                    f"created_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, "
+                    f"{self._epoch_expr()})",
                     [
                         (self.network_id,) + values + (commit_time,)
                         for values in effective_dels
@@ -738,6 +797,15 @@ class SQLPersisterBase(Manager):
                         "WHERE nid = ?",
                         (floor, self.network_id),
                     )
+            # time-based watch-log retention piggybacks on writes (at
+            # most once per interval), inside the open transaction
+            if (
+                self.watch_log_retention_s > 0
+                and time.monotonic() - self._last_watch_gc
+                > self._watch_gc_interval_s
+            ):
+                self._gc_watch_logs_in_txn()
+                self._last_watch_gc = time.monotonic()
             if idempotency_key is not None:
                 token = commit_time
                 if not changed:
@@ -790,6 +858,62 @@ class SQLPersisterBase(Manager):
                     (self.network_id,),
                 ).fetchone()
                 return row[0] if row else 0
+
+        return self._with_reconnect(run, retry=True)
+
+    # -- watch-log horizon hygiene -------------------------------------------
+
+    def _gc_watch_logs_in_txn(self) -> int:
+        """Prune delete-log entries older than ``watch_log_retention_s``
+        (wall clock) and raise ``del_log_floor`` beneath them. Runs
+        inside an already-open transaction; returns rows pruned. The
+        tuple rows themselves double as the insert log and are data, not
+        log — they are never GC'd."""
+        ret = self.watch_log_retention_s
+        if ret <= 0:
+            return 0
+        row = self._exec(
+            "SELECT MAX(commit_time) FROM keto_tuple_delete_log "
+            f"WHERE nid = ? AND created_at <= {self._epoch_expr()} - ?",
+            (self.network_id, int(ret)),
+        ).fetchone()
+        if row is None or row[0] is None:
+            return 0
+        floor = int(row[0])
+        cur = self._exec(
+            "DELETE FROM keto_tuple_delete_log "
+            "WHERE nid = ? AND commit_time <= ?",
+            (self.network_id, floor),
+        )
+        pruned = max(0, cur.rowcount or 0)
+        got = self._exec(
+            "SELECT del_log_floor FROM keto_watermarks WHERE nid = ?",
+            (self.network_id,),
+        ).fetchone()
+        if got is not None and floor > int(got[0]):
+            self._exec(
+                "UPDATE keto_watermarks SET del_log_floor = ? WHERE nid = ?",
+                (floor, self.network_id),
+            )
+        return pruned
+
+    def gc_watch_logs(self) -> int:
+        """Time-based GC of the durable change log feeding /watch and
+        the tombstone delta path (``serve.watch_log_retention_s``; 0
+        disables). Also piggybacked on writes at a bounded interval —
+        this public form is for tests and operators. Returns the number
+        of pruned delete-log rows."""
+
+        def run():
+            with self._lock:
+                self._exec("BEGIN")
+                try:
+                    pruned = self._gc_watch_logs_in_txn()
+                    self._exec("COMMIT")
+                    return pruned
+                except Exception:
+                    self._safe_rollback()
+                    raise
 
         return self._with_reconnect(run, retry=True)
 

@@ -1,0 +1,20 @@
+"""Snaptoken-consistent read replicas riding the Watch changefeed.
+
+A replica process holds **no SQL access**: its tuple state is a local
+materialization of the primary's commit log, cold-started from
+``GET /snapshot/export`` (full tuple state at a consistent watermark,
+plus the primary's snapshot-cache segments when they line up) and kept
+current by applying each Watch commit group — at the primary's own
+snaptoken — through the engine's existing delta-overlay/compaction
+path. The applied watermark is durable, so a SIGKILL'd replica resumes
+with exactly-once application; reads pinned above the watermark block
+briefly and then answer 412 with the current watermark (the
+bounded-staleness contract); feed lag and horizon loss feed the health
+state machine. See docs/concepts/replication.md.
+"""
+
+from keto_tpu.replica.checkcache import CheckCache
+from keto_tpu.replica.controller import ReplicaController
+from keto_tpu.replica.store import ReplicaStore, row_to_tuple
+
+__all__ = ["CheckCache", "ReplicaController", "ReplicaStore", "row_to_tuple"]

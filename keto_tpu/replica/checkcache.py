@@ -1,0 +1,150 @@
+"""Watch-invalidated check cache: decisions keyed by (tuple, snaptoken window).
+
+Every cached entry — positive AND negative decisions — records the
+snaptoken it was computed at (``from_token``) and stays **open** until
+the first commit group applied after it closes the window
+(``closed_at``). Because reachability is transitive — one inserted edge
+anywhere can flip a decision whose query tuple it never mentions, across
+namespaces via subject-set edges — invalidation is deliberately
+**global**: any applied delta closes every open window. That is the only
+namespace-config-oblivious policy that can never serve a hit an applied
+delta invalidated (the acceptance bar, fuzz-tested); the cost is that a
+write burst empties the cache, which is exactly what a bounded-staleness
+read tier wants.
+
+Window semantics (sound by construction):
+
+- an **open** entry represents the live state: it serves any request
+  whose ``at_least`` the replica gate already admitted (``<= watermark``);
+- a **closed** entry represents states ``[from_token, closed_at - 1]``:
+  it serves only explicit snaptoken reads with ``at_least < closed_at``
+  ("bypassed for snaptokens above the entry's window"); tokenless reads
+  mean "current" and never accept a closed entry;
+- an insert racing a concurrent invalidation (decision computed at
+  ``token``, commit applied before the insert ran) is **dropped** — both
+  paths take one lock, so the stale insert observes ``last_close >
+  token`` and never becomes an open entry.
+
+Bounded + LRU: at most ``entries`` decisions; lookups refresh recency.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+
+class _Entry:
+    __slots__ = ("allowed", "from_token", "closed_at")
+
+    def __init__(self, allowed: bool, from_token: int):
+        self.allowed = allowed
+        self.from_token = from_token
+        self.closed_at: Optional[int] = None
+
+
+class CheckCache:
+    def __init__(self, entries: int = 65536):
+        self.capacity = max(1, int(entries))
+        self._mu = threading.Lock()  # guards: _map, _open, _last_close, counters
+        self._map: OrderedDict[str, _Entry] = OrderedDict()
+        # keys of currently-open entries: closing on an applied commit is
+        # O(open), and each entry closes at most once — amortized O(1)
+        self._open: set[str] = set()
+        self._last_close = 0
+        #: /metrics bridges (keto_checkcache_* families)
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._map)
+
+    def get(self, key: str, at_least: Optional[int]) -> Optional[tuple[bool, int]]:
+        """Cached ``(allowed, decision_token)`` valid for ``at_least``
+        (already gate-admitted: ``at_least <= replica watermark``), or
+        None. Tokenless reads (``at_least=None``) mean "current" and only
+        open windows qualify."""
+        with self._mu:
+            e = self._map.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            if e.closed_at is None:
+                self._map.move_to_end(key)
+                self.hits += 1
+                return e.allowed, e.from_token
+            if at_least is not None and at_least < e.closed_at:
+                self._map.move_to_end(key)
+                self.hits += 1
+                return e.allowed, max(e.from_token, at_least)
+            self.misses += 1
+            return None
+
+    def put(self, key: str, allowed: bool, token: Optional[int]) -> bool:
+        """Record a decision computed at snaptoken ``token``. Dropped
+        (returns False) when a delta already applied past the decision's
+        state — caching it open would be the exact stale-hit bug the
+        fuzz suite hunts."""
+        if token is None:
+            return False
+        token = int(token)
+        with self._mu:
+            if token < self._last_close:
+                return False
+            old = self._map.pop(key, None)
+            if old is not None:
+                self._open.discard(key)
+            e = _Entry(bool(allowed), token)
+            self._map[key] = e
+            # |= rather than .add(): the lock-order analyzer's
+            # unique-name call resolution would read ``.add`` under this
+            # lock as HbmGovernor.add and report a phantom lock cycle
+            self._open |= {key}
+            while len(self._map) > self.capacity:
+                k, _ = self._map.popitem(last=False)
+                self._open.discard(k)
+            return True
+
+    def note_commit(self, token: int) -> int:
+        """An applied delta at snaptoken ``token``: close every open
+        window (global invalidation — see the module docstring for why
+        anything finer is unsound without rewrite-config analysis).
+        Returns how many entries were invalidated."""
+        token = int(token)
+        with self._mu:
+            self._last_close = max(self._last_close, token)
+            n = len(self._open)
+            for k in self._open:
+                e = self._map.get(k)
+                if e is not None:
+                    e.closed_at = token
+            self._open.clear()
+            self.invalidations += n
+            return n
+
+    def clear(self, token: int) -> None:
+        """Full reset at ``token`` (a re-bootstrap replaced the state
+        discontinuously: even closed windows may describe a history this
+        replica no longer vouches for)."""
+        with self._mu:
+            self._last_close = max(self._last_close, int(token))
+            self.invalidations += len(self._map)
+            self._map.clear()
+            self._open.clear()
+
+    def snapshot(self) -> dict:
+        """Scrape-time view for the /metrics bridges."""
+        with self._mu:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "invalidations": self.invalidations,
+                "entries": len(self._map),
+                "open_entries": len(self._open),
+            }
+
+
+__all__ = ["CheckCache"]

@@ -1,0 +1,146 @@
+"""Replica tuple store: the primary's commit log, materialized locally.
+
+A ``ReplicaStore`` is a ``MemoryPersister`` whose watermark is not its
+own counter but the **primary's snaptokens**: every applied Watch commit
+group lands at exactly the token it committed at on the primary, so
+``check(snaptoken=)`` pins, page tokens, watch resumes, and the snapshot
+cache keying all mean the same thing on a replica as on the primary.
+
+Three contracts distinguish it from the ordinary in-memory store:
+
+- **Read-only to the public write path.** ``transact_relation_tuples``
+  raises ``ErrReplicaReadOnly`` — replicas hold no authority over the
+  tuple log; mutations arrive only through ``apply_commit``.
+- **Exactly-once application, guarded by the watermark.** A commit group
+  with ``token <= watermark`` is skipped (counted, never re-applied), so
+  a Watch reconnect that replays groups — or a feed restart resuming
+  from a durable watermark older than the live state — is idempotent by
+  construction.
+- **Bootstrap replaces, never merges.** ``bootstrap`` installs a full
+  tuple state at an exact watermark and raises every delta/watch horizon
+  to it: a delta or watch read spanning a (re-)bootstrap can never be
+  served (the history was not observed locally), so downstream snapshot
+  maintenance rebuilds instead of silently diverging.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from keto_tpu.persistence.memory import InternalRow, MemoryPersister
+from keto_tpu.relationtuple.manager import TransactResult
+from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+from keto_tpu.x.errors import ErrReplicaReadOnly
+
+
+def row_to_tuple(nm, row: InternalRow) -> RelationTuple:
+    """``InternalRow`` → ``RelationTuple`` through namespace manager
+    ``nm`` — the export stream's row codec (both persisters' snapshot
+    rows share the InternalRow shape)."""
+    ns = nm.get_namespace_by_config_id(row.namespace_id)
+    if row.subject_id is not None:
+        subject: object = SubjectID(id=row.subject_id)
+    else:
+        sns = nm.get_namespace_by_config_id(row.sset_namespace_id)
+        subject = SubjectSet(
+            namespace=sns.name, object=row.sset_object, relation=row.sset_relation
+        )
+    return RelationTuple(
+        namespace=ns.name, object=row.object, relation=row.relation, subject=subject
+    )
+
+
+class ReplicaStore(MemoryPersister):
+    """Watch-fed, watermark-guarded view of the primary's tuple state."""
+
+    def __init__(self, namespace_manager_source, network_id: str = "default"):
+        super().__init__(namespace_manager_source, network_id)
+        #: commit groups applied at their primary snaptoken
+        self.applied_commits = 0
+        #: commit groups skipped by the exactly-once watermark guard
+        self.skipped_commits = 0
+        #: full-state installs (cold start + every 410-triggered redo)
+        self.bootstraps = 0
+
+    # -- the public write path is closed --------------------------------------
+
+    def transact_relation_tuples(
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+        idempotency_key: Optional[str] = None,
+    ) -> TransactResult:
+        raise ErrReplicaReadOnly()
+
+    # -- replication-internal mutation ----------------------------------------
+
+    def _apply_at(
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+        token: int,
+    ) -> None:
+        """Run one transaction through the parent's transact machinery,
+        pinned to land at exactly ``token``: the parent bumps the shared
+        watermark by one, so setting it to ``token - 1`` first makes the
+        commit (and its insert/delete log entries) carry the primary's
+        snaptoken. Caller holds the shared lock and has verified
+        ``token > watermark``."""
+        self._shared.watermark = int(token) - 1
+        MemoryPersister.transact_relation_tuples(self, insert, delete)
+        # deletes that matched nothing (the documented watch-replay
+        # elision pairs them with elided inserts) must still land the
+        # group's token: the parent bump always reaches token, but assert
+        # the invariant rather than assume it
+        assert self._shared.watermark == int(token)
+
+    def apply_commit(
+        self,
+        token: int,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+    ) -> bool:
+        """Apply one Watch commit group at its primary snaptoken.
+        Returns True when applied, False when the watermark guard skipped
+        it (already applied — exactly-once across reconnect replays)."""
+        token = int(token)
+        with self._shared.lock:
+            if token <= self._shared.watermark:
+                self.skipped_commits += 1
+                return False
+            self._apply_at(insert, delete, token)
+            self.applied_commits += 1
+            return True
+
+    def bootstrap(self, tuples: Sequence[RelationTuple], watermark: int) -> None:
+        """Install a full tuple state at exactly ``watermark`` (the
+        primary's export watermark), replacing whatever was held before.
+        Every log floor rises to the watermark: deltas and watch resumes
+        from before the bootstrap cannot be served from a history this
+        process never observed."""
+        watermark = int(watermark)
+        nid = self.network_id
+        with self._shared.lock:
+            self._shared.rows[nid] = []
+            self._shared.lhs_index = None
+            self._shared.col_cache.pop(nid, None)
+            self._shared.insert_log[nid] = []
+            self._shared.delete_log[nid] = []
+            self._shared.commit_times[nid] = []
+            if tuples:
+                self._apply_at(tuples, (), watermark)
+            else:
+                self._shared.watermark = watermark
+            # the bootstrap is a state discontinuity, not an observed
+            # history: raise every horizon so rows_since/changes_since/
+            # watch below the watermark answer "rebuild"/"expired",
+            # never a partial delta
+            self._shared.insert_log[nid] = []
+            self._shared.delete_log[nid] = []
+            self._shared.log_floor[nid] = watermark
+            self._shared.del_floor[nid] = watermark
+            self._shared.delete_wm[nid] = watermark
+            self.bootstraps += 1
+
+
+__all__ = ["ReplicaStore", "row_to_tuple"]

@@ -190,10 +190,28 @@ class CheckService:
                         f"invalid x-keto-priority {v!r} (expected interactive|batch)"
                     )
                 break
+        # replica mode: gate the pin against the applied watermark
+        # (FAILED_PRECONDITION above it), then the Watch-invalidated
+        # check cache — same semantics as the REST path
+        rep = self.registry.replica_controller()
+        cache = rep.checkcache if rep is not None else None
+        key = None
+        if rep is not None:
+            rep.gate_read(at_least, bool(request.latest))
+            if cache is not None:
+                key = str(tuple_)
+                got = cache.get(key, at_least)
+                if got is not None:
+                    allowed, token = got
+                    return check_service_pb2.CheckResponse(
+                        allowed=allowed, snaptoken=str(token)
+                    )
         allowed, token = self.registry.check_batcher().check_with_token(
             tuple_, at_least=at_least, latest=request.latest, deadline=deadline,
             lane=lane,
         )
+        if cache is not None and key is not None:
+            cache.put(key, allowed, token)
         return check_service_pb2.CheckResponse(
             allowed=allowed, snaptoken="" if token is None else str(token)
         )
@@ -225,6 +243,9 @@ class ExpandService:
 
     def Expand(self, request, context):
         subject = subject_from_proto(request.subject)
+        rep = self.registry.replica_controller()
+        if rep is not None:
+            rep.gate_read(None)  # UNAVAILABLE until the first bootstrap
         tree = self.registry.expand_engine().build_tree(
             subject, self.registry.expand_depth(request.max_depth)
         )
@@ -259,6 +280,9 @@ class ReadService:
         if not request.HasField("query"):
             raise ErrBadRequest("invalid request")
         query = query_from_proto(request.query)
+        rep = self.registry.replica_controller()
+        if rep is not None:
+            rep.gate_read(None)  # UNAVAILABLE until the first bootstrap
         opts = []
         if request.page_token:
             opts.append(with_token(request.page_token))
@@ -299,6 +323,10 @@ class WriteService:
         self.registry = registry
 
     def TransactRelationTuples(self, request, context):
+        if self.registry.is_replica():
+            from keto_tpu.x.errors import ErrReplicaReadOnly
+
+            raise ErrReplicaReadOnly()
         insert, delete = [], []
         for delta in request.relation_tuple_deltas:
             action = delta.action
@@ -413,6 +441,9 @@ class ListService:
         if sub is None:
             raise ErrBadRequest("Subject has to be specified.")
         at_least, latest = self._consistency(request)
+        rep = self.registry.replica_controller()
+        if rep is not None:
+            rep.gate_read(at_least, latest)
         objs, nxt, token = self.registry.list_engine().page_objects(
             ns, rel, sub,
             page_size=int(request.get("page_size", 0) or 0),
@@ -432,6 +463,9 @@ class ListService:
         if not rel:
             raise ErrBadRequest("relation has to be specified")
         at_least, latest = self._consistency(request)
+        rep = self.registry.replica_controller()
+        if rep is not None:
+            rep.gate_read(at_least, latest)
         subs, nxt, token = self.registry.list_engine().page_subjects(
             ns, obj, rel,
             page_size=int(request.get("page_size", 0) or 0),

@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import json
 import math
+import re
 import threading
 import time
 import uuid
@@ -89,13 +90,19 @@ WRITE = "write"
 MAX_BATCH_CHECK = 65536
 
 
-def _retry_after_headers(err: KetoError) -> dict[str, str]:
+def _error_headers(err: KetoError) -> dict[str, str]:
     """Overload errors carry the server's backoff advice: a Retry-After
-    header (integer seconds) on 429/503 responses."""
+    header (integer seconds) on 429/503/412 responses. A replica's 412
+    additionally surfaces its current applied watermark as
+    ``X-Keto-Watermark`` so callers can re-pin or route to the primary."""
+    out: dict[str, str] = {}
     ra = getattr(err, "retry_after_s", None)
-    if not ra:
-        return {}
-    return {"Retry-After": str(max(1, math.ceil(ra)))}
+    if ra:
+        out["Retry-After"] = str(max(1, math.ceil(ra)))
+    wm = (getattr(err, "details", None) or {}).get("watermark")
+    if wm is not None:
+        out["X-Keto-Watermark"] = str(wm)
+    return out
 
 
 @dataclass
@@ -236,7 +243,17 @@ class RestApp:
                     return self._get_list_subjects(query, headers)
                 if route == ("GET", "/watch"):
                     return self._get_watch(query)
+                if route == ("GET", "/snapshot/export"):
+                    return self._get_snapshot_export(query)
             else:
+                if self.registry.is_replica() and method in (
+                    "PUT", "DELETE", "PATCH",
+                ):
+                    # replicas hold no authority over the tuple log:
+                    # every mutation surface refuses before dispatch
+                    from keto_tpu.x.errors import ErrReplicaReadOnly
+
+                    raise ErrReplicaReadOnly()
                 if route == ("PUT", "/relation-tuples"):
                     return self._put_relation_tuple(body, headers)
                 if route == ("DELETE", "/relation-tuples"):
@@ -248,7 +265,7 @@ class RestApp:
             err.status_code = 404
             return 404, err.to_json(), {}
         except KetoError as e:
-            return e.status_code, e.to_json(), _retry_after_headers(e)
+            return e.status_code, e.to_json(), _error_headers(e)
         except Exception as e:  # unexpected → 500 envelope
             err = KetoError(str(e) or "internal server error")
             return 500, err.to_json(), {}
@@ -274,6 +291,83 @@ class RestApp:
         )
         return 200, RawBody(m.render(openmetrics=openmetrics).encode(), content_type), {}
 
+    # -- snapshot export (replica bootstrap source) ---------------------------
+
+    #: rows per ndjson chunk of the tuple export stream
+    _EXPORT_CHUNK = 2048
+
+    _CACHE_TAG_RE = re.compile(r"^v\d+-w\d+$")
+    _SEGMENT_NAME_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+    def _get_snapshot_export(self, query):
+        """``GET /snapshot/export`` — the replica bootstrap surface.
+
+        - bare: manifest JSON ``{watermark, format, cache}`` where
+          ``cache`` lists the newest current-format snapshot-cache
+          directory's segments (or null) — replicas mirror the segments
+          when the cache watermark matches the export watermark, and the
+          probe loop polls this for the primary's watermark;
+        - ``?stream=tuples``: chunked ndjson of the FULL tuple state at
+          one consistent watermark — a header line ``{"watermark",
+          "count"}`` then one ``{"relation_tuple"}`` line per tuple;
+        - ``?cache=<tag>&segment=<name>``: raw bytes of one cache
+          segment (names validated against the manifest grammar)."""
+        store = self.registry.relation_tuple_manager()
+        cache_dir = str(
+            self.registry.config().get("serve.snapshot_cache_dir", "") or ""
+        )
+        tag = (query.get("cache") or [""])[0]
+        seg = (query.get("segment") or [""])[0]
+        if tag or seg:
+            if not (tag and seg):
+                raise ErrBadRequest(
+                    "segment fetch needs both ?cache=<tag> and ?segment=<name>"
+                )
+            if not self._CACHE_TAG_RE.match(tag):
+                raise ErrBadRequest(f"malformed cache tag {tag!r}")
+            if not self._SEGMENT_NAME_RE.match(seg):
+                raise ErrBadRequest(f"malformed segment name {seg!r}")
+            from pathlib import Path
+
+            from keto_tpu.x.errors import ErrNotFound
+
+            path = Path(cache_dir) / tag / seg if cache_dir else None
+            if path is None or not path.is_file():
+                raise ErrNotFound(f"no cache segment {tag}/{seg}")
+            return 200, RawBody(path.read_bytes(), "application/octet-stream"), {}
+        stream = (query.get("stream") or [""])[0]
+        if stream and stream != "tuples":
+            raise ErrBadRequest(f"unknown export stream {stream!r}")
+        if stream == "tuples":
+            from keto_tpu.replica.store import row_to_tuple
+
+            rows, wm = store.snapshot_rows()
+            nm = self.registry.namespace_manager()
+
+            def gen():
+                head = json.dumps({"watermark": str(wm), "count": len(rows)})
+                buf = [head]
+                for row in rows:
+                    buf.append(
+                        json.dumps(
+                            {"relation_tuple": row_to_tuple(nm, row).to_json()}
+                        )
+                    )
+                    if len(buf) >= self._EXPORT_CHUNK:
+                        yield ("\n".join(buf) + "\n").encode()
+                        buf = []
+                if buf:
+                    yield ("\n".join(buf) + "\n").encode()
+
+            return 200, StreamBody(gen()), {"X-Keto-Snaptoken": str(wm)}
+        wm = store.watermark()
+        cache = None
+        if cache_dir:
+            from keto_tpu.graph.snapcache import export_manifest
+
+            cache = export_manifest(cache_dir, max_watermark=wm)
+        return 200, {"watermark": str(wm), "format": 1, "cache": cache}, {}
+
     # -- health --------------------------------------------------------------
 
     def _health_ready(self):
@@ -289,12 +383,15 @@ class RestApp:
         state, reason = monitor.status()
         if state not in READY_STATES:
             body = {"status": "unavailable", "reason": reason or state.value}
+            self._add_replica_health(body)
             # backoff advice rides the 503: probes already poll on their
             # own period, but ad-hoc clients should not hammer a server
             # that just told them its snapshot is stale
             return 503, body, {"Retry-After": "1"}
         if state is HealthState.SERVING:
-            return 200, {"status": "ok"}, {}
+            body = {"status": "ok"}
+            self._add_replica_health(body)
+            return 200, body, {}
         body = {"status": state.value}
         if reason:
             body["reason"] = reason
@@ -303,7 +400,24 @@ class RestApp:
             # carries {phase, pct} from the pipeline's progress tracker
             # instead of leaving probes staring at a bare state
             body.update(monitor.starting_detail())
+        self._add_replica_health(body)
         return 200, body, {}
+
+    def _add_replica_health(self, body: dict) -> None:
+        """On a replica, every readiness answer carries the replication
+        picture: role, applied watermark, lag, and primary connectivity
+        — the operator's one-glance view of a read-tier member."""
+        rep = self.registry.replica_controller()
+        if rep is None:
+            return
+        body.update(
+            {
+                "role": "replica",
+                "watermark": str(rep.watermark),
+                "lag_s": round(rep.lag_s(), 3),
+                "primary_connected": rep.primary_connected,
+            }
+        )
 
     # -- read ----------------------------------------------------------------
 
@@ -356,11 +470,34 @@ class RestApp:
 
     def _check(self, tuple_: RelationTuple, query, headers=None):
         at_least, latest = self._consistency_from(query)
+        # replica mode: admit the pin against the applied watermark
+        # (block-then-412 above it), then try the Watch-invalidated
+        # check cache before paying a device dispatch
+        rep = self.registry.replica_controller()
+        cache = rep.checkcache if rep is not None else None
+        key = None
+        if rep is not None:
+            rep.gate_read(at_least, latest)
+            if cache is not None:
+                key = str(tuple_)
+                got = cache.get(key, at_least)
+                if got is not None:
+                    allowed, token = got
+                    return (
+                        (200 if allowed else 403),
+                        {"allowed": allowed},
+                        {
+                            "X-Keto-Snaptoken": str(token),
+                            "X-Keto-Checkcache": "hit",
+                        },
+                    )
         allowed, token = self.registry.check_batcher().check_with_token(
             tuple_, at_least=at_least, latest=latest,
             deadline=self._deadline_from(query, headers),
             lane=self._lane_from(headers),
         )
+        if cache is not None and key is not None:
+            cache.put(key, allowed, token)
         resp_headers = {} if token is None else {"X-Keto-Snaptoken": str(token)}
         return (200 if allowed else 403), {"allowed": allowed}, resp_headers
 
@@ -406,6 +543,9 @@ class RestApp:
             )
         tuples = [RelationTuple.from_json(t) for t in raw]
         at_least, latest = self._consistency_from(query)
+        rep = self.registry.replica_controller()
+        if rep is not None:
+            rep.gate_read(at_least, latest)
         results, token = batcher.check_batch_with_token(
             tuples, at_least=at_least, latest=latest,
             deadline=self._deadline_from(query, headers),
@@ -425,6 +565,9 @@ class RestApp:
         except ValueError:
             raise ErrBadRequest(f"invalid max-depth {raw_depth!r}") from None
         subject = subject_set_from_url_query(query)
+        rep = self.registry.replica_controller()
+        if rep is not None:
+            rep.gate_read(None)  # 503 until the first bootstrap lands
         tree = self.registry.expand_engine().build_tree(
             subject, self.registry.expand_depth(depth)
         )
@@ -434,6 +577,9 @@ class RestApp:
 
     def _get_relation_tuples(self, query):
         rq = RelationQuery.from_url_query(query)
+        rep = self.registry.replica_controller()
+        if rep is not None:
+            rep.gate_read(None)  # 503 until the first bootstrap lands
         opts = []
         token = (query.get("page_token") or [""])[0]
         if token:
@@ -485,6 +631,9 @@ class RestApp:
         if sub is None:
             raise ErrBadRequest("Subject has to be specified.")
         at_least, latest = self._consistency_from(query)
+        rep = self.registry.replica_controller()
+        if rep is not None:
+            rep.gate_read(at_least, latest)
         size, token = self._page_opts(query)
         objs, nxt, snaptoken = self.registry.list_engine().page_objects(
             rq.namespace, rq.relation, sub,
@@ -507,6 +656,9 @@ class RestApp:
         if rq.relation == "":
             raise ErrBadRequest("relation has to be specified")
         at_least, latest = self._consistency_from(query)
+        rep = self.registry.replica_controller()
+        if rep is not None:
+            rep.gate_read(at_least, latest)
         size, token = self._page_opts(query)
         subs, nxt, snaptoken = self.registry.list_engine().page_subjects(
             rq.namespace, rq.object, rq.relation,

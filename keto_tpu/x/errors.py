@@ -56,6 +56,8 @@ class KetoError(Exception):
         }
         if self.reason:
             body["reason"] = self.reason
+        if self.details:
+            body["details"] = self.details
         return {"error": body}
 
 
@@ -165,6 +167,44 @@ class ErrMalformedPageToken(ErrBadRequest):
     """Reference internal/persistence/definitions.go:32."""
 
     def __init__(self, message: str = "malformed page token", **kw):
+        super().__init__(message, **kw)
+
+
+class ErrPreconditionFailed(KetoError):
+    """A read pinned to a snaptoken the serving replica has not applied
+    yet (and did not reach within ``serve.staleness_wait_ms``) — REST 412
+    Precondition Failed / gRPC FAILED_PRECONDITION. The response carries
+    the replica's current applied watermark (``details.watermark`` and
+    the ``X-Keto-Watermark`` header) plus Retry-After advice; callers
+    retry here or fall back to the primary (the SDK does the latter
+    automatically)."""
+
+    status_code = 412
+    grpc_code = 9  # FAILED_PRECONDITION
+
+    def __init__(
+        self,
+        message: str = "requested snaptoken is ahead of this replica's "
+        "applied watermark",
+        **kw,
+    ):
+        super().__init__(message, **kw)
+
+
+class ErrReplicaReadOnly(KetoError):
+    """A write reached a replica: replicas hold no SQL access and apply
+    state only through the primary's Watch changefeed — REST 403 /
+    gRPC PERMISSION_DENIED. Write to the primary instead."""
+
+    status_code = 403
+    grpc_code = 7  # PERMISSION_DENIED
+
+    def __init__(
+        self,
+        message: str = "this server is a read replica; writes must go to "
+        "the primary",
+        **kw,
+    ):
         super().__init__(message, **kw)
 
 

@@ -64,6 +64,7 @@ KNOWN_ROUTES = frozenset(
         "/relation-tuples",
         "/relation-tuples/list-objects",
         "/relation-tuples/list-subjects",
+        "/snapshot/export",
         "/watch",
         "/version",
         "/metrics",

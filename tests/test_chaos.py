@@ -69,7 +69,14 @@ def T(obj, sub):
 class DaemonProc:
     """One chaos_runner subprocess plus its published ports."""
 
-    def __init__(self, dbfile: Path, cache_dir: Path, workdir: Path, faults: str = ""):
+    def __init__(
+        self,
+        dbfile: Path,
+        cache_dir: Path,
+        workdir: Path,
+        faults: str = "",
+        extra_args: tuple = (),
+    ):
         self.port_file = workdir / f"ports-{os.urandom(4).hex()}.json"
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
@@ -95,6 +102,7 @@ class DaemonProc:
                 "--dsn", f"sqlite://{dbfile}",
                 "--cache-dir", str(cache_dir),
                 "--port-file", str(self.port_file),
+                *extra_args,
             ],
             cwd=REPO,
             env=env,
