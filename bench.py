@@ -1087,6 +1087,96 @@ def run_scrape_overhead():
     return out
 
 
+def run_timeline_overhead():
+    """Request-timeline recorder cost, measured the way the acceptance
+    bar states it: p99 single-check REST latency with the recorder ON
+    (the default — every request stamps arrival→deliver, ring + top-K
+    bookkeeping, Server-Timing header) vs serve.timeline_enabled=false.
+    Two small daemons boot sequentially over the same seeded memory
+    store; the budget is <= 5% p99 overhead, with the timeline families
+    live on /metrics during the ON pass."""
+    import urllib.request
+
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID
+
+    n_checks = int(os.environ.get("BENCH_TIMELINE_CHECKS", 2000))
+
+    def measure(timeline_enabled: bool) -> dict:
+        cfg = Config(
+            overrides={
+                "namespaces": [{"id": 0, "name": "acl"}],
+                "dsn": "memory",
+                "serve.read.port": 0,
+                "serve.write.port": 0,
+                "serve.timeline_enabled": timeline_enabled,
+            }
+        )
+        daemon = Daemon(Registry(cfg))
+        daemon.serve_all(block=False)
+        families_live = False
+        try:
+            store = daemon.registry.relation_tuple_manager()
+            store.write_relation_tuples(
+                *[
+                    RelationTuple(
+                        namespace="acl", object=f"obj-{i}", relation="access",
+                        subject=SubjectID(f"user-{i}"),
+                    )
+                    for i in range(2000)
+                ]
+            )
+            url = (
+                f"http://127.0.0.1:{daemon.read_port}"
+                "/check?namespace=acl&object=obj-7&relation=access&subject_id=user-7"
+            )
+            urllib.request.urlopen(url, timeout=10)  # warm: snapshot + jit
+            lat = []
+            for _ in range(n_checks):
+                t0 = time.perf_counter()
+                urllib.request.urlopen(url, timeout=10)
+                lat.append(time.perf_counter() - t0)
+            if timeline_enabled:
+                scrape = urllib.request.urlopen(
+                    f"http://127.0.0.1:{daemon.read_port}/metrics", timeout=10
+                ).read().decode()
+                families_live = (
+                    "keto_timeline_stage_duration_seconds_count" in scrape
+                    and "keto_timeline_finished_total" in scrape
+                    and "keto_slo_availability_ratio" in scrape
+                )
+        finally:
+            daemon.shutdown()
+        lat.sort()
+        return {
+            "checks": n_checks,
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+            "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 3),
+            "families_live": families_live,
+        }
+
+    with_timeline = measure(True)
+    without = measure(False)
+    overhead_pct = (
+        round(100.0 * (with_timeline["p99_ms"] / without["p99_ms"] - 1.0), 2)
+        if without["p99_ms"] > 0
+        else None
+    )
+    out = {
+        "recorder_on": with_timeline,
+        "recorder_off": without,
+        "p99_overhead_pct": overhead_pct,
+    }
+    log(
+        f"[timeline] p99 {with_timeline['p99_ms']:.2f} ms recorder-on vs "
+        f"{without['p99_ms']:.2f} ms recorder-off -> {overhead_pct}% overhead "
+        f"(families_live={with_timeline['families_live']})"
+    )
+    return out
+
+
 # -- open-loop overload harness ----------------------------------------------
 #
 # The honest load story: a CLOSED-loop generator (fire, wait, fire) slows
@@ -2244,6 +2334,16 @@ def main():
             log(f"[scrape] FAILED: {e!r}")
             scrape_overhead = {"error": repr(e)}
 
+    # request-timeline recorder cost: p99 check latency recorder-on vs
+    # recorder-off, timeline families live (failures degrade to an error)
+    timeline_overhead = None
+    if os.environ.get("BENCH_TIMELINE", "1") != "0":
+        try:
+            timeline_overhead = run_timeline_overhead()
+        except Exception as e:  # pragma: no cover - diagnostic path
+            log(f"[timeline] FAILED: {e!r}")
+            timeline_overhead = {"error": repr(e)}
+
     # overload resilience: open-loop 3x capacity, per-lane tail latency,
     # shed accounting, brownout + drain (failures degrade to an error field)
     overload = None
@@ -2355,6 +2455,7 @@ def main():
                     "tpu_oracle_mismatches": mismatch_vs_oracle,
                     "device": str(jax.devices()[0]),
                     "scrape_overhead": scrape_overhead,
+                    "timeline_overhead": timeline_overhead,
                     "overload": overload,
                     "depth_sweep": depth_sweep,
                     "reverse_query": reverse_query,

@@ -675,6 +675,13 @@ class TpuCheckEngine:
     collectives or corrupting results.
     """
 
+    #: capability flag: ``batch_check_stream_with_token`` accepts
+    #: ``with_info=True`` (ordered=False only) and yields
+    #: ``(offset, decisions, slice_info)`` — per-slice width / BFS steps
+    #: / label-vs-BFS route / halo rounds+bytes, what the CheckBatcher
+    #: stamps onto each rider's request timeline (keto_tpu/x/timeline.py)
+    STREAM_INFO = True
+
     def __init__(
         self,
         store,
@@ -1407,10 +1414,14 @@ class TpuCheckEngine:
             token = None
         return out, token
 
-    def _fallback_stream(self, tuples_iter, *, ordered: bool, chunk: int = 1024):
+    def _fallback_stream(
+        self, tuples_iter, *, ordered: bool, chunk: int = 1024,
+        with_info: bool = False,
+    ):
         """Streaming surface of the CPU fallback — same yield contract as
         ``_stream`` (bool arrays in order, or ``(offset, array)`` pairs
-        with ``ordered=False``). Returns ``(generator, token)``."""
+        with ``ordered=False``; ``with_info`` adds the per-slice info
+        dict with route ``cpu``). Returns ``(generator, token)``."""
         try:
             token = self._store.watermark()
         except Exception:
@@ -1421,6 +1432,7 @@ class TpuCheckEngine:
             it = iter(tuples_iter)
             off = 0
             while True:
+                t0 = time.perf_counter()
                 batch = list(itertools.islice(it, chunk))
                 if not batch:
                     return
@@ -1429,7 +1441,19 @@ class TpuCheckEngine:
                     count=len(batch),
                 )
                 self.maintenance.incr("fallback_checks", by=len(batch))
-                yield (off, out) if not ordered else out
+                if ordered:
+                    yield out
+                elif with_info:
+                    yield off, out, {
+                        "width": len(batch),
+                        "bfs_steps": 0,
+                        "route": "cpu",
+                        "service_ms": round(
+                            (time.perf_counter() - t0) * 1e3, 3
+                        ),
+                    }
+                else:
+                    yield off, out
                 off += len(batch)
 
         return gen(), token
@@ -2692,19 +2716,33 @@ class TpuCheckEngine:
         at_least: Optional[int] = None,
         mode: str = "latest",
         ordered: bool = True,
+        with_info: bool = False,
     ):
         """``batch_check_stream`` plus the deciding snapshot's id, resolved
         eagerly so serving callers can attach the snaptoken to responses
         they assemble as slices land. Returns ``(generator, token)``.
 
+        ``with_info=True`` (requires ``ordered=False``) widens each yield
+        to ``(offset, decisions, info)`` where ``info`` describes the
+        slice that landed: ``width`` (queries), ``bfs_steps``, ``route``
+        (``label`` | ``hybrid`` | ``bfs`` | ``host`` | ``cpu``),
+        ``service_ms``, and — in sharded mode — ``halo_rounds`` /
+        ``halo_bytes``. The CheckBatcher stamps this onto every rider's
+        request timeline.
+
         In degraded mode the stream is served by the CPU reference engine
         with the same yield contract (see ``batch_check_with_token`` for
         the fallback semantics)."""
+        if with_info and ordered:
+            raise ValueError("with_info requires ordered=False")
         if self._should_fallback():
-            return self._fallback_stream(tuples_iter, ordered=ordered)
+            return self._fallback_stream(
+                tuples_iter, ordered=ordered, with_info=with_info
+            )
         snap = self._snapshot_for(at_least, mode)
         gen = self._stream(
-            snap, tuples_iter, depth=depth, slice_cap=slice_cap, ordered=ordered
+            snap, tuples_iter, depth=depth, slice_cap=slice_cap,
+            ordered=ordered, with_info=with_info,
         )
         return self._guard_stream(gen), snap.snapshot_id
 
@@ -2725,7 +2763,10 @@ class TpuCheckEngine:
         cap = self._slice_cap(snap)
         return [32 * w for w in self._word_widths() if 32 * w <= cap]
 
-    def _stream(self, snap, tuples_iter, *, depth, slice_cap, ordered):
+    def _stream(
+        self, snap, tuples_iter, *, depth, slice_cap, ordered,
+        with_info: bool = False,
+    ):
         depth = depth or self._dispatch_window
         bound = self._slice_cap(snap)
         if slice_cap:
@@ -2791,7 +2832,38 @@ class TpuCheckEngine:
             if ctrl is not None:
                 ctrl.observe(nq, ms)
             self._audit_sample(chunk, out, snap.snapshot_id)
-            return off, out
+            if not with_info:
+                return off, out
+            # per-slice route/cost description for request timelines:
+            # which kernel answered and what it did (the stats words the
+            # kernels already carry, threaded per request instead of
+            # summed into counters)
+            if dev is None:
+                route = "host"
+            elif isinstance(dev, _HybridSlice):
+                route = "label" if dev.bfs_dev is None else "hybrid"
+            else:
+                route = "bfs"
+            info = {
+                "width": nq,
+                "bfs_steps": int(iters),
+                "route": route,
+                "service_ms": round(ms, 3),
+            }
+            halo_src = None
+            if isinstance(dev, _ShardedSlice):
+                halo_src = dev
+            elif isinstance(dev, _HybridSlice) and isinstance(
+                dev.bfs_dev, _ShardedSlice
+            ):
+                halo_src = dev.bfs_dev
+            if halo_src is not None:
+                # one frontier all-gather per real BFS hop: rounds ==
+                # the slice's iteration count, bytes == rounds x the
+                # per-round slab cost the dispatch recorded
+                info["halo_rounds"] = int(iters)
+                info["halo_bytes"] = int(iters) * halo_src.halo_bytes_per_round
+            return off, out, info
 
         src = slices()
         exhausted = False

@@ -221,6 +221,51 @@ CONFIG_SCHEMA = {
                     "default": 3600.0,
                     "description": "How long (seconds, wall clock) the durable change logs feeding /watch and the delta-overlay path retain entries before GC (memory and SQL stores; on SQL the tuple rows themselves also serve insert replay and are never GC'd — this bounds the delete log). A watch resume (or replica feed) older than the retained horizon answers 410/ErrWatchExpired; replicas recover by automatic full re-bootstrap. 0 disables time-based GC (the count-based caps still apply).",
                 },
+                "timeline_enabled": {
+                    "type": "boolean",
+                    "default": True,
+                    "description": "Per-request timeline recorder (keto_tpu/x/timeline.py): every non-health request records the stages it passes through (arrival, admission verdict, lane queue wait, pack, dispatch, each device slice with width/BFS-steps/route/halo cost, land, deliver) into a bounded ring, emits them as child spans under the request's traceparent, summarizes them in the Server-Timing response header (gRPC: server-timing trailing metadata), and serves them at GET /debug/requests. Cheap enough to leave on (bench.py timeline_overhead gates <= 5% p99 impact); false disables recording entirely (the endpoints stay, reporting empty).",
+                },
+                "timeline_ring": {
+                    "type": "integer",
+                    "default": 512,
+                    "description": "How many finished request timelines the recorder's ring retains (plus a fixed top-K slowest set kept separately). GET /debug/requests reads from this bound; older timelines rotate out.",
+                },
+                "debug_bundle_dir": {
+                    "type": "string",
+                    "default": "",
+                    "description": "Flight recorder (keto_tpu/x/flightrec.py): directory anomaly debug bundles are atomically written to. A bundle (recent+slowest request timelines, health transition history, HBM governor ledger, admission/batcher state, a metrics snapshot, the lockwatch report when the sanitizer runs) is dumped on DEGRADED/NOT_SERVING health transitions, contained device OOMs, SIGTERM drains, and lock-watchdog trips — rate-limited, size-capped, and count-bounded. Empty disables the recorder.",
+                },
+                "debug_bundle_max": {
+                    "type": "integer",
+                    "default": 8,
+                    "description": "Flight recorder retention: newest bundles kept in serve.debug_bundle_dir; older ones are pruned after each dump.",
+                },
+                "debug_bundle_min_interval_s": {
+                    "type": "number",
+                    "default": 30.0,
+                    "description": "Flight recorder rate limit: minimum seconds between bundle dumps — a flapping health state or an OOM storm produces one bundle per interval, not one per event (suppressed triggers are counted on keto_flightrec_suppressed_total).",
+                },
+                "debug_bundle_max_bytes": {
+                    "type": "integer",
+                    "default": 4194304,
+                    "description": "Flight recorder size cap: a bundle exceeding this sheds sections in a deterministic order (metrics snapshot first, timelines last) and records which were shed, so one dump can never write an unbounded file.",
+                },
+                "slo_availability_objective": {
+                    "type": "number",
+                    "default": 0.999,
+                    "description": "SLO engine (keto_tpu/x/slo.py): the availability objective (fraction of REST+gRPC requests without a server-side 5xx/INTERNAL-class failure) the keto_slo_* burn rates and GET /slo are judged against.",
+                },
+                "slo_latency_objective_ms": {
+                    "type": "number",
+                    "default": 250.0,
+                    "description": "SLO engine: the latency threshold (milliseconds) a request must answer within to count as 'good' for the latency objective. Quantized UP to the nearest request-latency histogram bucket edge; the /slo report states the edge actually used.",
+                },
+                "slo_latency_objective_ratio": {
+                    "type": "number",
+                    "default": 0.99,
+                    "description": "SLO engine: the target fraction of requests answering within serve.slo_latency_objective_ms; the latency burn rate measures budget spend against 1 minus this.",
+                },
                 "drain_timeout_s": {
                     "type": "number",
                     "default": 5.0,

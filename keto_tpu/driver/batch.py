@@ -51,6 +51,7 @@ from typing import TYPE_CHECKING, Optional, Sequence
 from keto_tpu.relationtuple.model import RelationTuple
 from keto_tpu.x import faults
 from keto_tpu.x.errors import ErrDeadlineExceeded, ErrTooManyRequests, KetoError
+from keto_tpu.x.timeline import current_timeline
 
 if TYPE_CHECKING:
     from keto_tpu.driver.admission import AdmissionController
@@ -69,10 +70,10 @@ class _Item:
 
     __slots__ = (
         "tuples", "fut", "at_least", "latest", "deadline", "lane",
-        "results", "taken", "remaining",
+        "results", "taken", "remaining", "tl",
     )
 
-    def __init__(self, tuples, fut, at_least, latest, deadline, lane):
+    def __init__(self, tuples, fut, at_least, latest, deadline, lane, tl=None):
         self.tuples = tuples
         self.fut = fut
         self.at_least = at_least
@@ -82,6 +83,10 @@ class _Item:
         self.results: list = [None] * len(tuples)
         self.taken = 0  # tuples already handed to a dispatch round
         self.remaining = len(tuples)  # results not yet filled in
+        #: the caller's request timeline (keto_tpu/x/timeline.py), bound
+        #: by the serving layer; the batcher stamps queue/pack/dispatch/
+        #: device/land stages through it. None when recording is off.
+        self.tl = tl
 
     @property
     def n(self) -> int:
@@ -298,7 +303,10 @@ class CheckBatcher:
             deadline = t_deadline if deadline is None else min(deadline, t_deadline)
         if deadline is not None and time.monotonic() >= deadline:
             raise ErrDeadlineExceeded("deadline expired before the check was queued")
-        item = _Item(tuples, Future(), at_least, latest, deadline, lane)
+        item = _Item(
+            tuples, Future(), at_least, latest, deadline, lane,
+            tl=current_timeline(),
+        )
         self._enqueue(item)
         remaining = None
         if deadline is not None:
@@ -328,6 +336,8 @@ class CheckBatcher:
             if lane == BATCH and self.admission is not None:
                 self.admission.tick(backlog=self._lane_tuples[BATCH])
                 if self._lane_tuples[BATCH] + n > self.admission.window:
+                    if item.tl is not None:
+                        item.tl.stamp("shed", lane=lane, why="admission")
                     raise self._shed(
                         lane, True,
                         "batch lane over the admitted window (server near its "
@@ -341,6 +351,8 @@ class CheckBatcher:
                 # oversized chunk is still admitted into an EMPTY lane
                 # (the sub-slice split serves it in bounded rounds).
                 if self._lane_tuples[lane] + n > cap and self._lane_tuples[lane] > 0:
+                    if item.tl is not None:
+                        item.tl.stamp("shed", lane=lane, why="queue-full")
                     raise self._shed(
                         lane, False,
                         "check queue full (device backlogged); retry with backoff",
@@ -368,6 +380,8 @@ class CheckBatcher:
                         self._cond.wait(timeout=0.25)
             self._lanes[lane].append(item)
             self._lane_tuples[lane] += n
+            if item.tl is not None:
+                item.tl.stamp("admit", lane=lane)
             self._cond.notify_all()
         with self._inflight_lock:
             self._inflight += 1
@@ -455,6 +469,8 @@ class CheckBatcher:
             item.results[idx] = allowed
             item.remaining -= 1
         if item.remaining == 0 and not item.fut.done():
+            if item.tl is not None:
+                item.tl.stamp("land")  # every tuple has its decision
             try:
                 item.fut.set_result((item.results, token))
             except InvalidStateError:
@@ -482,7 +498,12 @@ class CheckBatcher:
         each caller's future resolves the moment ITS slice lands (the
         ``ordered=False`` fast path — re-association is by query offset),
         so early-finishing slices don't wait behind stragglers, and the
-        interactive tuples at the head of the round land first."""
+        interactive tuples at the head of the round land first.
+
+        Engines advertising ``STREAM_INFO`` additionally yield a
+        per-slice info record (width / BFS steps / label-vs-BFS route /
+        halo rounds+bytes / service time), which is stamped onto every
+        rider's request timeline as its ``device`` stage."""
         emitted: list = []  # stream offset -> (item, idx), built at pull time
 
         def live_tuples():
@@ -492,15 +513,34 @@ class CheckBatcher:
                 if item.deadline is not None and time.monotonic() >= item.deadline:
                     self._expire(item)
                     continue
+                if item.tl is not None:
+                    item.tl.stamp("dispatch")
                 for idx in range(start, start + count):
                     emitted.append((item, idx))
                     yield item.tuples[idx]
 
+        want_info = bool(getattr(self._engine, "STREAM_INFO", False))
+        kw = self._consistency_kw(at_leasts, latests)
+        if want_info:
+            kw["with_info"] = True
         gen, token = self._engine.batch_check_stream_with_token(
-            live_tuples(), ordered=False,
-            **self._consistency_kw(at_leasts, latests),
+            live_tuples(), ordered=False, **kw
         )
-        for off, out in gen:
+        for rec in gen:
+            if want_info:
+                off, out, info = rec
+                # stamp the slice's route/cost onto every distinct rider
+                # BEFORE filling results, so the device stage precedes
+                # land in each timeline (items are contiguous per slice —
+                # dedup against the previous one suffices)
+                prev = None
+                for j in range(len(out)):
+                    item = emitted[off + j][0]
+                    if item is not prev and item.tl is not None:
+                        item.tl.stamp("device", **info)
+                    prev = item
+            else:
+                off, out = rec
             for j, allowed in enumerate(out.tolist()):
                 item, idx = emitted[off + j]
                 self._fill(item, idx, bool(allowed), token)
@@ -530,6 +570,8 @@ class CheckBatcher:
                 continue  # expired/failed while queued
             segments.append((item, 0, item.n))
             item.taken = item.n
+            if item.tl is not None:
+                item.tl.stamp("pack")  # queue wait ended here
             n += item.n
         batch_cap = min(cap - n, self._sub_slice)
         while batchq and batch_cap > 0:
@@ -540,6 +582,8 @@ class CheckBatcher:
                 continue
             take = min(batch_cap, head.n - head.taken)
             segments.append((head, head.taken, take))
+            if head.tl is not None and head.taken == 0:
+                head.tl.stamp("pack")  # first sub-slice: queue wait ended
             head.taken += take
             self._lane_tuples[BATCH] -= take
             batch_cap -= take

@@ -91,6 +91,12 @@ class Daemon:
         # very first /health/ready or grpc.health.v1 Watch reads a live
         # state instead of constructing the monitor mid-request
         self.registry.health_monitor()
+        # prime the observability companions the scrape-time bridges
+        # peek at (timeline recorder, SLO engine), and attach the flight
+        # recorder's anomaly triggers now that the components exist
+        self.registry.timeline_recorder()
+        self.registry.slo_engine()
+        self.registry.wire_flight_recorder()
         rep = self.registry.replica_controller()
         if rep is not None:
             # replica mode: the controller's supervised feed bootstraps
@@ -156,6 +162,11 @@ class Daemon:
             return
         self._stop_requested.set()
         drain_s = float(self.registry.config().get("serve.drain_timeout_s", 5.0))
+        # flight-recorder drain bundle FIRST, while the state it freezes
+        # (queues, timelines, health) still describes live serving
+        fr = self.registry.flight_recorder()
+        if fr is not None:
+            fr.trigger("drain", "SIGTERM/SIGINT drain requested")
         try:
             from keto_tpu.driver.health import HealthState
 

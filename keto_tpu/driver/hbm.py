@@ -433,11 +433,21 @@ class HbmGovernor:
 
     # -- OOM accounting ------------------------------------------------------
 
+    #: optional anomaly hook (the flight recorder's OOM trigger seam);
+    #: invoked outside the governor lock, exceptions contained
+    on_oom: Optional[Callable[[str], None]] = None
+
     def note_oom(self, what: str = "") -> None:
         with self._lock:
             self.oom_events += 1
         self._incr("oom_events")
         _log.warning("device RESOURCE_EXHAUSTED at %s", what or "unknown site")
+        cb = self.on_oom
+        if cb is not None:
+            try:
+                cb(what)
+            except Exception:
+                _log.warning("on_oom hook failed", exc_info=True)
 
     def note_oom_recovered(self) -> None:
         with self._lock:

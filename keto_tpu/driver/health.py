@@ -47,6 +47,7 @@ import enum
 import logging
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
 _log = logging.getLogger("keto_tpu.health")
@@ -87,11 +88,17 @@ class HealthMonitor:
         self._engine = engine
         self._replica = replica
         self._budget = float(staleness_budget_s)
-        self._lock = threading.Lock()  # guards: _last_state, _last_reason, _override, _transitions
+        self._lock = threading.Lock()  # guards: _last_state, _last_reason, _override, _transitions, transitions_log
         self._last_state: Optional[HealthState] = None
         self._last_reason = ""
         self._override: Optional[tuple[HealthState, str]] = None
         self._transitions = 0
+        #: recent transitions [(unix, from, to, reason)] — the flight
+        #: recorder's health-history section
+        self.transitions_log: deque[dict] = deque(maxlen=64)
+        # transition listeners (the flight recorder's trigger seam);
+        # invoked OUTSIDE the monitor lock, exceptions contained
+        self._listeners: list[Callable[[HealthState, str], None]] = []
 
     @property
     def staleness_budget_s(self) -> float:
@@ -105,9 +112,17 @@ class HealthMonitor:
 
     # -- the state machine ---------------------------------------------------
 
+    def add_listener(self, fn: Callable[[HealthState, str], None]) -> None:
+        """Call ``fn(state, reason)`` on every state transition (the
+        flight recorder hooks anomaly dumps here). Listeners run outside
+        the monitor lock; exceptions are contained and logged."""
+        with self._lock:
+            self._listeners.append(fn)
+
     def status(self) -> tuple[HealthState, str]:
         """Current ``(state, reason)``; reason is "" while SERVING."""
         state, reason = self._compute()
+        transitioned = False
         with self._lock:
             if state != self._last_state:
                 if self._last_state is not None:
@@ -116,10 +131,30 @@ class HealthMonitor:
                         self._last_state.value, state.value,
                         f" ({reason})" if reason else "",
                     )
+                self.transitions_log.append(
+                    {
+                        "unix": round(time.time(), 3),
+                        "from": (
+                            self._last_state.value
+                            if self._last_state is not None else None
+                        ),
+                        "to": state.value,
+                        "reason": reason,
+                    }
+                )
+                transitioned = self._last_state is not None
                 self._transitions += 1
                 self._last_state = state
                 self._record(state)
             self._last_reason = reason
+            listeners = list(self._listeners) if transitioned else []
+        for fn in listeners:
+            try:
+                fn(state, reason)
+            except Exception:
+                _log.warning(
+                    "health transition listener failed", exc_info=True
+                )
         return state, reason
 
     def ready(self) -> bool:
@@ -271,6 +306,7 @@ class HealthMonitor:
             "reason": reason,
             "staleness_budget_s": self._budget,
             "transitions": self._transitions,
+            "transitions_log": list(self.transitions_log),
         }
         eng = self._engine
         if eng is not None and hasattr(eng, "health"):

@@ -148,6 +148,19 @@ class Registry:
                 self.relation_tuple_manager(),
                 self.permission_engine,
                 str(self._config.get("serve.primary_url", "")),
+                # replication-aware tracing: applies join the writer's
+                # trace, and the commit→visible delay histogram carries
+                # the writer's trace id as its exemplar
+                tracer=self.tracer(),
+                apply_delay_histogram=self.metrics().histogram(
+                    "keto_replication_apply_delay_seconds",
+                    "Replica mode: wall time from the primary's commit to "
+                    "the change being visible through this replica's 412 "
+                    "gate (cross-clock; slowest sample carries the "
+                    "writer's trace_id exemplar).",
+                    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                             0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
+                ),
                 replica_dir=str(self._config.get("serve.replica_dir", "") or ""),
                 snapshot_cache_dir=str(
                     self._config.get("serve.snapshot_cache_dir", "") or ""
@@ -419,6 +432,178 @@ class Registry:
             ),
         )
 
+    # -- request timelines / flight recorder / SLOs ---------------------------
+
+    def timeline_recorder(self):
+        """The per-request timeline recorder (keto_tpu/x/timeline.py):
+        REST/gRPC begin+finish timelines around every non-health
+        request, the batcher/engine stamp stages through the context
+        variable, ``GET /debug/requests`` reads the ring. Disabled
+        (``serve.timeline_enabled: false``) it hands out None timelines
+        and every stamp site short-circuits."""
+
+        def build():
+            from keto_tpu.x.timeline import TimelineRecorder
+
+            rec = TimelineRecorder(
+                capacity=int(self._config.get("serve.timeline_ring", 512)),
+                enabled=bool(self._config.get("serve.timeline_enabled", True)),
+            )
+            rec.set_tracer(self.tracer())
+            rec.attach_stage_histogram(
+                self.metrics().histogram(
+                    "keto_timeline_stage_duration_seconds",
+                    "Per-request time attributed to each pipeline stage "
+                    "(admit/pack/dispatch/device/land/deliver, from the "
+                    "request timelines); slowest sample per stage carries "
+                    "a trace_id exemplar.",
+                    ("stage",),
+                )
+            )
+            return rec
+
+        return self._memo("timeline", build)
+
+    def slo_engine(self):
+        """The SLO engine (keto_tpu/x/slo.py): availability + latency
+        burn rates over the live request counters, multi-window, served
+        at ``GET /slo`` and scraped as ``keto_slo_*``."""
+
+        def build():
+            from keto_tpu.x.slo import SloEngine
+
+            return SloEngine(
+                self.metrics(),
+                availability_objective=float(
+                    self._config.get("serve.slo_availability_objective", 0.999)
+                ),
+                latency_objective_ms=float(
+                    self._config.get("serve.slo_latency_objective_ms", 250.0)
+                ),
+                latency_objective_ratio=float(
+                    self._config.get("serve.slo_latency_objective_ratio", 0.99)
+                ),
+            )
+
+        return self._memo("slo", build)
+
+    def flight_recorder(self):
+        """The anomaly flight recorder (keto_tpu/x/flightrec.py), or
+        None without ``serve.debug_bundle_dir``. ``wire_flight_recorder``
+        attaches its triggers to the live components."""
+        bundle_dir = str(self._config.get("serve.debug_bundle_dir", "") or "")
+        if not bundle_dir:
+            return None
+
+        def build():
+            from keto_tpu.x.flightrec import FlightRecorder
+
+            return FlightRecorder(
+                bundle_dir,
+                collect=self._flightrec_collect,
+                max_bundles=int(self._config.get("serve.debug_bundle_max", 8)),
+                min_interval_s=float(
+                    self._config.get("serve.debug_bundle_min_interval_s", 30.0)
+                ),
+                max_bytes=int(
+                    self._config.get("serve.debug_bundle_max_bytes", 4 << 20)
+                ),
+                version=VERSION,
+            )
+
+        return self._memo("flightrec", build)
+
+    def wire_flight_recorder(self) -> None:
+        """Attach the flight recorder's anomaly triggers: health
+        transitions into DEGRADED/NOT_SERVING (which also covers audit
+        mismatches — they surface as a DEGRADED transition), contained
+        device OOMs, and lock-watchdog trips. Called by the daemon after
+        the serving components exist; a no-op without a bundle dir."""
+        fr = self.flight_recorder()
+        if fr is None:
+            return
+        from keto_tpu.driver.health import HealthState
+
+        def on_transition(state, reason):
+            if state in (HealthState.DEGRADED, HealthState.NOT_SERVING):
+                fr.trigger(f"health-{state.value}", reason)
+
+        self.health_monitor().add_listener(on_transition)
+        gov = getattr(self.permission_engine(), "hbm", None)
+        if gov is not None:
+            # OOMs are detected MID-request: defer briefly so the
+            # triggering request's finished timeline is in the bundle
+            gov.on_oom = lambda what: fr.trigger("oom", what, defer_s=0.3)
+        from keto_tpu.x import lockwatch
+
+        if lockwatch.installed():
+            lockwatch.add_trip_listener(
+                lambda trip: fr.trigger("watchdog", str(trip.get("lock_site", "")))
+            )
+
+    def _flightrec_collect(self) -> dict:
+        """The flight recorder's bundle sections, every one gathered
+        under its own guard so a broken component cannot suppress the
+        evidence from the rest."""
+        sections: dict = {}
+
+        def sec(name, fn):
+            try:
+                sections[name] = fn()
+            except Exception as e:
+                sections[name] = {"error": repr(e)}
+
+        rec = self.peek("timeline")
+        if rec is not None:
+            sec("timelines", lambda: rec.snapshot(recent=100, slowest=20))
+        monitor = self.peek("health_monitor")
+        if monitor is not None:
+            sec("health", monitor.snapshot)
+        gov = getattr(self.peek("permission_engine"), "hbm", None)
+        if gov is not None:
+            sec("hbm", gov.snapshot)
+        batcher = self.peek("check_batcher")
+        if batcher is not None:
+            def batcher_state():
+                adm = batcher.admission
+                return {
+                    "queue_depth": batcher.queue_depth,
+                    "lane_depths": batcher.lane_depths,
+                    "inflight": batcher.inflight,
+                    "shed_count": batcher.shed_count,
+                    "shed_by_lane": dict(batcher.shed_by_lane),
+                    "admission_shed_count": batcher.admission_shed_count,
+                    "deadline_drop_count": batcher.deadline_drop_count,
+                    "admission": None if adm is None else {
+                        "window": getattr(adm, "window", None),
+                        "budget_ms": getattr(adm, "budget_ms", None),
+                        "last_p99_ms": getattr(adm, "last_p99_ms", None),
+                    },
+                }
+
+            sec("batcher", batcher_state)
+        m = self.peek("metrics")
+        if m is not None:
+            sec("metrics", m.render)
+        from keto_tpu.x import lockwatch
+
+        if lockwatch.installed():
+            sec("lockwatch", lockwatch.report)
+        hub = self.peek("watch_hub")
+        if hub is not None:
+            sec("watch", hub.snapshot)
+        rep = self.peek("replica")
+        if rep is not None:
+            sec("replica", rep.snapshot)
+        slo = self.peek("slo")
+        if slo is not None:
+            sec("slo", slo.to_json)
+        sections["config"] = {
+            "role": str(self._config.get("serve.role", "primary")),
+            "version": VERSION,
+        }
+        return sections
+
     # -- observability -------------------------------------------------------
 
     def metrics(self):
@@ -460,6 +645,27 @@ class Registry:
                 ("phase",),
                 buckets=(0.01, 0.05, 0.25, 1.0, 5.0, 15.0, 60.0,
                          300.0, 1200.0),
+            )
+            # request-timeline stage durations (the recorder attaches
+            # the same instrument in timeline_recorder()) and the
+            # replica-side replication delay — declared eagerly so every
+            # role's scrape exposes the documented family set
+            m.histogram(
+                "keto_timeline_stage_duration_seconds",
+                "Per-request time attributed to each pipeline stage "
+                "(admit/pack/dispatch/device/land/deliver, from the "
+                "request timelines); slowest sample per stage carries "
+                "a trace_id exemplar.",
+                ("stage",),
+            )
+            m.histogram(
+                "keto_replication_apply_delay_seconds",
+                "Replica mode: wall time from the primary's commit to "
+                "the change being visible through this replica's 412 "
+                "gate (cross-clock; slowest sample carries the "
+                "writer's trace_id exemplar).",
+                buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                         0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
             )
             # request families are declared eagerly (the serving layers
             # re-declare idempotently) so a scrape before first traffic
@@ -1016,6 +1222,102 @@ class Registry:
             "keto_health_transitions_total", "counter",
             "Health state transitions since boot.",
             health_transitions,
+        )
+
+        # request timelines (keto_tpu/x/timeline.py) + flight recorder
+        # (keto_tpu/x/flightrec.py) + SLO engine (keto_tpu/x/slo.py) —
+        # peek-only like every other bridge; the daemon primes the SLO
+        # engine at boot so scrapes see live burn rates
+        def timeline_finished():
+            rec = self.peek("timeline")
+            by = getattr(rec, "finished_by_surface", {}) if rec is not None else {}
+            return [
+                ((s,), float(by.get(s, 0))) for s in ("http", "grpc")
+            ] + [
+                ((s,), float(v)) for s, v in sorted(by.items())
+                if s not in ("http", "grpc")
+            ]
+
+        m.register_callback(
+            "keto_timeline_finished_total", "counter",
+            "Request timelines recorded (ring + top-K slowest, queryable "
+            "at GET /debug/requests), by serving surface.",
+            timeline_finished, ("surface",),
+        )
+
+        def flightrec_snapshot():
+            fr = self.peek("flightrec")
+            return fr.snapshot() if fr is not None else {}
+
+        def flightrec_bundles():
+            by = flightrec_snapshot().get("bundles_by_reason", {})
+            return [
+                ((r,), float(v)) for r, v in sorted(by.items())
+            ] or [(("none",), 0.0)]
+
+        m.register_callback(
+            "keto_flightrec_bundles_total", "counter",
+            "Flight-recorder debug bundles written to "
+            "serve.debug_bundle_dir, by trigger reason (health-degraded/"
+            "health-not_serving/oom/drain/watchdog).",
+            flightrec_bundles, ("reason",),
+        )
+
+        def flightrec_suppressed():
+            yield (), float(flightrec_snapshot().get("suppressed", 0) or 0)
+
+        m.register_callback(
+            "keto_flightrec_suppressed_total", "counter",
+            "Flight-recorder triggers refused by the rate limit "
+            "(serve.debug_bundle_min_interval_s) — a flapping anomaly "
+            "cannot fill the disk.",
+            flightrec_suppressed,
+        )
+
+        def slo_field(field):
+            def read():
+                slo = self.peek("slo")
+                return slo.metric_rows(field) if slo is not None else []
+
+            return read
+
+        m.register_callback(
+            "keto_slo_availability_ratio", "gauge",
+            "Fraction of REST+gRPC requests without a server-side "
+            "failure (5xx / INTERNAL-class codes) over each trailing "
+            "window; 1.0 on an idle window.",
+            slo_field("availability_ratio"), ("window",),
+        )
+        m.register_callback(
+            "keto_slo_availability_burn_rate", "gauge",
+            "Error-budget burn rate of the availability objective per "
+            "window: 1.0 spends the budget exactly at the objective "
+            "horizon, >1 is an alertable burn.",
+            slo_field("availability_burn_rate"), ("window",),
+        )
+        m.register_callback(
+            "keto_slo_latency_ratio", "gauge",
+            "Fraction of REST requests answered within the latency "
+            "objective threshold (bucket-quantized), per window.",
+            slo_field("latency_ratio"), ("window",),
+        )
+        m.register_callback(
+            "keto_slo_latency_burn_rate", "gauge",
+            "Error-budget burn rate of the latency objective per "
+            "window (same semantics as the availability burn rate).",
+            slo_field("latency_burn_rate"), ("window",),
+        )
+
+        def slo_objectives():
+            slo = self.peek("slo")
+            return slo.objective_rows() if slo is not None else []
+
+        m.register_callback(
+            "keto_slo_objective", "gauge",
+            "The configured objectives the burn rates are judged "
+            "against (availability ratio, latency good-ratio, latency "
+            "threshold seconds).",
+            slo_objectives, ("objective",),
         )
 
         def tracer_attr(attr):

@@ -33,7 +33,14 @@ SIGTERM drain so watch connections never hold the drain window open.
 from __future__ import annotations
 
 import threading
+import time
+from collections import OrderedDict
 from typing import Iterator, Optional
+
+#: commit-trace entries retained (token -> trace context); bounds the
+#: index under write-heavy tenants — a missing entry only omits the
+#: optional trace fields from the watch message, never an event
+COMMIT_TRACE_CAP = 4096
 
 
 class WatchHub:
@@ -44,11 +51,47 @@ class WatchHub:
         self._poll_s = max(0.005, float(poll_s))
         self.max_streams = int(max_streams)
         self._closed = threading.Event()
-        self._lock = threading.Lock()  # guards: active_streams
+        self._lock = threading.Lock()  # guards: active_streams, _commit_traces
         #: /metrics bridges read these (keto_watch_* families)
         self.active_streams = 0
         self.events_total = 0
         self.expired_total = 0
+        # REPLICATION-AWARE TRACING: the write path registers each
+        # commit's traceparent + wall-clock commit time here; the watch
+        # serializers attach them to the commit group's message so ONE
+        # trace spans primary transact -> watch emit -> replica apply ->
+        # 412-gate visibility. Process-local by design: commits from
+        # OTHER processes sharing the SQL store simply carry no trace.
+        self._commit_traces: OrderedDict[int, tuple[str, float]] = OrderedDict()
+
+    def note_commit_trace(self, token: int, traceparent: str = "") -> None:
+        """Record the trace context of the transaction committed at
+        ``token`` (called by the REST/gRPC write handlers inside their
+        server span; idempotent replays must NOT re-register)."""
+        with self._lock:
+            self._commit_traces[int(token)] = (traceparent, time.time())
+            while len(self._commit_traces) > COMMIT_TRACE_CAP:
+                self._commit_traces.popitem(last=False)
+
+    def commit_trace(self, token: int) -> Optional[tuple[str, float]]:
+        """``(traceparent, committed_unix)`` of a locally-registered
+        commit, or None (foreign/evicted commits)."""
+        with self._lock:
+            return self._commit_traces.get(int(token))
+
+    def enrich_group(self, token: int, msg: dict) -> dict:
+        """Attach the commit's trace fields to a serialized watch
+        message: ``traceparent``/``committed_at`` when known, plus
+        ``emitted_at`` — the replica tier's replication timeline feeds
+        on these (keto_tpu/replica/controller.py)."""
+        got = self.commit_trace(token)
+        if got is not None:
+            tp, committed = got
+            if tp:
+                msg["traceparent"] = tp
+            msg["committed_at"] = round(committed, 6)
+        msg["emitted_at"] = round(time.time(), 6)
+        return msg
 
     @property
     def closed(self) -> bool:

@@ -43,6 +43,7 @@ import re
 import tempfile
 import threading
 import time
+from collections import deque
 from pathlib import Path
 from typing import Callable, Optional
 
@@ -54,6 +55,8 @@ from keto_tpu.x.errors import (
     ErrWatchExpired,
 )
 from keto_tpu.x.supervise import SupervisedTask
+from keto_tpu.x.tracing import NOOP as NOOP_TRACER
+from keto_tpu.x.tracing import parse_traceparent
 
 _log = logging.getLogger("keto_tpu.replica")
 
@@ -128,6 +131,8 @@ class ReplicaController:
         checkcache_entries: int = 65536,
         client_factory: Optional[Callable[[], object]] = None,
         stats=None,
+        tracer=None,
+        apply_delay_histogram=None,
     ):
         if not primary_url:
             raise ValueError("serve.role=replica requires serve.primary_url")
@@ -161,6 +166,18 @@ class ReplicaController:
         self.apply_failures = 0
         #: primary watermark regressions observed across re-bootstraps
         self.watermark_regressions = 0
+        # REPLICATION-AWARE TRACING: each applied commit group's apply
+        # runs under a span joined to the WRITER's traceparent (carried
+        # on the watch message), so one trace spans primary transact →
+        # watch emit → replica apply → 412-gate visibility; the
+        # commit→apply delay feeds keto_replication_apply_delay_seconds
+        # with the writer's trace id as the exemplar.
+        self._tracer = tracer or NOOP_TRACER
+        self._delay_hist = apply_delay_histogram
+        #: per-commit replication timelines, newest last — the replica
+        #: half of GET /debug/requests (clock-skew caveat: committed_at/
+        #: emitted_at are the PRIMARY's wall clock)
+        self._replication_log: deque[dict] = deque(maxlen=256)
         self._feed = SupervisedTask("replica-feed", self._feed_pass, stats=stats)
         self._probe = SupervisedTask("replica-probe", self._probe_pass, stats=stats)
 
@@ -323,7 +340,10 @@ class ReplicaController:
             try:
                 for token, changes in client.watch(snaptoken=self.watermark):
                     reconnect_wait = 0.2
-                    self._apply_group(int(token), changes)
+                    self._apply_group(
+                        int(token), changes,
+                        meta=getattr(client, "last_commit_meta", None),
+                    )
                     if self._stop.is_set():
                         return
             except ErrWatchExpired:
@@ -348,36 +368,86 @@ class ReplicaController:
                 return
             reconnect_wait = min(2.0, reconnect_wait * 2)
 
-    def _apply_group(self, token: int, changes) -> None:
+    def _apply_group(self, token: int, changes, meta: Optional[dict] = None) -> None:
         insert = [rt for action, rt in changes if action == "insert"]
         delete = [rt for action, rt in changes if action != "insert"]
-        try:
-            applied = self._store.apply_commit(token, insert, delete)
-        except Exception:
-            # namespace-config drift between primary and replica is the
-            # only way a replayed commit can fail to apply; skipping the
-            # group (loudly) keeps the feed alive — retrying it forever
-            # would freeze the watermark and take the whole replica down
-            self.apply_failures += 1
-            self._incr("replica_apply_failures")
-            _log.error(
-                "failed to apply watch commit group at snaptoken %d; "
-                "skipping it (namespace config drift?)", token, exc_info=True,
-            )
-            return
-        if applied:
-            self.durable.store(token)
-            if self.checkcache is not None:
-                self.checkcache.note_commit(token)
-            with self._applied:
-                self._applied.notify_all()
-            # ride the engine's existing delta-overlay/compaction path
-            # eagerly so pinned reads above the old snapshot land fast
+        meta = meta or {}
+        remote = parse_traceparent(str(meta.get("traceparent", "") or ""))
+        t_recv = time.time()
+        # the apply span joins the WRITER's trace (carried on the watch
+        # message) and closes only after the watermark is raised and the
+        # 412 gate notified — its end IS the visibility point
+        with self._tracer.span(
+            "replica.apply", remote_parent=remote, snaptoken=token,
+            changes=len(changes),
+        ) as span:
             try:
-                self._engine().snapshot_serving()
+                applied = self._store.apply_commit(token, insert, delete)
             except Exception:
-                _log.debug("post-apply engine refresh failed", exc_info=True)
+                # namespace-config drift between primary and replica is the
+                # only way a replayed commit can fail to apply; skipping the
+                # group (loudly) keeps the feed alive — retrying it forever
+                # would freeze the watermark and take the whole replica down
+                self.apply_failures += 1
+                self._incr("replica_apply_failures")
+                _log.error(
+                    "failed to apply watch commit group at snaptoken %d; "
+                    "skipping it (namespace config drift?)", token, exc_info=True,
+                )
+                return
+            if span is not None:
+                span.tags["applied"] = applied
+            if applied:
+                self.durable.store(token)
+                if self.checkcache is not None:
+                    self.checkcache.note_commit(token)
+                with self._applied:
+                    self._applied.notify_all()
+                # ride the engine's existing delta-overlay/compaction path
+                # eagerly so pinned reads above the old snapshot land fast
+                try:
+                    self._engine().snapshot_serving()
+                except Exception:
+                    _log.debug("post-apply engine refresh failed", exc_info=True)
+        if applied:
+            self._note_replication(token, len(changes), meta, remote, t_recv)
         self._note_contact(token)
+
+    def _note_replication(
+        self, token: int, n_changes: int, meta: dict, remote, t_recv: float
+    ) -> None:
+        """Record one commit's replication timeline and feed the
+        commit→visible delay histogram (trace-id exemplar = the writer's
+        trace). ``committed_at``/``emitted_at`` come from the primary's
+        clock — delays are cross-clock and clamped at zero."""
+        now = time.time()
+        committed = meta.get("committed_at")
+        delay = None
+        if committed is not None:
+            try:
+                delay = max(0.0, now - float(committed))
+            except (TypeError, ValueError):
+                delay = None
+        entry = {
+            "snaptoken": token,
+            "changes": n_changes,
+            "trace_id": remote[0] if remote else "",
+            "committed_at": committed,
+            "emitted_at": meta.get("emitted_at"),
+            "received_at": round(t_recv, 6),
+            "visible_at": round(now, 6),
+            "commit_to_visible_s": round(delay, 6) if delay is not None else None,
+        }
+        self._replication_log.append(entry)
+        if self._delay_hist is not None and delay is not None:
+            self._delay_hist.observe(
+                (), delay, trace_id=remote[0] if remote else ""
+            )
+
+    def replication_timelines(self) -> list[dict]:
+        """Per-commit replication timelines, newest first (the replica
+        section of GET /debug/requests)."""
+        return list(reversed(self._replication_log))
 
     def _engine(self):
         return self._engine_source()

@@ -91,13 +91,39 @@ def _request_metrics(m):
     )
 
 
+class _TrailingMergeContext:
+    """A pass-through ServicerContext proxy that REMEMBERS the trailing
+    metadata the handler set, so the wrapper can append its
+    ``server-timing`` entry without clobbering it (gRPC's
+    ``set_trailing_metadata`` replaces wholesale)."""
+
+    __slots__ = ("_inner", "_trailing")
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._trailing: list = []
+
+    def set_trailing_metadata(self, md) -> None:
+        self._trailing = list(md or ())
+        self._inner.set_trailing_metadata(tuple(self._trailing))
+
+    def append_trailing(self, key: str, value: str) -> None:
+        self._trailing.append((key, value))
+        self._inner.set_trailing_metadata(tuple(self._trailing))
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 def _wrap(fn, registry=None, name: str = ""):
     """Translate KetoError into gRPC status codes; trace + count + time
     the call (the reference's otgrpc/grpc_logrus interceptor slot,
     registry_default.go:327-346). Inbound ``traceparent`` metadata joins
     the caller's trace; ``x-request-id`` is echoed (or minted) back as
     initial metadata and bound into the logging context — the gRPC face
-    of the REST correlation headers."""
+    of the REST correlation headers. Successful calls carry the request
+    timeline's stage breakdown as ``server-timing`` trailing metadata
+    (merged with whatever the handler set)."""
 
     def handler(request, context):
         if registry is None:
@@ -111,13 +137,19 @@ def _wrap(fn, registry=None, name: str = ""):
         req_id = (md.get("x-request-id") or "").strip() or uuid.uuid4().hex
         registry.telemetry().record(f"grpc {name}")
         counter, latency = _request_metrics(registry.metrics())
+        recorder = registry.timeline_recorder()
         code = "OK"
         trace_id = remote[0] if remote else ""
         t0 = time.perf_counter()
+        tl = None
+        context = _TrailingMergeContext(context)
         try:
             with registry.tracer().span(f"grpc.{name}", remote_parent=remote) as span:
                 if span is not None:
                     trace_id = span.trace_id
+                tl = recorder.begin(
+                    name, trace_id=trace_id, request_id=req_id, surface="grpc"
+                )
                 with request_context(request_id=req_id, trace_id=trace_id):
                     try:
                         context.send_initial_metadata((("x-request-id", req_id),))
@@ -128,7 +160,25 @@ def _wrap(fn, registry=None, name: str = ""):
                             exc_info=True,
                         )
                     try:
-                        return fn(request, context)
+                        with recorder.activate(tl):
+                            result = fn(request, context)
+                        recorder.finish(tl, status=code)
+                        tl_done, tl = tl, None
+                        if tl_done is not None:
+                            # the gRPC face of the Server-Timing header:
+                            # the stage breakdown rides trailing metadata
+                            # (merged — never clobbering the handler's)
+                            try:
+                                context.append_trailing(
+                                    "server-timing",
+                                    recorder.server_timing(tl_done),
+                                )
+                            except Exception:
+                                _log.debug(
+                                    "trailing metadata raced stream teardown",
+                                    exc_info=True,
+                                )
+                        return result
                     except KetoError as e:
                         code = _CODE_BY_NUM.get(
                             e.grpc_code, grpc.StatusCode.INTERNAL
@@ -140,6 +190,8 @@ def _wrap(fn, registry=None, name: str = ""):
                         code = "INTERNAL"
                         raise
         finally:
+            if tl is not None:  # error path: still recorded, no metadata
+                recorder.finish(tl, status=code)
             counter.inc((name, code))
             latency.observe((name,), time.perf_counter() - t0, trace_id=trace_id)
 
@@ -355,6 +407,18 @@ class WriteService:
             token = str(result.snaptoken)
             if result.replayed:
                 context.set_trailing_metadata((("keto-idempotent-replay", "true"),))
+            else:
+                # replication-aware tracing: the watch emission of this
+                # commit carries the writer's traceparent (rest.py's
+                # _note_commit, gRPC face)
+                from keto_tpu.x.tracing import current_traceparent
+
+                try:
+                    self.registry.watch_hub().note_commit_trace(
+                        int(result.snaptoken), current_traceparent()
+                    )
+                except Exception:
+                    _log.debug("commit-trace registration failed", exc_info=True)
         else:  # legacy manager without a transact result
             token = str(manager.watermark())
         return write_service_pb2.TransactRelationTuplesResponse(
@@ -546,13 +610,16 @@ class WatchService:
         for token, changes in hub.subscribe(since):
             if not context.is_active():
                 return
-            yield {
-                "snaptoken": str(token),
-                "changes": [
-                    {"action": action, "relation_tuple": rt.to_json()}
-                    for action, rt in changes
-                ],
-            }
+            yield hub.enrich_group(
+                token,
+                {
+                    "snaptoken": str(token),
+                    "changes": [
+                        {"action": action, "relation_tuple": rt.to_json()}
+                        for action, rt in changes
+                    ],
+                },
+            )
 
     def register(self, server):
         server.add_generic_rpc_handlers(

@@ -78,7 +78,12 @@ from keto_tpu.x.errors import ErrBadRequest, ErrNilSubject, KetoError
 from keto_tpu.x.logging import request_context
 from keto_tpu.x.metrics import normalize_route
 from keto_tpu.x.pagination import with_size, with_token
-from keto_tpu.x.tracing import parse_traceparent
+from keto_tpu.x.tracing import current_traceparent, parse_traceparent
+
+#: routes whose handling is excluded from request-timeline recording —
+#: scrapes and the debug surfaces themselves would otherwise churn the
+#: ring the operator is trying to read
+_TIMELINE_EXCLUDED = frozenset({"/metrics", "/debug/requests", "/slo"})
 
 READ = "read"
 WRITE = "write"
@@ -176,6 +181,7 @@ class RestApp:
         req_id = (hdrs.get("x-request-id") or "").strip() or uuid.uuid4().hex
         remote = parse_traceparent(hdrs.get("traceparent", ""))
         self.registry.telemetry().record(f"{self.role} {method} {route}")
+        recorder = self.registry.timeline_recorder()
         t0 = time.perf_counter()
         with self.registry.tracer().span(
             f"http.{method} {route}", remote_parent=remote, role=self.role
@@ -183,10 +189,21 @@ class RestApp:
             trace_id = (
                 span.trace_id if span is not None else (remote[0] if remote else "")
             )
-            with request_context(request_id=req_id, trace_id=trace_id):
-                status, payload, resp_headers = self._route(
-                    method, path, query, body, headers
+            # the request timeline is born INSIDE the server span so the
+            # stage spans it emits at finish parent under it
+            tl = (
+                None
+                if path in _TIMELINE_EXCLUDED
+                else recorder.begin(
+                    f"{method} {route}", trace_id=trace_id,
+                    request_id=req_id, surface="http",
                 )
+            )
+            with request_context(request_id=req_id, trace_id=trace_id):
+                with recorder.activate(tl):
+                    status, payload, resp_headers = self._route(
+                        method, path, query, body, headers
+                    )
                 if span is not None:
                     span.tags["status"] = status
                     span.tags["request_id"] = req_id
@@ -199,6 +216,18 @@ class RestApp:
         self._req_latency.observe((self.role, method, route), dur_s, trace_id=trace_id)
         resp_headers = dict(resp_headers)
         resp_headers.setdefault("X-Request-Id", req_id)
+        if tl is not None:
+            recorder.finish(
+                tl, status=status,
+                snaptoken=resp_headers.get("X-Keto-Snaptoken"),
+            )
+            # the caller-visible stage breakdown (W3C Server-Timing);
+            # streaming responses (watch) carry no timing — the exchange
+            # has no end
+            if not isinstance(payload, StreamBody):
+                resp_headers.setdefault(
+                    "Server-Timing", recorder.server_timing(tl)
+                )
         return status, payload, resp_headers
 
     def note_listener_shed(self, method: str, path: str) -> None:
@@ -225,6 +254,10 @@ class RestApp:
                 return 200, {"version": self.registry.version()}, {}
             if route == ("GET", "/metrics"):
                 return self._get_metrics(headers)
+            if route == ("GET", "/debug/requests"):
+                return self._get_debug_requests(query)
+            if route == ("GET", "/slo"):
+                return self._get_slo()
 
             if self.role == READ:
                 if route == ("GET", "/check"):
@@ -290,6 +323,40 @@ class RestApp:
             else "text/plain; version=0.0.4; charset=utf-8"
         )
         return 200, RawBody(m.render(openmetrics=openmetrics).encode(), content_type), {}
+
+    @staticmethod
+    def _int_param(query, key: str, default: int) -> int:
+        raw = (query.get(key) or [""])[0]
+        if not raw:
+            return default
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            raise ErrBadRequest(f"invalid {key} {raw!r}") from None
+
+    def _get_debug_requests(self, query):
+        """``GET /debug/requests`` — recent + top-K-slowest request
+        timelines from the bounded ring (keto_tpu/x/timeline.py),
+        filterable by ``?trace_id=`` and ``?snaptoken=``; ``?n=`` /
+        ``?slowest=`` bound the result sizes. On a replica the body also
+        carries the per-commit replication timelines."""
+        rec = self.registry.timeline_recorder()
+        body = rec.snapshot(
+            recent=self._int_param(query, "n", 50),
+            slowest=self._int_param(query, "slowest", 20),
+            trace_id=(query.get("trace_id") or [""])[0] or None,
+            snaptoken=(query.get("snaptoken") or [""])[0] or None,
+        )
+        rep = self.registry.replica_controller()
+        if rep is not None:
+            body["replication"] = rep.replication_timelines()
+        return 200, body, {}
+
+    def _get_slo(self):
+        """``GET /slo`` — the SLO engine's multi-window availability and
+        latency burn-rate report (keto_tpu/x/slo.py); the same numbers
+        the ``keto_slo_*`` families expose at scrape time."""
+        return 200, self.registry.slo_engine().to_json(), {}
 
     # -- snapshot export (replica bootstrap source) ---------------------------
 
@@ -483,6 +550,11 @@ class RestApp:
                 got = cache.get(key, at_least)
                 if got is not None:
                     allowed, token = got
+                    from keto_tpu.x.timeline import current_timeline
+
+                    tl = current_timeline()
+                    if tl is not None:
+                        tl.stamp("cache_hit")
                     return (
                         (200 if allowed else 403),
                         {"allowed": allowed},
@@ -699,13 +771,16 @@ class RestApp:
         def gen():
             try:
                 for token, changes in hub.subscribe(since, own_slot=False):
-                    msg = {
-                        "snaptoken": str(token),
-                        "changes": [
-                            {"action": action, "relation_tuple": rt.to_json()}
-                            for action, rt in changes
-                        ],
-                    }
+                    msg = hub.enrich_group(
+                        token,
+                        {
+                            "snaptoken": str(token),
+                            "changes": [
+                                {"action": action, "relation_tuple": rt.to_json()}
+                                for action, rt in changes
+                            ],
+                        },
+                    )
                     yield (json.dumps(msg) + "\n").encode()
             finally:
                 hub.release_stream()
@@ -722,6 +797,25 @@ class RestApp:
         if not headers:
             return None
         return headers.get("x-idempotency-key") or None
+
+    def _note_commit(self, result) -> None:
+        """Register the committed transaction's trace context with the
+        watch hub (replication-aware tracing): the commit group emitted
+        at this snaptoken will carry the writer's traceparent, so one
+        trace spans primary transact → watch emit → replica apply.
+        Idempotent replays re-answer an OLD commit — never re-register."""
+        if result is None or getattr(result, "replayed", False):
+            return
+        token = getattr(result, "snaptoken", None)
+        if token is None:
+            return
+        try:
+            self.registry.watch_hub().note_commit_trace(
+                int(token), current_traceparent()
+            )
+        except Exception:
+            # tracing enrichment must never fail a write
+            self._log.debug("commit-trace registration failed", exc_info=True)
 
     @staticmethod
     def _write_headers(result) -> dict[str, str]:
@@ -745,6 +839,7 @@ class RestApp:
         result = self.registry.relation_tuple_manager().transact_relation_tuples(
             [rel], (), idempotency_key=self._idempotency_key_from(headers)
         )
+        self._note_commit(result)
         resp = {"Location": "/relation-tuples?" + rel.to_url_query()}
         resp.update(self._write_headers(result))
         return 201, rel.to_json(), resp
@@ -754,6 +849,7 @@ class RestApp:
         result = self.registry.relation_tuple_manager().transact_relation_tuples(
             (), [rel], idempotency_key=self._idempotency_key_from(headers)
         )
+        self._note_commit(result)
         return 204, None, self._write_headers(result)
 
     def _patch_relation_tuples(self, body: bytes, headers=None):
@@ -778,6 +874,7 @@ class RestApp:
         result = self.registry.relation_tuple_manager().transact_relation_tuples(
             insert, delete, idempotency_key=self._idempotency_key_from(headers)
         )
+        self._note_commit(result)
         return 204, None, self._write_headers(result)
 
 

@@ -66,6 +66,9 @@ _g_max_hold_s: dict[str, float] = {}
 _g_contended_acquires = 0
 _g_acquires = 0
 _g_watchdog_trips: list[dict[str, Any]] = []
+#: watchdog-trip listeners (flight recorder); called per NEW trip with
+#: the trip dict, outside any repo lock (only _state_lock is released)
+_g_trip_listeners: list[Any] = []
 #: thread ident -> (site, started_monotonic) while blocked acquiring
 _g_waiting: dict[int, tuple[str, float]] = {}
 
@@ -302,12 +305,19 @@ def _watchdog_scan(
         }
         with _state_lock:
             _g_watchdog_trips.append(trip)
+            listeners = list(_g_trip_listeners)
         print(
             f"lockwatch WATCHDOG: thread {trip['thread']} blocked "
             f"{waited:.1f}s acquiring lock from {site}; all stacks follow",
             file=sys.stderr,
         )
         faulthandler.dump_traceback(file=sys.stderr)
+        # anomaly hooks (the flight recorder dumps a bundle at the trip)
+        for fn in listeners:
+            try:
+                fn(trip)
+            except Exception:
+                print("lockwatch trip listener failed", file=sys.stderr)
     return len(stuck)
 
 
@@ -326,6 +336,13 @@ def _watchdog_loop() -> None:
 
 def installed() -> bool:
     return _installed
+
+
+def add_trip_listener(fn) -> None:
+    """Call ``fn(trip_dict)`` on every NEW watchdog trip — the flight
+    recorder's anomaly hook. Exceptions are contained."""
+    with _state_lock:
+        _g_trip_listeners.append(fn)
 
 
 def install() -> None:

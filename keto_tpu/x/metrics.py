@@ -68,6 +68,8 @@ KNOWN_ROUTES = frozenset(
         "/watch",
         "/version",
         "/metrics",
+        "/debug/requests",
+        "/slo",
         "/health/alive",
         "/health/ready",
     }
@@ -374,6 +376,14 @@ class MetricsRegistry:
         with self._lock:
             return sorted(self._families)
 
+    def family(self, name: str):
+        """The live family object for ``name`` (or None) — the SLO
+        engine reads request counters/histograms through this instead of
+        parsing a rendered exposition, so sampling inside a scrape-time
+        callback can never recurse into ``render``."""
+        with self._lock:
+            return self._families.get(name)
+
 
 class _NullInstrument:
     """Accepts every record call and does nothing — what instruments
@@ -416,6 +426,9 @@ class NullMetricsRegistry:
 
     def family_names(self) -> list[str]:
         return []
+
+    def family(self, name: str):
+        return None
 
 
 # -- strict exposition parser (lint + conformance seam) ------------------------

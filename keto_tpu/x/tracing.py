@@ -75,6 +75,16 @@ def current_traceparent() -> str:
     return format_traceparent(s.trace_id, s.span_id)
 
 
+def current_span_ids() -> Optional[tuple[str, str]]:
+    """``(trace_id, span_id)`` of the active span, or None — the
+    timeline recorder captures these at request arrival so the child
+    spans it emits at finish parent under the request's server span."""
+    s = _current_span.get()
+    if s is None:
+        return None
+    return s.trace_id, s.span_id
+
+
 @dataclass
 class Span:
     name: str
@@ -301,6 +311,35 @@ class Tracer:
             s.end = time.perf_counter()
             _current_span.reset(token)
             self._export(s)
+
+    def emit(
+        self,
+        name: str,
+        trace_id: str,
+        parent_id: Optional[str] = None,
+        start_unix_ns: int = 0,
+        duration_s: float = 0.0,
+        **tags,
+    ) -> None:
+        """Export an explicitly-timed, already-finished span (the
+        timeline recorder's post-hoc stage spans): no context-variable
+        nesting, the caller supplies trace/parent ids and wall-clock
+        timing. No-op while disabled."""
+        if not self.enabled:
+            return
+        s = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=uuid.uuid4().hex[:16],
+            # "" (not None) when the caller knows no parent: these are
+            # always INTERNAL stage spans, never request entry points
+            parent_id=parent_id or "",
+            start=0.0,
+            start_unix_ns=int(start_unix_ns) or time.time_ns(),
+            end=max(0.0, float(duration_s)),
+            tags=dict(tags),
+        )
+        self._export(s)
 
     def _export(self, s: Span) -> None:
         if self.provider == "log" and self._logger is not None:

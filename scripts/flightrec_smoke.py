@@ -1,0 +1,220 @@
+"""flightrec-smoke: the flight recorder proven against a real daemon.
+
+Boots tests/chaos_runner.py as a subprocess with a debug-bundle dir and
+a device-alloc OOM armed AFTER the first snapshot (so the boot path
+cannot consume it), then verifies the whole contract:
+
+1. a check driven into the armed fault is CONTAINED (the caller still
+   gets its answer) and produces EXACTLY ONE bundle with reason ``oom``
+   — schema-valid (keto_tpu/x/flightrec.validate_bundle), loadable
+   JSON, and containing the triggering request's own timeline (matched
+   by the X-Request-Id the smoke sent);
+2. a SIGTERM drain produces exactly one more bundle with reason
+   ``drain``, carrying the session's timelines and the health history,
+   and the daemon exits 0 through the drain path;
+3. with KETO_TPU_SANITIZE=1 the whole run is sanitizer-clean (zero
+   lock-order inversions, zero watchdog trips in the exit report).
+
+Run: ``python scripts/flightrec_smoke.py`` (CPU; CI runs it with the
+sanitizer on).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+from keto_tpu.x.flightrec import list_bundles, validate_bundle  # noqa: E402
+
+OOM_REQUEST_ID = "flightrec-smoke-oom-1"
+
+
+def fail(msg: str) -> None:
+    print(f"flightrec-smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def wait_for(predicate, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = predicate()
+        if got:
+            return got
+        time.sleep(0.1)
+    fail(f"timed out waiting for {what}")
+
+
+def read_ports(port_file: Path) -> dict:
+    return wait_for(
+        lambda: json.loads(port_file.read_text()) if port_file.exists() else None,
+        60.0, "daemon port publish",
+    )
+
+
+def get(url: str, headers: dict | None = None, timeout: float = 30.0):
+    req = urllib.request.Request(url)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def load_bundles(bundle_dir: Path) -> list[dict]:
+    out = []
+    for path in list_bundles(bundle_dir):
+        try:
+            bundle = json.loads(path.read_text())
+        except ValueError as e:
+            fail(f"bundle {path.name} is not loadable JSON: {e}")
+        problems = validate_bundle(bundle)
+        if problems:
+            fail(f"bundle {path.name} invalid: {problems}")
+        out.append(bundle)
+    return out
+
+
+def timeline_request_ids(bundle: dict) -> set[str]:
+    tls = bundle.get("sections", {}).get("timelines", {})
+    return {
+        t.get("request_id", "")
+        for key in ("recent", "slowest")
+        for t in tls.get(key, [])
+    }
+
+
+def main() -> int:
+    tmp = Path(tempfile.mkdtemp(prefix="flightrec-smoke-"))
+    bundle_dir = tmp / "bundles"
+    port_file = tmp / "ports.json"
+    armed_file = tmp / "armed"
+    sanitize_report = tmp / "lockwatch.json"
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if env.get("KETO_TPU_SANITIZE") == "1":
+        env.setdefault("KETO_TPU_SANITIZE_REPORT", str(sanitize_report))
+    proc = subprocess.Popen(
+        [
+            sys.executable, str(ROOT / "tests" / "chaos_runner.py"),
+            "--dsn", "memory",
+            "--cache-dir", str(tmp / "cache"),
+            "--port-file", str(port_file),
+            "--debug-bundle-dir", str(bundle_dir),
+            "--bundle-min-interval-s", "0.5",
+            "--arm-after-ready", "device-alloc:oom:1",
+            "--armed-file", str(armed_file),
+        ],
+        env=env,
+    )
+    try:
+        ports = read_ports(port_file)
+        read, write = ports["read"], ports["write"]
+        # seed one tuple and settle the serving snapshot BEFORE arming
+        put = json.dumps(
+            {"namespace": "docs", "object": "o", "relation": "r",
+             "subject_id": "u"}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{write}/relation-tuples", data=put,
+            method="PUT", headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=30)
+        status, _ = get(
+            f"http://127.0.0.1:{read}/check?namespace=docs&object=o"
+            f"&relation=r&subject_id=u",
+            headers={"X-Request-Id": "flightrec-smoke-warm"},
+        )
+        if status != 200:
+            fail(f"warm check answered {status}")
+        wait_for(armed_file.exists, 60.0, "fault arming")
+        # the armed check: the device-alloc OOM fires inside ITS serving
+        # path, is contained (the answer still arrives), and the
+        # deferred oom bundle freezes this request's timeline
+        status, _ = get(
+            f"http://127.0.0.1:{read}/check?namespace=docs&object=o"
+            f"&relation=r&subject_id=u",
+            headers={"X-Request-Id": OOM_REQUEST_ID},
+        )
+        if status != 200:
+            fail(f"armed check answered {status} — OOM not contained")
+        wait_for(lambda: len(list_bundles(bundle_dir)) >= 1, 30.0, "oom bundle")
+        bundles = load_bundles(bundle_dir)
+        oom = [b for b in bundles if b["reason"] == "oom"]
+        if len(oom) != 1 or len(bundles) != 1:
+            fail(
+                f"expected exactly one oom bundle, got "
+                f"{[b['reason'] for b in bundles]}"
+            )
+        if OOM_REQUEST_ID not in timeline_request_ids(oom[0]):
+            fail(
+                "oom bundle does not contain the triggering request's "
+                f"timeline (want request_id={OOM_REQUEST_ID}, have "
+                f"{sorted(timeline_request_ids(oom[0]))[:10]})"
+            )
+        hbm = oom[0]["sections"].get("hbm", {})
+        if int(hbm.get("oom_events", 0)) < 1:
+            fail(f"oom bundle's hbm section records no oom_events: {hbm}")
+        # a later check still answers (recovered service)
+        status, _ = get(
+            f"http://127.0.0.1:{read}/check?namespace=docs&object=o"
+            f"&relation=r&subject_id=u"
+        )
+        if status != 200:
+            fail(f"post-oom check answered {status}")
+        time.sleep(0.6)  # clear the bundle rate-limit window
+        # SIGTERM: the drain path dumps exactly one more bundle
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        if rc != 0:
+            fail(f"daemon exited {rc} (want 0 via the drain path)")
+        bundles = load_bundles(bundle_dir)
+        reasons = sorted(b["reason"] for b in bundles)
+        if reasons != ["drain", "oom"]:
+            fail(f"expected one oom + one drain bundle, got {reasons}")
+        drain = next(b for b in bundles if b["reason"] == "drain")
+        ids = timeline_request_ids(drain)
+        if OOM_REQUEST_ID not in ids:
+            fail(
+                "drain bundle lost the session's timelines "
+                f"(have request ids {sorted(ids)[:10]})"
+            )
+        if "health" not in drain["sections"]:
+            fail("drain bundle missing the health section")
+        if env.get("KETO_TPU_SANITIZE") == "1":
+            report = wait_for(
+                lambda: (
+                    json.loads(sanitize_report.read_text())
+                    if sanitize_report.exists()
+                    else None
+                ),
+                30.0, "sanitizer report",
+            )
+            if report.get("inversions") or report.get("watchdog_trips"):
+                fail(
+                    f"sanitizer not clean: inversions="
+                    f"{report.get('inversions')} trips="
+                    f"{report.get('watchdog_trips')}"
+                )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    print(
+        "flightrec-smoke OK: injected OOM and SIGTERM drain each produced "
+        "exactly one schema-valid bundle; the oom bundle carries the "
+        "triggering request's timeline; daemon drained exit 0"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

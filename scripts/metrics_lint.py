@@ -1,16 +1,24 @@
-"""metrics-lint: scrape a live daemon and fail on convention violations.
+"""metrics-lint: scrape live daemons and fail on convention violations.
 
 The CI seam keeping /metrics and its documentation honest:
 
-1. boots a real daemon (memory store), drives one request through every
-   signal path (check allowed/denied, a write, a gRPC check, a scrape);
-2. scrapes ``GET /metrics`` and strict-parses every line
-   (keto_tpu/x/metrics.parse_exposition): name/label/escaping
+1. boots a real PRIMARY daemon (memory store) on a sharded 2-device
+   virtual mesh (labels disabled so checks ride the halo-exchanging BFS
+   kernel), plus a REPLICA daemon feeding off its /snapshot/export +
+   /watch — the two roles whose family sets used to go unlinted;
+2. drives one request through every signal path (check allowed/denied
+   through the sharded kernel, a write, a gRPC check, a batch check,
+   the SLO and debug-requests endpoints, a replica-pinned read);
+3. scrapes ``GET /metrics`` on BOTH daemons and strict-parses every
+   line (keto_tpu/x/metrics.parse_exposition): name/label/escaping
    conventions, counters ending ``_total``, histogram bucket
    monotonicity, ``_count``/``_sum`` consistency;
-3. cross-checks the scrape against the family table in
+4. cross-checks each scrape against the family table in
    docs/concepts/observability.md — a family exposed but undocumented,
-   or documented but missing from the scrape, fails the build.
+   or documented but missing from the scrape, fails the build;
+5. asserts the replication / sharding / SLO / timeline families are
+   NONZERO — proof the new serve paths actually feed them, not just
+   declare them.
 
 This is the **dynamic half** of the metric-surface check: the family
 table parser and the static declared-instrument extraction are shared
@@ -24,15 +32,37 @@ Exit code 0 on a clean scrape; 1 with the violations listed.
 from __future__ import annotations
 
 import json
+import os
 import sys
+import time
 import urllib.error
 import urllib.request
 from pathlib import Path
+
+# the sharded serve path needs >= 2 devices; must be set before jax init
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
 
 ROOT = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(ROOT))
 
 DOC = ROOT / "docs" / "concepts" / "observability.md"
+
+#: families the driven paths must leave NONZERO on the named role's
+#: scrape (family -> role): declaring a family is cheap, feeding it is
+#: the contract
+NONZERO = {
+    "keto_shard_halo_rounds_total": "primary",
+    "keto_shard_halo_bytes_total": "primary",
+    "keto_shard_frontier_bits_total": "primary",
+    "keto_timeline_finished_total": "primary",
+    "keto_timeline_stage_duration_seconds": "primary",
+    "keto_slo_availability_ratio": "primary",
+    "keto_replica_applied_commits_total": "replica",
+    "keto_replica_bootstraps_total": "replica",
+    "keto_replication_apply_delay_seconds": "replica",
+    "keto_timeline_finished_total#replica": "replica",
+}
 
 
 def documented_families() -> dict[str, str]:
@@ -54,22 +84,38 @@ def statically_declared() -> set[str]:
     return set(declared_families(project))
 
 
-def drive_traffic(read_port: int, write_port: int) -> None:
-    """One request through every signal path the families cover."""
+def _get(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def drive_traffic(read_port: int, write_port: int) -> int:
+    """One request through every signal path the families cover.
+    Returns the snaptoken of the last write (the replica pin)."""
     import grpc
     from ory.keto.acl.v1alpha1 import check_service_pb2
 
-    put = json.dumps(
-        {"namespace": "files", "object": "o", "relation": "r", "subject_id": "u"}
-    ).encode()
-    req = urllib.request.Request(
-        f"http://127.0.0.1:{write_port}/relation-tuples", data=put, method="PUT",
-        headers={"Content-Type": "application/json", "X-Idempotency-Key": "lint-1"},
-    )
-    urllib.request.urlopen(req, timeout=10)
-    urllib.request.urlopen(req, timeout=10)  # idempotent replay
+    # group membership so the check BFSes through an interior node —
+    # with labels disabled, that is the sharded halo-exchange path
     base = f"http://127.0.0.1:{read_port}"
-    urllib.request.urlopen(f"{base}/check?namespace=files&object=o&relation=r&subject_id=u", timeout=10)
+    token = 0
+    for payload in (
+        {"namespace": "groups", "object": "g1", "relation": "member",
+         "subject_id": "u"},
+        {"namespace": "files", "object": "o", "relation": "r",
+         "subject_set": {"namespace": "groups", "object": "g1",
+                         "relation": "member"}},
+    ):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{write_port}/relation-tuples",
+            data=json.dumps(payload).encode(), method="PUT",
+            headers={"Content-Type": "application/json",
+                     "X-Idempotency-Key": f"lint-{payload['object']}"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            token = int(resp.headers.get("X-Keto-Snaptoken") or token)
+        urllib.request.urlopen(req, timeout=10)  # idempotent replay
+    _get(f"{base}/check?namespace=files&object=o&relation=r&subject_id=u&snaptoken={token}")
     # batch-check: the priority-lane / admission-control path
     batch = json.dumps(
         {"tuples": [
@@ -84,10 +130,13 @@ def drive_traffic(read_port: int, write_port: int) -> None:
         timeout=10,
     )
     try:
-        urllib.request.urlopen(f"{base}/check?namespace=files&object=o&relation=r&subject_id=nobody", timeout=10)
+        _get(f"{base}/check?namespace=files&object=o&relation=r&subject_id=nobody")
     except urllib.error.HTTPError:
         pass  # 403 denial is the point
-    urllib.request.urlopen(f"{base}/health/ready", timeout=10)
+    _get(f"{base}/health/ready")
+    # the SLO + timeline surfaces (also drives their lazy samplers)
+    _get(f"{base}/slo")
+    _get(f"{base}/debug/requests")
     channel = grpc.insecure_channel(f"127.0.0.1:{read_port}")
     stub = channel.unary_unary(
         "/ory.keto.acl.v1alpha1.CheckService/Check",
@@ -102,6 +151,7 @@ def drive_traffic(read_port: int, write_port: int) -> None:
         timeout=10,
     )
     channel.close()
+    return token
 
 
 def lint(text: str) -> list[str]:
@@ -142,38 +192,136 @@ def lint(text: str) -> list[str]:
     return problems
 
 
+def family_total(families: dict, name: str) -> float:
+    """Sum of a family's samples (histograms: the _count samples)."""
+    fam = families.get(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for sample_name, _labels, value in fam["samples"]:
+        if fam["type"] == "histogram":
+            if sample_name == f"{name}_count":
+                total += value
+        else:
+            total += value
+    return total
+
+
+def check_nonzero(role: str, text: str) -> list[str]:
+    from keto_tpu.x.metrics import parse_exposition
+
+    families = parse_exposition(text)
+    problems = []
+    for spec, want_role in NONZERO.items():
+        if want_role != role:
+            continue
+        name = spec.split("#")[0]
+        if family_total(families, name) <= 0:
+            problems.append(
+                f"{role}: family {name} scraped zero — the driven "
+                f"{role} serve path did not feed it"
+            )
+    return problems
+
+
+def wait_ready(port: int, want_role: str, timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            body = json.loads(_get(f"http://127.0.0.1:{port}/health/ready", 5))
+            if body.get("status") == "ok" and (
+                want_role != "replica" or body.get("role") == "replica"
+            ):
+                return
+        except Exception:  # keto-analyze: ignore[KTA401] readiness poll races daemon boot; the bounded deadline below is the failure signal
+            pass
+        time.sleep(0.2)
+    raise RuntimeError(f"{want_role} daemon not ready within {timeout}s")
+
+
 def main() -> int:
     from keto_tpu.config.provider import Config
     from keto_tpu.driver.daemon import Daemon
     from keto_tpu.driver.registry import Registry
 
+    namespaces = [{"id": 0, "name": "files"}, {"id": 1, "name": "groups"}]
     cfg = Config(
         overrides={
-            "namespaces": [{"id": 0, "name": "files"}],
+            "namespaces": namespaces,
             "dsn": "memory",
             "serve.read.port": 0,
             "serve.write.port": 0,
             "tracing.provider": "memory",
+            # sharded serve path: 2-shard graph axis, labels off so
+            # checks ride the halo-exchanging BFS kernel
+            "serve.mesh_graph": 2,
+            "serve.labels_enabled": False,
+            "serve.watch_poll_ms": 20,
         }
     )
     daemon = Daemon(Registry(cfg))
     daemon.serve_all(block=False)
+    replica = None
+    problems: list[str] = []
     try:
-        drive_traffic(daemon.read_port, daemon.write_port)
-        with urllib.request.urlopen(
-            f"http://127.0.0.1:{daemon.read_port}/metrics", timeout=10
-        ) as resp:
-            text = resp.read().decode()
+        token = drive_traffic(daemon.read_port, daemon.write_port)
+        # replica daemon feeding off the primary (single-device engine —
+        # the replica families are role-, not mesh-, specific)
+        replica_cfg = Config(
+            overrides={
+                "namespaces": namespaces,
+                "dsn": "memory",  # ignored by design: replicas hold no store
+                "serve.read.port": 0,
+                "serve.write.port": 0,
+                "serve.role": "replica",
+                "serve.primary_url": f"http://127.0.0.1:{daemon.read_port}",
+                "serve.watch_poll_ms": 20,
+                "serve.staleness_wait_ms": 2000,
+            }
+        )
+        replica = Daemon(Registry(replica_cfg))
+        replica.serve_all(block=False)
+        wait_ready(replica.read_port, "replica")
+        # a write AFTER the replica subscribed rides the live feed with
+        # its commit metadata (the replication-delay histogram's source)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.write_port}/relation-tuples",
+            data=json.dumps(
+                {"namespace": "files", "object": "o2", "relation": "r",
+                 "subject_id": "u"}
+            ).encode(),
+            method="PUT", headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            token = int(resp.headers.get("X-Keto-Snaptoken") or token)
+        # pinned read blocks until applied, then answers from the replica
+        _get(
+            f"http://127.0.0.1:{replica.read_port}/check?namespace=files"
+            f"&object=o2&relation=r&subject_id=u&snaptoken={token}", 30
+        )
+        primary_text = _get(
+            f"http://127.0.0.1:{daemon.read_port}/metrics", 10
+        ).decode()
+        replica_text = _get(
+            f"http://127.0.0.1:{replica.read_port}/metrics", 10
+        ).decode()
     finally:
+        if replica is not None:
+            replica.shutdown()
         daemon.shutdown()
-    problems = lint(text)
+    for role, text in (("primary", primary_text), ("replica", replica_text)):
+        problems += [f"{role}: {p}" for p in lint(text)]
+        problems += check_nonzero(role, text)
     if problems:
         print("metrics-lint FAILED:", file=sys.stderr)
         for p in problems:
             print(f"  - {p}", file=sys.stderr)
         return 1
-    n = len(text.splitlines())
-    print(f"metrics-lint OK: {n} exposition lines, every family documented")
+    n = len(primary_text.splitlines()) + len(replica_text.splitlines())
+    print(
+        f"metrics-lint OK: {n} exposition lines across primary+replica, "
+        "every family documented, replica/shard/SLO/timeline families live"
+    )
     return 0
 
 

@@ -52,6 +52,16 @@ def main() -> int:
     # address its replicas were configured with (0 = ephemeral)
     ap.add_argument("--read-port", type=int, default=0)
     ap.add_argument("--write-port", type=int, default=0)
+    # flight recorder (keto_tpu/x/flightrec.py): with a bundle dir the
+    # daemon dumps anomaly bundles (scripts/flightrec_smoke.py drives it)
+    ap.add_argument("--debug-bundle-dir", default="")
+    ap.add_argument("--bundle-min-interval-s", type=float, default=0.5)
+    # arm a fault spec only AFTER the first snapshot is built, so the
+    # boot path cannot consume a count-limited fault meant for a live
+    # request (e.g. device-alloc:oom:1); --armed-file is touched when
+    # the faults are live so the parent can sequence its traffic
+    ap.add_argument("--arm-after-ready", default="")
+    ap.add_argument("--armed-file", default="")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -82,10 +92,41 @@ def main() -> int:
                 "serve.watch_poll_ms": 20,
             }
         )
+    if args.debug_bundle_dir:
+        overrides.update(
+            {
+                "serve.debug_bundle_dir": args.debug_bundle_dir,
+                "serve.debug_bundle_min_interval_s": args.bundle_min_interval_s,
+            }
+        )
     cfg = Config(overrides=overrides)
     daemon = Daemon(Registry(cfg))
     daemon.install_signal_handlers()
     daemon.serve_all(block=False)
+
+    if args.arm_after_ready:
+        import threading
+        import time as _time
+
+        def arm():
+            from keto_tpu.x import faults
+
+            engine = daemon.registry.permission_engine()
+            deadline = _time.monotonic() + 60.0
+            while _time.monotonic() < deadline:
+                try:
+                    if not hasattr(engine, "health") or engine.health().get(
+                        "has_snapshot"
+                    ):
+                        break
+                except Exception:
+                    pass
+                _time.sleep(0.05)
+            faults.load_env(args.arm_after_ready)
+            if args.armed_file:
+                Path(args.armed_file).touch()
+
+        threading.Thread(target=arm, name="chaos-arm", daemon=True).start()
 
     ports = {"read": daemon.read_port, "write": daemon.write_port, "pid": os.getpid()}
     # atomic publish: the parent polls this file and must never read a

@@ -1,0 +1,292 @@
+"""Flight recorder: bundle policy units + daemon-level anomaly triggers.
+
+Covers keto_tpu/x/flightrec.py in isolation (rate limit, size cap with
+deterministic section shedding, retention prune, torn-dump atomicity,
+schema validation) and wired into a live daemon (bundle on an injected
+device-alloc OOM containing the triggering request's timeline; bundle
+on a health transition into NOT_SERVING; suppression counting)."""
+
+import json
+import os
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from keto_tpu.x.flightrec import (
+    BUNDLE_PREFIX,
+    FlightRecorder,
+    list_bundles,
+    validate_bundle,
+)
+
+# -- unit policy ---------------------------------------------------------------
+
+
+def _collect_small():
+    return {"health": {"state": "serving"}, "timelines": {"recent": []}}
+
+
+def test_bundle_write_is_valid_and_atomic(tmp_path):
+    fr = FlightRecorder(
+        tmp_path, collect=_collect_small, min_interval_s=0.0, version="v-test"
+    )
+    path = fr.trigger("oom", "injected")
+    assert path is not None
+    bundle = json.loads(Path(path).read_text())
+    assert validate_bundle(bundle) == []
+    assert bundle["reason"] == "oom" and bundle["detail"] == "injected"
+    assert bundle["version"] == "v-test"
+    # no temp litter left behind
+    assert not list(tmp_path.glob(".flightrec-*.tmp"))
+    assert fr.snapshot()["bundles_by_reason"] == {"oom": 1}
+
+
+def test_rate_limit_suppresses_and_counts(tmp_path):
+    fr = FlightRecorder(tmp_path, collect=_collect_small, min_interval_s=60.0)
+    assert fr.trigger("oom") is not None
+    assert fr.trigger("oom") is None
+    assert fr.trigger("drain") is None  # the limit is global, not per-reason
+    assert fr.snapshot()["suppressed"] == 2
+    assert len(list_bundles(tmp_path)) == 1
+
+
+def test_retention_prunes_oldest(tmp_path):
+    fr = FlightRecorder(
+        tmp_path, collect=_collect_small, min_interval_s=0.0, max_bundles=3
+    )
+    for i in range(6):
+        assert fr.trigger(f"r{i}") is not None
+        time.sleep(0.002)  # distinct millisecond stamps in the names
+    bundles = list_bundles(tmp_path)
+    assert len(bundles) == 3
+    reasons = [json.loads(p.read_text())["reason"] for p in bundles]
+    assert reasons == ["r3", "r4", "r5"]  # newest kept
+
+
+def test_size_cap_sheds_sections_deterministically(tmp_path):
+    big = "x" * 20000
+
+    def collect():
+        return {
+            "metrics": big,            # shed first
+            "timelines": {"recent": [{"kind": "GET /check"}]},  # survives
+            "health": {"state": "serving"},
+        }
+
+    fr = FlightRecorder(
+        tmp_path, collect=collect, min_interval_s=0.0, max_bytes=8192
+    )
+    path = fr.trigger("oom")
+    bundle = json.loads(Path(path).read_text())
+    assert validate_bundle(bundle) == []
+    assert bundle["sections"]["metrics"] == {"shed": "size cap"}
+    assert bundle["shed_sections"] == ["metrics"]
+    assert bundle["sections"]["timelines"]["recent"], "timelines shed too early"
+    assert len(Path(path).read_bytes()) <= 8192
+
+
+def test_torn_dump_leaves_no_partial_bundle(tmp_path, monkeypatch):
+    """A crash (or I/O failure) mid-write must never leave a torn
+    bundle-*.json — the atomic tmp+rename protocol guarantees a reader
+    only ever sees complete bundles."""
+    fr = FlightRecorder(tmp_path, collect=_collect_small, min_interval_s=0.0)
+    real_replace = os.replace
+
+    def torn(src, dst):
+        raise OSError("disk died at the rename")
+
+    monkeypatch.setattr(os, "replace", torn)
+    assert fr.trigger("oom") is None
+    assert fr.snapshot()["failures"] == 1
+    assert list_bundles(tmp_path) == []  # no bundle, torn or otherwise
+    assert not list(tmp_path.glob(".flightrec-*.tmp"))  # tmp cleaned up
+    monkeypatch.setattr(os, "replace", real_replace)
+    fr2 = FlightRecorder(tmp_path, collect=_collect_small, min_interval_s=0.0)
+    assert fr2.trigger("retry") is not None  # recorder still serviceable
+
+
+def test_unserializable_section_contained(tmp_path):
+    def collect():
+        return {"health": {"state": "ok"}, "bad": {"thread": object()}}
+
+    fr = FlightRecorder(tmp_path, collect=collect, min_interval_s=0.0)
+    path = fr.trigger("oom")
+    bundle = json.loads(Path(path).read_text())
+    assert validate_bundle(bundle) == []
+    assert "error" in bundle["sections"]["bad"]
+    assert bundle["sections"]["health"] == {"state": "ok"}
+
+
+def test_collect_failure_still_dumps(tmp_path):
+    def collect():
+        raise RuntimeError("collector exploded")
+
+    fr = FlightRecorder(tmp_path, collect=collect, min_interval_s=0.0)
+    path = fr.trigger("drain")
+    bundle = json.loads(Path(path).read_text())
+    assert "collect_error" in bundle["sections"]
+
+
+def test_list_bundles_ignores_foreign_files(tmp_path):
+    (tmp_path / "notes.txt").write_text("hi")
+    (tmp_path / ".flightrec-torn.tmp").write_text("{")
+    (tmp_path / f"{BUNDLE_PREFIX}123-oom.json").write_text("{}")
+    assert [p.name for p in list_bundles(tmp_path)] == [
+        f"{BUNDLE_PREFIX}123-oom.json"
+    ]
+
+
+def test_validate_bundle_catches_schema_problems():
+    assert validate_bundle([]) == ["bundle is not a JSON object"]
+    problems = validate_bundle({"schema": 99, "sections": {}})
+    assert any("schema" in p for p in problems)
+    assert any("sections is empty" in p for p in problems)
+    assert any("reason" in p for p in problems)
+
+
+# -- wired into a live daemon --------------------------------------------------
+
+
+def _daemon(tmp_path, **extra):
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "docs"}],
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "serve.debug_bundle_dir": str(tmp_path / "bundles"),
+            "serve.debug_bundle_min_interval_s": 0.1,
+            **extra,
+        }
+    )
+    d = Daemon(Registry(cfg))
+    d.serve_all(block=False)
+    return d
+
+
+def _wait_bundles(bundle_dir, n, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = list_bundles(bundle_dir)
+        if len(got) >= n:
+            return got
+        time.sleep(0.05)
+    raise AssertionError(
+        f"wanted {n} bundles, have {[p.name for p in list_bundles(bundle_dir)]}"
+    )
+
+
+def test_daemon_bundle_on_injected_oom(tmp_path):
+    """An injected device-alloc OOM during a check is contained AND
+    produces one schema-valid bundle whose timeline ring contains the
+    triggering request (the deferred dump waits for it to finish)."""
+    from keto_tpu.x import faults
+
+    d = _daemon(tmp_path, **{
+        "namespaces": [{"id": 0, "name": "docs"}, {"id": 1, "name": "groups"}],
+    })
+    bundle_dir = tmp_path / "bundles"
+    try:
+        # a 2-hop membership shape so the check BFSes through an
+        # interior node — a direct edge resolves on host and would never
+        # pass the device-alloc seam
+        for payload in (
+            {"namespace": "groups", "object": "g", "relation": "member",
+             "subject_id": "u"},
+            {"namespace": "docs", "object": "o", "relation": "r",
+             "subject_set": {"namespace": "groups", "object": "g",
+                             "relation": "member"}},
+        ):
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{d.write_port}/relation-tuples",
+                    data=json.dumps(payload).encode(), method="PUT",
+                    headers={"Content-Type": "application/json"},
+                ),
+                timeout=10,
+            )
+        url = (
+            f"http://127.0.0.1:{d.read_port}"
+            "/check?namespace=docs&object=o&relation=r&subject_id=u"
+        )
+        urllib.request.urlopen(url, timeout=30)  # settle snapshot + jit
+        faults.inject("device-alloc", exc=faults.OomInjected, count=1)
+        try:
+            req = urllib.request.Request(url)
+            req.add_header("X-Request-Id", "flightrec-test-oom")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200  # contained, answer delivered
+        finally:
+            faults.clear("device-alloc")
+        bundles = _wait_bundles(bundle_dir, 1)
+        bundle = json.loads(bundles[-1].read_text())
+        assert validate_bundle(bundle) == []
+        assert bundle["reason"] == "oom"
+        assert int(bundle["sections"]["hbm"]["oom_events"]) >= 1
+        ids = {
+            t.get("request_id")
+            for key in ("recent", "slowest")
+            for t in bundle["sections"]["timelines"].get(key, [])
+        }
+        assert "flightrec-test-oom" in ids
+        assert "metrics" in bundle["sections"]
+        assert "batcher" in bundle["sections"]
+    finally:
+        d.shutdown()
+
+
+def test_daemon_bundle_on_health_transition(tmp_path):
+    """A transition into NOT_SERVING (the operator drain override here;
+    any derived degradation takes the same listener path) dumps a bundle
+    carrying the transition history."""
+    from keto_tpu.driver.health import HealthState
+
+    d = _daemon(tmp_path)
+    bundle_dir = tmp_path / "bundles"
+    try:
+        monitor = d.registry.health_monitor()
+        assert monitor.status()[0] in (HealthState.STARTING, HealthState.SERVING)
+        time.sleep(0.15)  # past the min interval (no bundle yet to limit)
+        monitor.set_override(HealthState.NOT_SERVING, "test-induced")
+        monitor.status()  # transition detected on read
+        bundles = _wait_bundles(bundle_dir, 1)
+        bundle = json.loads(bundles[-1].read_text())
+        assert validate_bundle(bundle) == []
+        assert bundle["reason"] == "health-not_serving"
+        log = bundle["sections"]["health"]["transitions_log"]
+        assert log and log[-1]["to"] == "not_serving"
+        # flap back: within the rate-limit window the second transition
+        # is suppressed, counted on the recorder
+        monitor.set_override(None)
+        monitor.set_override(HealthState.NOT_SERVING, "flap")
+        monitor.status()
+        fr = d.registry.flight_recorder()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not fr.snapshot()["suppressed"]:
+            monitor.set_override(None)
+            monitor.status()
+            monitor.set_override(HealthState.NOT_SERVING, "flap")
+            monitor.status()
+            time.sleep(0.01)
+        assert fr.snapshot()["suppressed"] >= 1
+    finally:
+        d.shutdown()
+
+
+def test_no_bundle_dir_disables_recorder(tmp_path):
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={"namespaces": [{"id": 0, "name": "docs"}], "dsn": "memory"}
+    )
+    reg = Registry(cfg)
+    assert reg.flight_recorder() is None
+    reg.wire_flight_recorder()  # must be a no-op, not a crash
+    reg.close()

@@ -57,7 +57,16 @@ def test_rest_requests_traced_and_counted():
     )
     assert status == 403
     assert reg.telemetry().snapshot() == {"read GET /check": 1}
-    assert [s.name for s in reg.tracer().finished] == ["http.GET /check"]
+    spans = list(reg.tracer().finished)
+    # the request's server span, plus the timeline recorder's stage
+    # children under the same trace
+    assert [s.name for s in spans if not s.name.startswith("timeline.")] == [
+        "http.GET /check"
+    ]
+    server = next(s for s in spans if s.name == "http.GET /check")
+    for s in spans:
+        if s.name.startswith("timeline."):
+            assert s.trace_id == server.trace_id
     reg.close()
 
 

@@ -1,0 +1,273 @@
+"""Replication-aware tracing: one trace id from primary write to
+replica visibility, plus the SDK watch correlation contract.
+
+The contract under test (keto_tpu/list/watch.py CommitTrace index,
+rest/grpc write registration, httpclient watch metadata,
+replica/controller apply spans):
+
+- a write's traceparent rides its Watch commit group (``traceparent`` /
+  ``committed_at`` / ``emitted_at`` fields on the message);
+- the replica applies the group under a ``replica.apply`` span JOINED
+  to the writer's trace, closing only after the 412 gate is notified —
+  so ONE trace id spans primary transact → watch emit → replica apply
+  → read-visible;
+- the commit→visible delay feeds keto_replication_apply_delay_seconds
+  with the writer's trace id as the exemplar, and the replica's
+  /debug/requests lists the per-commit replication timelines;
+- httpclient.watch() injects traceparent + X-Request-Id on the initial
+  streaming request AND every budget-gated reconnect.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from keto_tpu.httpclient import KetoClient
+from keto_tpu.x.logging import request_context
+from keto_tpu.x.tracing import Tracer
+
+WRITE_TRACE = "ab" * 16
+WRITE_SPAN = "cd" * 8
+
+
+@pytest.fixture
+def replica_pair(tmp_path):
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+
+    ns = [{"id": 0, "name": "docs"}, {"id": 1, "name": "groups"}]
+    primary = Daemon(
+        Registry(
+            Config(
+                overrides={
+                    "namespaces": ns,
+                    "dsn": "memory",
+                    "serve.read.port": 0,
+                    "serve.write.port": 0,
+                    "serve.watch_poll_ms": 20,
+                    "tracing.provider": "memory",
+                }
+            )
+        )
+    )
+    primary.serve_all(block=False)
+    replica = Daemon(
+        Registry(
+            Config(
+                overrides={
+                    "namespaces": ns,
+                    "dsn": "memory",  # ignored by design
+                    "serve.read.port": 0,
+                    "serve.write.port": 0,
+                    "serve.role": "replica",
+                    "serve.primary_url": f"http://127.0.0.1:{primary.read_port}",
+                    "serve.replica_dir": str(tmp_path / "replica"),
+                    "serve.watch_poll_ms": 20,
+                    "serve.staleness_wait_ms": 3000.0,
+                    "tracing.provider": "memory",
+                }
+            )
+        )
+    )
+    replica.serve_all(block=False)
+    # wait for the replica's first bootstrap
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            body = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{replica.read_port}/health/ready",
+                    timeout=5,
+                ).read()
+            )
+            if body.get("role") == "replica" and body.get("status") == "ok":
+                break
+        except Exception:
+            pass
+        time.sleep(0.1)
+    else:
+        pytest.fail("replica never became ready")
+    yield primary, replica
+    replica.shutdown()
+    primary.shutdown()
+
+
+def test_one_trace_spans_write_to_replica_visibility(replica_pair):
+    primary, replica = replica_pair
+    # primary REST write carrying an explicit caller traceparent
+    put = json.dumps(
+        {"namespace": "docs", "object": "readme", "relation": "view",
+         "subject_id": "ann"}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{primary.write_port}/relation-tuples", data=put,
+        method="PUT",
+        headers={
+            "Content-Type": "application/json",
+            "traceparent": f"00-{WRITE_TRACE}-{WRITE_SPAN}-01",
+        },
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        token = int(resp.headers["X-Keto-Snaptoken"])
+
+    # read visible through the replica's 412 gate at the write's pin
+    status = urllib.request.urlopen(
+        f"http://127.0.0.1:{replica.read_port}/check?namespace=docs"
+        f"&object=readme&relation=view&subject_id=ann&snaptoken={token}",
+        timeout=30,
+    ).status
+    assert status == 200
+
+    # ONE trace id: the primary's server span for the write...
+    primary_spans = [
+        s for s in primary.registry.tracer().finished
+        if s.trace_id == WRITE_TRACE
+    ]
+    assert any(s.name == "http.PUT /relation-tuples" for s in primary_spans)
+
+    # ...and the replica's apply span for the SAME commit join it
+    def replica_apply_spans():
+        return [
+            s for s in replica.registry.tracer().finished
+            if s.name == "replica.apply" and s.trace_id == WRITE_TRACE
+        ]
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not replica_apply_spans():
+        time.sleep(0.05)
+    spans = replica_apply_spans()
+    assert spans, "replica.apply never joined the writer's trace"
+    apply_span = spans[-1]
+    assert int(apply_span.tags["snaptoken"]) == token
+    assert apply_span.tags["applied"] is True
+
+    # the replication timeline + delay histogram carry the same trace
+    raw = urllib.request.urlopen(
+        f"http://127.0.0.1:{replica.read_port}/debug/requests", timeout=10
+    ).read()
+    rep = json.loads(raw)["replication"]
+    mine = [e for e in rep if e["snaptoken"] == token]
+    assert mine and mine[0]["trace_id"] == WRITE_TRACE
+    assert mine[0]["commit_to_visible_s"] is not None
+    assert mine[0]["commit_to_visible_s"] >= 0.0
+    assert mine[0]["committed_at"] is not None
+    assert mine[0]["emitted_at"] is not None
+
+    metrics_req = urllib.request.Request(
+        f"http://127.0.0.1:{replica.read_port}/metrics",
+        headers={"Accept": "application/openmetrics-text"},
+    )
+    text = urllib.request.urlopen(metrics_req, timeout=10).read().decode()
+    count_lines = [
+        line for line in text.splitlines()
+        if line.startswith("keto_replication_apply_delay_seconds_count")
+    ]
+    assert count_lines and float(count_lines[0].split()[-1]) >= 1
+    assert f'trace_id="{WRITE_TRACE}"' in text  # the writer's exemplar
+
+
+def test_watch_message_carries_commit_trace(replica_pair):
+    """The raw /watch stream: groups committed with a traceparent carry
+    it (plus committed_at/emitted_at), and the SDK exposes the fields as
+    last_commit_meta."""
+    primary, _ = replica_pair
+    client = KetoClient(
+        f"http://127.0.0.1:{primary.read_port}",
+        f"http://127.0.0.1:{primary.write_port}",
+    )
+    before = primary.registry.relation_tuple_manager().watermark()
+    put = json.dumps(
+        {"namespace": "groups", "object": "g9", "relation": "member",
+         "subject_id": "zoe"}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{primary.write_port}/relation-tuples", data=put,
+        method="PUT",
+        headers={
+            "Content-Type": "application/json",
+            "traceparent": f"00-{'77' * 16}-{'88' * 8}-01",
+        },
+    )
+    urllib.request.urlopen(req, timeout=10)
+    gen = client.watch(snaptoken=before)
+    token, changes = next(gen)
+    gen.close()
+    meta = client.last_commit_meta
+    assert meta.get("traceparent", "").split("-")[1] == "77" * 16
+    assert meta.get("committed_at") is not None
+    assert meta.get("emitted_at") is not None
+    assert meta["emitted_at"] >= meta["committed_at"] - 1.0  # same clock
+
+
+class _WatchStub(BaseHTTPRequestHandler):
+    """A fake /watch endpoint recording request headers; serves one
+    commit group then closes, forcing the SDK's budget-gated reconnect."""
+
+    seen_headers: list = []
+
+    def do_GET(self):
+        type(self).seen_headers.append(
+            {k.lower(): v for k, v in self.headers.items()}
+        )
+        body = (
+            json.dumps(
+                {
+                    "snaptoken": str(len(type(self).seen_headers)),
+                    "changes": [
+                        {
+                            "action": "insert",
+                            "relation_tuple": {
+                                "namespace": "n", "object": "o",
+                                "relation": "r", "subject_id": "u",
+                            },
+                        }
+                    ],
+                }
+            )
+            + "\n"
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)  # then EOF: a clean stream end
+
+    def log_message(self, *a):
+        pass
+
+
+def test_sdk_watch_injects_correlation_on_initial_and_reconnect():
+    """The satellite regression: watch() must carry traceparent AND
+    X-Request-Id on the initial streaming request and on every
+    budget-gated reconnect, exactly like unary SDK calls."""
+    _WatchStub.seen_headers = []
+    server = HTTPServer(("127.0.0.1", 0), _WatchStub)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        client = KetoClient(base, base, timeout=5.0)
+        tracer = Tracer("memory")
+        with request_context(request_id="watch-rid-1"):
+            with tracer.span("caller") as span:
+                gen = client.watch(snaptoken=0)
+                next(gen)  # initial connect + first group
+                next(gen)  # stream ended -> budget-gated reconnect
+                gen.close()
+        assert len(_WatchStub.seen_headers) >= 2
+        for i, hdrs in enumerate(_WatchStub.seen_headers[:2]):
+            which = "initial" if i == 0 else "reconnect"
+            assert hdrs.get("x-request-id") == "watch-rid-1", (
+                f"{which} watch request missing X-Request-Id"
+            )
+            tp = hdrs.get("traceparent", "")
+            assert tp.split("-")[1:2] == [span.trace_id], (
+                f"{which} watch request missing/foreign traceparent: {tp!r}"
+            )
+    finally:
+        server.shutdown()
+        server.server_close()

@@ -455,3 +455,73 @@ def test_request_id_headers_conform(daemon):
     status, _, headers = _request_h(daemon.read_port, "GET", "/check", query=query)
     assert status == 403
     assert headers.get("X-Request-Id"), "deny response missing a minted request id"
+
+
+def test_debug_requests_conforms(daemon):
+    """GET /debug/requests answers the declared timeline shape, with
+    every stage entry carrying the required fields, and the filter
+    parameters honored."""
+    # traffic first so the ring is non-empty (the module fixture already
+    # drove checks, but make one with a known id)
+    _request_h(
+        daemon.read_port, "GET", "/check",
+        query={
+            "namespace": "files", "object": "readme", "relation": "view",
+            "subject_id": "deb",
+        },
+        headers={"X-Request-Id": "debug-conform-1"},
+    )
+    status, body = _request(daemon.read_port, "GET", "/debug/requests")
+    assert status == 200
+    _validate("/debug/requests", "GET", status, body)
+    assert body["enabled"] is True
+    assert body["recent"], "ring empty after traffic"
+    ids = {t["request_id"] for t in body["recent"]}
+    assert "debug-conform-1" in ids
+    stages = [s["stage"] for s in body["recent"][0]["stages"]]
+    assert stages[0] == "arrival" and stages[-1] == "deliver"
+    # bad filter params are 400s with the error envelope
+    status, body = _request(
+        daemon.read_port, "GET", "/debug/requests", query={"n": "nope"}
+    )
+    assert status == 400
+    _validate("/debug/requests", "GET", status, body)
+
+
+def test_slo_conforms(daemon):
+    """GET /slo answers the declared report shape: objectives plus one
+    entry per trailing window with ratios and burn rates."""
+    status, body = _request(daemon.read_port, "GET", "/slo")
+    assert status == 200
+    _validate("/slo", "GET", status, body)
+    windows = {w["window"] for w in body["windows"]}
+    assert windows == {"5m", "1h"}
+    for w in body["windows"]:
+        assert 0.0 <= w["availability_ratio"] <= 1.0
+        assert w["availability_burn_rate"] >= 0.0
+
+
+def test_server_timing_header_conforms(daemon):
+    """The declared Server-Timing header on /check: present on allow AND
+    deny, well-formed per the W3C grammar (name;dur=millis entries),
+    ending with the total."""
+    import re
+
+    get = SPEC["paths"]["/check"]["get"]
+    assert "Server-Timing" in get["responses"]["200"]["headers"]
+    assert "Server-Timing" in get["responses"]["403"]["headers"]
+    entry = re.compile(r"^[a-z_]+;dur=\d+(\.\d+)?$")
+    for subject, want in (("deb", 200), ("mallory", 403)):
+        status, _, headers = _request_h(
+            daemon.read_port, "GET", "/check",
+            query={
+                "namespace": "files", "object": "readme", "relation": "view",
+                "subject_id": subject,
+            },
+        )
+        assert status == want
+        st = headers.get("Server-Timing")
+        assert st, f"{want} response missing Server-Timing"
+        parts = [p.strip() for p in st.split(",")]
+        assert all(entry.match(p) for p in parts), st
+        assert parts[-1].startswith("total;dur=")

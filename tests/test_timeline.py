@@ -1,0 +1,425 @@
+"""Per-request timelines, the debug surfaces, and the SLO engine.
+
+Covers keto_tpu/x/timeline.py (recorder semantics: ring/top-K bounds,
+stamp caps, Server-Timing rendering, filters, disabled mode),
+keto_tpu/x/slo.py (burn-rate math against a fabricated registry), and
+the end-to-end integration: a live daemon's check requests produce
+timelines with batcher/engine stages, Server-Timing headers (REST) and
+server-timing trailing metadata (gRPC), stage child spans under the
+request's trace, trace-exemplared stage histograms, and GET
+/debug/requests + GET /slo."""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from keto_tpu.x.timeline import (
+    MAX_STAMPS,
+    Timeline,
+    TimelineRecorder,
+    current_timeline,
+)
+
+SERVER_TIMING_ENTRY = re.compile(r"^[a-z_]+;dur=\d+(\.\d+)?$")
+
+
+# -- recorder unit semantics ---------------------------------------------------
+
+
+def test_recorder_ring_and_topk_bounds():
+    rec = TimelineRecorder(capacity=16, top_k=4)
+    for i in range(50):
+        tl = rec.begin(f"GET /check", request_id=f"r{i}")
+        tl.stamp("admit")
+        # make request 7 the slowest by faking its arrival earlier
+        if i == 7:
+            tl._t0 -= 10.0
+        rec.finish(tl, status=200)
+    snap = rec.snapshot(recent=100, slowest=100)
+    assert len(snap["recent"]) == 16  # ring bound
+    assert len(snap["slowest"]) == 4  # top-K bound
+    # the artificially slow request survives in the top-K even though
+    # the ring rotated past it
+    assert snap["slowest"][0]["request_id"] == "r7"
+    assert snap["slowest"][0]["total_ms"] > 9000
+    assert snap["finished"] == {"http": 50}
+
+
+def test_stamp_cap_marks_truncation():
+    tl = Timeline("GET /check")
+    for i in range(MAX_STAMPS + 10):
+        tl.stamp("device", width=i)
+    assert len(tl.stamps) == MAX_STAMPS
+    assert tl.truncated
+
+
+def test_snapshot_filters_by_trace_and_snaptoken():
+    rec = TimelineRecorder()
+    a = rec.begin("GET /check", trace_id="a" * 32)
+    rec.finish(a, status=200, snaptoken=5)
+    b = rec.begin("GET /check", trace_id="b" * 32)
+    rec.finish(b, status=200, snaptoken=9)
+    got = rec.snapshot(trace_id="a" * 32)
+    assert [t["trace_id"] for t in got["recent"]] == ["a" * 32]
+    got = rec.snapshot(snaptoken="9")
+    assert [t["snaptoken"] for t in got["recent"]] == ["9"]
+
+
+def test_server_timing_aggregates_repeated_stages():
+    rec = TimelineRecorder()
+    tl = rec.begin("POST /check/batch")
+    tl.stamp("pack")
+    tl.stamp("device", width=32)
+    tl.stamp("device", width=32)
+    rec.finish(tl, status=200)
+    st = rec.server_timing(tl)
+    parts = [p.strip() for p in st.split(",")]
+    assert all(SERVER_TIMING_ENTRY.match(p) for p in parts), st
+    # repeated device stamps fold into ONE entry; total is last
+    assert sum(p.startswith("device;") for p in parts) == 1
+    assert parts[-1].startswith("total;dur=")
+
+
+def test_disabled_recorder_is_inert():
+    rec = TimelineRecorder(enabled=False)
+    assert rec.begin("GET /check") is None
+    with rec.activate(None):
+        assert current_timeline() is None
+    rec.finish(None, status=200)  # accepts None unconditionally
+    snap = rec.snapshot()
+    assert snap["enabled"] is False and snap["recent"] == []
+
+
+def test_activate_binds_context():
+    rec = TimelineRecorder()
+    tl = rec.begin("GET /check")
+    assert current_timeline() is None
+    with rec.activate(tl):
+        assert current_timeline() is tl
+    assert current_timeline() is None
+
+
+def test_stage_histogram_mirror_carries_exemplar():
+    from keto_tpu.x.metrics import MetricsRegistry
+
+    m = MetricsRegistry()
+    h = m.histogram("keto_timeline_stage_duration_seconds", "t", ("stage",))
+    rec = TimelineRecorder()
+    rec.attach_stage_histogram(h)
+    tl = rec.begin("GET /check", trace_id="c" * 32)
+    tl.stamp("admit")
+    tl.stamp("device", width=32)
+    rec.finish(tl, status=200)
+    text = m.render(openmetrics=True)
+    assert 'stage="device"' in text
+    assert f'trace_id="{"c" * 32}"' in text
+
+
+def test_finish_emits_stage_spans_under_request_trace():
+    from keto_tpu.x.tracing import Tracer
+
+    tracer = Tracer("memory")
+    rec = TimelineRecorder()
+    rec.set_tracer(tracer)
+    with tracer.span("http.GET /check") as server:
+        tl = rec.begin("GET /check")
+        assert tl.trace_id == server.trace_id
+        assert tl.parent_span_id == server.span_id
+        tl.stamp("admit")
+        tl.stamp("land")
+    rec.finish(tl, status=200)
+    stage_spans = [s for s in tracer.finished if s.name.startswith("timeline.")]
+    assert {s.name for s in stage_spans} == {
+        "timeline.admit", "timeline.land", "timeline.deliver",
+    }
+    for s in stage_spans:
+        assert s.trace_id == server.trace_id
+        assert s.parent_id == server.span_id
+        assert s.to_otlp()["kind"] == 1  # INTERNAL, never a server span
+
+
+# -- SLO engine unit semantics -------------------------------------------------
+
+
+def _fabricated_registry():
+    from keto_tpu.x.metrics import MetricsRegistry
+
+    m = MetricsRegistry()
+    http = m.counter(
+        "keto_http_requests_total", "t", ("role", "method", "route", "code")
+    )
+    grpc = m.counter("keto_grpc_requests_total", "t", ("method", "code"))
+    hist = m.histogram(
+        "keto_http_request_duration_seconds", "t", ("role", "method", "route")
+    )
+    return m, http, grpc, hist
+
+
+def test_slo_burn_rate_math():
+    from keto_tpu.x.slo import SloEngine
+
+    m, http, grpc, hist = _fabricated_registry()
+    eng = SloEngine(
+        m, availability_objective=0.99, latency_objective_ms=100.0,
+        latency_objective_ratio=0.9, min_sample_interval_s=0.0,
+    )
+    # 90 good + 10 server failures -> availability 0.9, burn (0.1/0.01)=10
+    for _ in range(90):
+        http.inc(("read", "GET", "/check", "200"))
+        hist.observe(("read", "GET", "/check"), 0.01)
+    for _ in range(10):
+        http.inc(("read", "GET", "/check", "500"))
+        hist.observe(("read", "GET", "/check"), 0.5)  # also slow
+    rep = eng.report()
+    w = rep["windows"][0]
+    assert w["availability_ratio"] == pytest.approx(0.9)
+    assert w["availability_burn_rate"] == pytest.approx(10.0)
+    # latency: 90/100 under the 0.1 s bucket edge -> ratio 0.9, budget
+    # 0.1 -> burn 1.0
+    assert rep["objectives"]["latency_threshold_le_s"] == pytest.approx(0.1)
+    assert w["latency_ratio"] == pytest.approx(0.9)
+    assert w["latency_burn_rate"] == pytest.approx(1.0)
+
+
+def test_slo_counts_grpc_and_ignores_client_errors():
+    from keto_tpu.x.slo import SloEngine
+
+    m, http, grpc, hist = _fabricated_registry()
+    eng = SloEngine(m, availability_objective=0.999, min_sample_interval_s=0.0)
+    http.inc(("read", "GET", "/check", "403"))  # a DENY, not a failure
+    http.inc(("read", "GET", "/check", "429"))  # policy shed, not a failure
+    grpc.inc(("CheckService/Check", "OK"))
+    grpc.inc(("CheckService/Check", "UNAVAILABLE"))  # server failure
+    w = eng.report()["windows"][0]
+    assert w["requests"] == 4
+    assert w["errors"] == 1
+    assert w["availability_ratio"] == pytest.approx(0.75)
+
+
+def test_slo_idle_window_spends_no_budget():
+    from keto_tpu.x.metrics import MetricsRegistry
+    from keto_tpu.x.slo import SloEngine
+
+    eng = SloEngine(MetricsRegistry(), min_sample_interval_s=0.0)
+    for w in eng.report()["windows"]:
+        assert w["availability_ratio"] == 1.0
+        assert w["availability_burn_rate"] == 0.0
+        assert w["latency_burn_rate"] == 0.0
+
+
+# -- end-to-end against a live daemon ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "docs"}, {"id": 1, "name": "groups"}],
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "tracing.provider": "memory",
+        }
+    )
+    d = Daemon(Registry(cfg))
+    d.serve_all(block=False)
+    put = json.dumps(
+        {
+            "namespace": "groups", "object": "g", "relation": "member",
+            "subject_id": "ann",
+        }
+    ).encode()
+    urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://127.0.0.1:{d.write_port}/relation-tuples", data=put,
+            method="PUT", headers={"Content-Type": "application/json"},
+        ),
+        timeout=10,
+    )
+    put2 = json.dumps(
+        {
+            "namespace": "docs", "object": "readme", "relation": "view",
+            "subject_set": {
+                "namespace": "groups", "object": "g", "relation": "member",
+            },
+        }
+    ).encode()
+    urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://127.0.0.1:{d.write_port}/relation-tuples", data=put2,
+            method="PUT", headers={"Content-Type": "application/json"},
+        ),
+        timeout=10,
+    )
+    yield d
+    d.shutdown()
+
+
+def _get(port, path, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, resp.read(), dict(resp.headers)
+
+
+def test_e2e_check_timeline_has_device_stage(daemon):
+    """One REST check produces a timeline spanning the whole pipeline:
+    batcher stages (admit/pack/dispatch), the device slice with its
+    kernel attrs, land, deliver — queryable at /debug/requests and
+    summarized in the Server-Timing header."""
+    status, _, headers = _get(
+        daemon.read_port,
+        "/check?namespace=docs&object=readme&relation=view&subject_id=ann",
+        headers={"X-Request-Id": "tl-e2e-1"},
+    )
+    assert status == 200
+    st = headers.get("Server-Timing")
+    assert st and "device;dur=" in st and st.split(",")[-1].strip().startswith("total;")
+    _, raw, _ = _get(daemon.read_port, "/debug/requests?n=50")
+    body = json.loads(raw)
+    mine = [t for t in body["recent"] if t["request_id"] == "tl-e2e-1"]
+    assert mine, "request missing from /debug/requests"
+    stages = {s["stage"]: s for s in mine[0]["stages"]}
+    for stage in ("arrival", "admit", "pack", "dispatch", "device", "land", "deliver"):
+        assert stage in stages, f"missing stage {stage}"
+    dev = stages["device"]["attrs"]
+    assert dev["width"] >= 1
+    assert dev["route"] in ("label", "hybrid", "bfs", "host", "cpu")
+    assert "service_ms" in dev and "bfs_steps" in dev
+    assert mine[0]["status"] == 200
+    # offsets are monotone within the timeline
+    offs = [s["t_ms"] for s in mine[0]["stages"]]
+    assert offs == sorted(offs)
+
+
+def test_e2e_debug_requests_trace_filter(daemon):
+    trace_id = "f" * 32
+    tp = f"00-{trace_id}-{'1' * 16}-01"
+    _get(
+        daemon.read_port,
+        "/check?namespace=docs&object=readme&relation=view&subject_id=ann",
+        headers={"traceparent": tp},
+    )
+    _, raw, _ = _get(
+        daemon.read_port, f"/debug/requests?trace_id={trace_id}"
+    )
+    body = json.loads(raw)
+    assert body["recent"], "trace filter returned nothing"
+    assert all(t["trace_id"] == trace_id for t in body["recent"])
+    assert all(t["trace_id"] == trace_id for t in body["slowest"])
+
+
+def test_e2e_stage_spans_join_request_trace(daemon):
+    trace_id = "e" * 32
+    tp = f"00-{trace_id}-{'2' * 16}-01"
+    _get(
+        daemon.read_port,
+        "/check?namespace=docs&object=readme&relation=view&subject_id=ann",
+        headers={"traceparent": tp},
+    )
+    spans = [
+        s for s in daemon.registry.tracer().finished
+        if s.trace_id == trace_id
+    ]
+    names = {s.name for s in spans}
+    assert "http.GET /check" in names
+    assert {"timeline.admit", "timeline.device", "timeline.deliver"} <= names
+
+
+def test_e2e_grpc_server_timing_trailer(daemon):
+    import grpc
+    from ory.keto.acl.v1alpha1 import check_service_pb2
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{daemon.read_port}")
+    call = channel.unary_unary(
+        "/ory.keto.acl.v1alpha1.CheckService/Check",
+        request_serializer=check_service_pb2.CheckRequest.SerializeToString,
+        response_deserializer=check_service_pb2.CheckResponse.FromString,
+    )
+    resp, rpc = call.with_call(
+        check_service_pb2.CheckRequest(
+            namespace="docs", object="readme", relation="view",
+            subject={"id": "ann"},
+        ),
+        timeout=30,
+    )
+    assert resp.allowed is True
+    trailing = dict(rpc.trailing_metadata() or ())
+    st = trailing.get("server-timing")
+    assert st and st.split(",")[-1].strip().startswith("total;dur=")
+    channel.close()
+    _, raw, _ = _get(daemon.read_port, "/debug/requests?n=50")
+    body = json.loads(raw)
+    assert any(t["surface"] == "grpc" for t in body["recent"])
+
+
+def test_e2e_openmetrics_stage_exemplars(daemon):
+    """The new slice-timing family carries trace-id exemplars in the
+    OpenMetrics rendering — a dashboard spike links to /debug/requests."""
+    trace_id = "d" * 32
+    _get(
+        daemon.read_port,
+        "/check?namespace=docs&object=readme&relation=view&subject_id=ann",
+        headers={"traceparent": f"00-{trace_id}-{'3' * 16}-01"},
+    )
+    _, raw, _ = _get(
+        daemon.read_port, "/metrics",
+        headers={"Accept": "application/openmetrics-text"},
+    )
+    text = raw.decode()
+    exemplared = [
+        l for l in text.splitlines()
+        if l.startswith("keto_timeline_stage_duration_seconds_bucket")
+        and "trace_id=" in l
+    ]
+    assert exemplared, "no exemplars on the stage-duration family"
+
+
+def test_e2e_slo_endpoint_live(daemon):
+    _, raw, _ = _get(daemon.read_port, "/slo")
+    body = json.loads(raw)
+    assert {w["window"] for w in body["windows"]} == {"5m", "1h"}
+    assert body["objectives"]["availability"] == 0.999
+    # scrape the same numbers: endpoint and families cannot disagree
+    _, mraw, _ = _get(daemon.read_port, "/metrics")
+    assert "keto_slo_availability_burn_rate" in mraw.decode()
+
+
+def test_timeline_disabled_daemon_omits_header():
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "docs"}],
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "serve.timeline_enabled": False,
+        }
+    )
+    d = Daemon(Registry(cfg))
+    d.serve_all(block=False)
+    try:
+        status, _, headers = _get(
+            daemon_port := d.read_port,
+            "/check?namespace=docs&object=o&relation=r&subject_id=u",
+        )
+    except urllib.error.HTTPError as e:
+        status, headers = e.code, dict(e.headers)
+    try:
+        assert status in (200, 403)
+        assert "Server-Timing" not in headers
+        _, raw, _ = _get(d.read_port, "/debug/requests")
+        body = json.loads(raw)
+        assert body["enabled"] is False and body["recent"] == []
+    finally:
+        d.shutdown()
