@@ -23,14 +23,18 @@ all: native proto
 docs:
 	python scripts/render_docs.py
 
-# native libraries: tuple→graph interner (keto_tpu/graph/native.py) and
-# the epoll port multiplexer (keto_tpu/servers/native_mux.py)
-native: native/libketoingest.so native/libketomux.so
+# native libraries: tuple→graph interner (keto_tpu/graph/native.py), the
+# epoll port multiplexer (keto_tpu/servers/native_mux.py), and the check
+# pack walk (keto_tpu/check/native_pack.py)
+native: native/libketoingest.so native/libketomux.so native/libketopack.so
 
 native/libketoingest.so: native/ingest.cpp
 	$(CXX) $(CXXFLAGS) -shared $< -o $@
 
 native/libketomux.so: native/mux.cpp
+	$(CXX) $(CXXFLAGS) -shared $< -o $@ -lpthread
+
+native/libketopack.so: native/pack.cpp
 	$(CXX) $(CXXFLAGS) -shared $< -o $@ -lpthread
 
 # regenerate protobuf modules from the wire contract

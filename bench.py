@@ -323,17 +323,24 @@ def make_queries(rng, n_checks, doc_grant, n_users, user_reaches, member_of, T):
 
 def stream_pass(engine, snap, queries, tag):
     """Adaptive streamed pass (the serving path's default): the engine's
-    latency controller sizes slices toward serve.stream_slice_target_ms.
-    Every ladder geometry pre-warms so no compile lands in the timed
-    window; per-slice latency is measured two ways — caller-visible
-    inter-yield gaps (first yield excluded: it absorbs pipeline fill) and
-    the engine's own DurationStats, the numbers the controller steers by.
-    Returns ``(decisions, metrics)``."""
+    service-time controller sizes slices toward
+    serve.stream_slice_target_ms. Every ladder geometry pre-warms so no
+    compile lands in the timed window; per-slice latency is measured two
+    ways — caller-visible inter-yield gaps (first yield excluded: it
+    absorbs pipeline fill) and the engine's own DurationStats, the
+    numbers the controller steers by. Reports the per-route breakdown
+    (label | hybrid | bfs | host — which kernel answered each slice, at
+    what latency and implied throughput) and the slice-tail ratio the
+    ``slice_tail`` section aggregates. Returns ``(decisions, metrics)``."""
     import numpy as _np
 
     for w in engine.stream_widths(snap):
         engine.batch_check(queries[:w])
     engine.stream_slice_stats.reset()
+    engine.reset_route_stats()
+    from keto_tpu.check.native_pack import COUNTERS as _pack_counters
+
+    pack_before = dict(_pack_counters)
     slice_lat = []
     outs = []
     t_start = time.perf_counter()
@@ -350,21 +357,41 @@ def stream_pass(engine, snap, queries, tag):
     p99 = steady[min(len(steady) - 1, int(len(steady) * 0.99))] * 1e3
     svc = engine.stream_slice_stats.snapshot()
     ctrl = engine.stream_ctrl.snapshot()
+    routes = {}
+    for route, r in engine.stream_route_snapshot().items():
+        busy_s = r["mean_ms"] * r["slices"] / 1e3
+        routes[route] = {
+            **{k: r[k] for k in ("slices", "queries", "p50_ms", "p99_ms")},
+            "checks_per_s": round(r["queries"] / busy_s, 1) if busy_s else None,
+        }
+    tail_ratio = round(p99 / p50, 2) if p50 else None
+    route_summary = ", ".join(
+        "%s:%d" % (r, v["slices"]) for r, v in routes.items()
+    )
     log(
         f"[{tag}] stream (adaptive): {got.shape[0]/total_s:,.0f} checks/s; "
-        f"slice p50={p50:.0f} ms p99={p99:.0f} ms "
-        f"(service p50={svc['p50_ms']:.0f}/p99={svc['p99_ms']:.0f} ms, "
-        f"cap={ctrl['cap']}, {len(slice_lat)} slices)"
+        f"slice p50={p50:.0f} ms p99={p99:.0f} ms (ratio={tail_ratio}; "
+        f"service p50={svc['p50_ms']:.0f}/p99={svc['p99_ms']:.0f} ms, "
+        f"cap={ctrl['cap']}, {len(slice_lat)} slices, "
+        f"routes={{{route_summary}}})"
     )
     return got, {
         "stream_total_s": round(total_s, 2),
         "stream_checks_per_s": round(got.shape[0] / total_s, 1),
         "stream_slice_p50_ms": round(p50, 1),
         "stream_slice_p99_ms": round(p99, 1),
+        "stream_tail_ratio": tail_ratio,
         "stream_slice_service_p50_ms": svc["p50_ms"],
         "stream_slice_service_p99_ms": svc["p99_ms"],
         "stream_adaptive_cap": ctrl["cap"],
+        "stream_model_cap": ctrl.get("model_cap"),
+        "stream_tail_guard": ctrl.get("tail_guard"),
         "stream_slices": len(slice_lat),
+        "stream_routes": routes,
+        "stream_pack_chunks": {
+            k: _pack_counters[k] - pack_before.get(k, 0)
+            for k in ("native", "numpy")
+        },
     }
 
 
@@ -2432,6 +2459,35 @@ def main():
             log(f"[c5] FAILED: {e!r}")
             config5 = {"error": repr(e)}
 
+    # slice-tail summary: per streaming config, the p99/p50 service
+    # ratio (the number the acceptance gate and the tail-smoke CI job
+    # read) next to the per-route slice counts it decomposes into
+    slice_tail = {}
+    for name, m in (
+        ("config1", stream_metrics),
+        ("config4", config4),
+        ("config5", config5),
+    ):
+        if not isinstance(m, dict) or not m.get("stream_slice_p50_ms"):
+            continue
+        slice_tail[name] = {
+            "p50_ms": m["stream_slice_p50_ms"],
+            "p99_ms": m["stream_slice_p99_ms"],
+            "ratio": m.get("stream_tail_ratio"),
+            "checks_per_s": m.get("stream_checks_per_s"),
+            "routes": m.get("stream_routes"),
+            "pack_chunks": m.get("stream_pack_chunks"),
+        }
+    if slice_tail:
+        log(
+            "[slice_tail] "
+            + "; ".join(
+                "%s: p50=%.0fms p99=%.0fms ratio=%s"
+                % (k, v["p50_ms"], v["p99_ms"], v["ratio"])
+                for k, v in slice_tail.items()
+            )
+        )
+
     print(
         json.dumps(
             {
@@ -2457,6 +2513,7 @@ def main():
                     "scrape_overhead": scrape_overhead,
                     "timeline_overhead": timeline_overhead,
                     "overload": overload,
+                    "slice_tail": slice_tail,
                     "depth_sweep": depth_sweep,
                     "reverse_query": reverse_query,
                     "sharded": sharded,

@@ -47,6 +47,7 @@ from __future__ import annotations
 import collections
 import itertools
 import logging
+import os
 import random
 import threading
 import time
@@ -59,6 +60,7 @@ import numpy as np
 from jax import lax
 
 from keto_tpu import namespace as namespace_pkg
+from keto_tpu.check import native_pack
 from keto_tpu.driver.hbm import HbmGovernor, MemoryPressure, is_resource_exhausted
 from keto_tpu.graph.snapshot import WILDCARD, GraphSnapshot
 from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
@@ -260,6 +262,47 @@ _check_kernel = partial(
     ),
 )(check_step)
 
+#: donated variant: the ``entries`` staging buffer (arg 1) is donated to
+#: the computation, so XLA aliases its device memory into the (much
+#: smaller) packed output instead of allocating a fresh result buffer —
+#: and the staging allocation is released the moment the kernel consumes
+#: it, not when Python GC finds the array. Per-slice churn on the hot
+#: path drops to: one H2D copy into memory the allocator just got back
+#: from slice k-1. The engine only routes here when the backend actually
+#: implements donation (``_donation_default``); elsewhere donation is a
+#: silent no-op plus a warning, so the plain kernel is used instead.
+_check_kernel_donated = partial(
+    jax.jit,
+    static_argnames=(
+        "sizes", "n_active", "n_int", "valid_rows", "it_cap", "block_iters",
+        "bitmap_sharding",
+    ),
+    donate_argnums=(1,),
+)(check_step)
+
+
+def _donation_default() -> bool:
+    """Donate entry buffers? ``KETO_TPU_DONATE`` forces (1/0); default is
+    platform-derived — XLA implements input-output aliasing for
+    device-memory backends (TPU/GPU), while the CPU backend ignores the
+    donation and warns."""
+    env = os.environ.get("KETO_TPU_DONATE", "")
+    if env == "0":
+        return False
+    if env == "1":
+        # forced on (tests exercise the donated call path on CPU, where
+        # XLA ignores the donation): suppress the per-geometry warning
+        import warnings
+
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        return True
+    try:
+        return jax.local_devices()[0].platform in ("tpu", "gpu")
+    except Exception:
+        return False
+
 #: cap on the [pairs, W_out, W_in] compare intermediate per chunk
 _LABEL_PAIR_CHUNK = 2048
 
@@ -307,6 +350,12 @@ def label_step(
 
 
 _label_kernel = partial(jax.jit, static_argnames=("n_pairs", "B"))(label_step)
+
+#: donated variant (see _check_kernel_donated): the pair-entry staging
+#: buffer (arg 2) aliases into the packed uint32[W] output
+_label_kernel_donated = partial(
+    jax.jit, static_argnames=("n_pairs", "B"), donate_argnums=(2,)
+)(label_step)
 
 
 class _HybridSlice:
@@ -364,12 +413,160 @@ class _ShardedSlice:
         return True if r is None else bool(r())
 
 
-def pack_entries(packed) -> tuple[np.ndarray, tuple[int, int, int, int]]:
+def pack_entries(
+    packed, out: Optional[np.ndarray] = None
+) -> tuple[np.ndarray, tuple[int, int, int, int]]:
     """Concatenate pack_chunk's seven arrays into check_step's single
-    int32 ``entries`` buffer + static split sizes."""
+    int32 ``entries`` buffer + static split sizes. ``out`` (a staging
+    buffer of exactly the total size, from the engine's ``_StagingPool``)
+    receives the concatenation in place — no per-slice host allocation;
+    the pool only re-leases it after the slice that shipped it lands."""
     (e1r, e1q, e2r, e2q, ar, aq, targets) = packed
-    buf = np.concatenate([e1r, e1q, e2r, e2q, ar, aq, targets])
+    arrays = [e1r, e1q, e2r, e2q, ar, aq, targets]
+    if (
+        out is not None
+        and out.shape[0] == sum(a.shape[0] for a in arrays)
+        and all(a.dtype == np.int32 for a in arrays)
+    ):
+        buf = np.concatenate(arrays, out=out)
+    else:
+        buf = np.concatenate(arrays)
     return buf, (e1r.shape[0], e2r.shape[0], ar.shape[0], targets.shape[0])
+
+
+class _StagingPool:
+    """Reusable int32 host staging buffers for the packed entry arrays,
+    keyed by exact element count (entry geometries are pow2-padded, so a
+    serving process sees a handful of distinct sizes per width rung).
+
+    The aliasing discipline that makes reuse safe: ``acquire`` hands a
+    buffer out ON LEASE, and the engine only ``release``s it after the
+    slice that shipped it has LANDED (its device output fetched) — the
+    H2D copy behind ``jnp.asarray``/``device_put`` may complete
+    asynchronously (and on CPU backends may alias the host memory
+    outright), so writing the next slice's entries into the buffer any
+    earlier could corrupt an in-flight one. tests/test_slice_tail.py
+    fuzzes exactly that contract.
+
+    Pool growth is PLANNED: ``on_grow`` (the engine's governor seam)
+    may refuse a new buffer, in which case the caller falls back to a
+    per-slice allocation — the eviction ladder's "staging" rung drops
+    the whole pool the same way. ``bytes()`` is the figure the HBM
+    ledger's ``staging`` tag carries, reconciled at scrape."""
+
+    #: free buffers kept per distinct size (beyond the lease depth this
+    #: only caches geometry churn, so keep it shallow)
+    MAX_FREE_PER_SIZE = 8
+
+    def __init__(self, on_change: Optional[Callable[[int], None]] = None):
+        self._lock = threading.Lock()  # guards: _free, _bytes, _leased
+        self._free: dict[int, list] = {}
+        self._bytes = 0  # free + leased, the ledger figure
+        self._leased = 0
+        self._on_change = on_change
+
+    def _notify(self, nbytes: int) -> None:
+        # ALWAYS outside self._lock: the callback takes the governor's
+        # lock, and the governor's staging rung calls back into drop()
+        # while holding it — publishing under the pool lock would be a
+        # lock-order inversion (the sharded-smoke sanitizer caught
+        # exactly that). Concurrent publishes may land out of order; the
+        # ledger is reconciled at scrape, not per-update.
+        cb = self._on_change
+        if cb is not None:
+            cb(nbytes)
+
+    def acquire(self, n: int, plan=None) -> Optional[np.ndarray]:
+        """An int32 buffer of exactly ``n`` elements, or None when a new
+        buffer would be needed and ``plan`` (bytes -> bool) refuses it."""
+        with self._lock:
+            free = self._free.get(n)
+            if free:
+                self._leased += 1
+                return free.pop()
+        if plan is not None and not plan(4 * n):
+            return None
+        with self._lock:
+            self._bytes += 4 * n
+            self._leased += 1
+            total = self._bytes
+        self._notify(total)
+        return np.empty(n, np.int32)
+
+    def release(self, buf: np.ndarray) -> None:
+        total = None
+        with self._lock:
+            self._leased = max(0, self._leased - 1)
+            free = self._free.setdefault(buf.shape[0], [])
+            if len(free) < self.MAX_FREE_PER_SIZE:
+                free.append(buf)
+            else:
+                self._bytes = max(0, self._bytes - 4 * buf.shape[0])
+                total = self._bytes
+        if total is not None:
+            self._notify(total)
+
+    def drop(self) -> int:
+        """Evict: clear every free buffer and forget leased accounting
+        (outstanding leases release into a fresh pool). Returns the
+        bytes freed from the ledger."""
+        with self._lock:
+            freed = self._bytes
+            self._free.clear()
+            self._bytes = 0
+            self._leased = 0
+        self._notify(0)
+        return freed
+
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "bytes": self._bytes,
+                "leased": self._leased,
+                "free_buffers": sum(len(v) for v in self._free.values()),
+                "sizes": sorted(self._free),
+            }
+
+
+class _SortedSeen:
+    """Sorted-key membership set with amortized O(log n) inserts: keys
+    live in a list of sorted runs whose lengths form a (loosely)
+    geometric sequence — an insert batch merges equal-or-smaller runs
+    (each element participates in O(log n) merges total), replacing the
+    ``np.insert``-into-one-array scheme whose per-hop O(n) memmove made
+    a long walk quadratic. ``work`` counts elements moved by merges;
+    tests/test_native_pack.py asserts the O(n log n) bound."""
+
+    __slots__ = ("_runs", "work")
+
+    def __init__(self):
+        self._runs: list[np.ndarray] = []
+        self.work = 0
+
+    def contains(self, keys: np.ndarray) -> np.ndarray:
+        """bool mask: which of ``keys`` are present (any order)."""
+        mask = np.zeros(keys.shape[0], dtype=bool)
+        for run in self._runs:
+            pos = np.clip(np.searchsorted(run, keys), 0, run.size - 1)
+            mask |= run[pos] == keys
+        return mask
+
+    def add(self, ks: np.ndarray) -> None:
+        """Insert a SORTED batch of keys not currently present."""
+        if not ks.size:
+            return
+        run = ks
+        while self._runs and self._runs[-1].size <= run.size:
+            prev = self._runs.pop()
+            merged = np.concatenate([prev, run])
+            merged.sort(kind="stable")
+            self.work += merged.size
+            run = merged
+        self._runs.append(run)
 
 
 def _ceil_pow2(x: int) -> int:
@@ -417,6 +614,7 @@ def pack_chunk(
     i0: int,
     i1: int,
     force_W: Optional[int] = None,
+    native: bool = True,
 ):
     """Pack queries ``[i0, i1)`` of a bulk-resolved batch into kernel
     arguments — vectorized numpy throughout (the host side of the hot path,
@@ -475,56 +673,71 @@ def pack_chunk(
             prop_rows.append(hostp)
             prop_q.append(np.full(hostp.size, li, np.int64))
 
+    use_native = (
+        native and native_pack.available() and native_pack.walk_eligible(snap)
+    )
+    native_pack.COUNTERS["native" if use_native else "numpy"] += 1
     if prop_rows:
-        # multi-hop frontier propagation, (query, row)-deduplicated. The
-        # visited set stays SORTED so each hop's membership test is one
-        # searchsorted pass — np.isin against an unsorted history would
-        # re-sort the whole set every hop on this hot path.
         rows = np.concatenate(prop_rows).astype(np.int64)
         pq = np.concatenate(prop_q).astype(np.int64)
-        seen = np.zeros(0, np.int64)
-        seed_rows: list = []
-        seed_q: list = []
-        while rows.size:
-            key = (pq << 32) | rows
-            _, first = np.unique(key, return_index=True)
-            keep = np.sort(first)
-            rows, pq, key = rows[keep], pq[keep], key[keep]
-            if seen.size:
-                pos = np.clip(np.searchsorted(seen, key), 0, seen.size - 1)
-                fresh = seen[pos] != key
+        if use_native:
+            # one GIL-released C++ call walks the whole frontier
+            # (native/pack.cpp): threaded CSR gathers, hash-set
+            # seen/seed dedup, bit-identical output by contract
+            # (fuzz-compared in tests/test_native_pack.py)
+            srows, sq, hits = native_pack.pack_walk(snap, rows, pq, tgc)
+            if hits is not None:
+                host_ans |= hits
+            if srows.size:
+                e2[0].append(srows)
+                e2[1].append(sq)
+        else:
+            # numpy fallback: multi-hop frontier propagation, (query,
+            # row)-deduplicated. The visited set lives in merged sorted
+            # runs (_SortedSeen) — membership stays one searchsorted pass
+            # per run, and inserts amortize to O(log n) instead of the
+            # O(n) np.insert memmove that made long walks quadratic.
+            seen = _SortedSeen()
+            seed_rows: list = []
+            seed_q: list = []
+            while rows.size:
+                key = (pq << 32) | rows
+                _, first = np.unique(key, return_index=True)
+                keep = np.sort(first)
+                rows, pq, key = rows[keep], pq[keep], key[keep]
+                fresh = ~seen.contains(key)
                 rows, pq, key = rows[fresh], pq[fresh], key[fresh]
-            if not rows.size:
-                break
-            ks = np.sort(key)
-            seen = np.insert(seen, np.searchsorted(seen, ks), ks)
-            nbrs, cnts = snap.out_neighbors_bulk(rows)
-            if not nbrs.size:
-                break
-            gq = np.repeat(pq, cnts)
-            nbrs = nbrs.astype(np.int64)
-            # a traversed edge landing on the query's target decides it
-            # ("reached via ≥ 1 edge" — real edges only). The -1 no-target
-            # sentinel can never match a neighbor id.
-            hit = nbrs == tgc[gq]
-            if hit.any():
-                host_ans[gq[hit]] = True
-            m_seed = nbrs < ni
-            if m_seed.any():
-                seed_rows.append(nbrs[m_seed])
-                seed_q.append(gq[m_seed])
-            m_next = (nbrs >= ni) & (nbrs < sb)
-            rows, pq = nbrs[m_next], gq[m_next]
-        if seed_rows:
-            # global (query, row) dedup: e2 scatter-adds per-bit, so a row
-            # seeded twice for one query would carry into the next bit
-            srows = np.concatenate(seed_rows)
-            sq = np.concatenate(seed_q)
-            skey = (sq << 32) | srows
-            _, sfirst = np.unique(skey, return_index=True)
-            keep = np.sort(sfirst)
-            e2[0].append(srows[keep])
-            e2[1].append(sq[keep])
+                if not rows.size:
+                    break
+                seen.add(np.sort(key))
+                nbrs, cnts = snap.out_neighbors_bulk(rows)
+                if not nbrs.size:
+                    break
+                gq = np.repeat(pq, cnts)
+                nbrs = nbrs.astype(np.int64)
+                # a traversed edge landing on the query's target decides
+                # it ("reached via ≥ 1 edge" — real edges only). The -1
+                # no-target sentinel can never match a neighbor id.
+                hit = nbrs == tgc[gq]
+                if hit.any():
+                    host_ans[gq[hit]] = True
+                m_seed = nbrs < ni
+                if m_seed.any():
+                    seed_rows.append(nbrs[m_seed])
+                    seed_q.append(gq[m_seed])
+                m_next = (nbrs >= ni) & (nbrs < sb)
+                rows, pq = nbrs[m_next], gq[m_next]
+            if seed_rows:
+                # global (query, row) dedup: e2 scatter-adds per-bit, so
+                # a row seeded twice for one query would carry into the
+                # next bit
+                srows = np.concatenate(seed_rows)
+                sq = np.concatenate(seed_q)
+                skey = (sq << 32) | srows
+                _, sfirst = np.unique(skey, return_index=True)
+                keep = np.sort(sfirst)
+                e2[0].append(srows[keep])
+                e2[1].append(sq[keep])
 
     # answer-gather entries for sink targets of queries that have any start
     has_start = m_int | m_host
@@ -541,7 +754,12 @@ def pack_chunk(
         )
     m_ans = has_start & m_sink_t
     if m_ans.any():
-        rows, cnts = snap.sink_in_rows_bulk(tgc[m_ans])
+        if use_native:
+            # overlay-free by eligibility: the native gather mirrors
+            # sink_in_rows_bulk's plain-CSR arm off the GIL
+            rows, cnts = native_pack.sink_gather(snap, tgc[m_ans])
+        else:
+            rows, cnts = snap.sink_in_rows_bulk(tgc[m_ans])
         if rows.size:
             ans[0].append(rows)
             ans[1].append(np.repeat(qi[m_ans], cnts).astype(np.int32))
@@ -567,23 +785,38 @@ def pack_chunk(
 
 
 class StreamSliceController:
-    """Latency-adaptive slice-width controller for the streaming pipeline.
+    """Service-time-aware slice scheduler for the streaming pipeline.
 
     The memory-derived ``_slice_cap`` optimizes pure throughput — the
     widest bitmap the workspace budget allows — which on a tunneled device
-    means multi-hundred-ms service time per slice. This controller instead
-    picks the widest width on the compiled ladder (``32·_WORD_WIDTHS``:
-    only those geometries ever jit, so adapting never compiles a new
-    kernel) whose observed per-slice service time stays at or below
-    ``target_ms`` (config ``serve.stream_slice_target_ms``):
+    means multi-hundred-ms service time per slice. Per-slice timelines
+    (PR 14) showed the residual p99 tail is ROUTE-shaped: label slices
+    finish in single-digit ms while a BFS slice of the same width pays
+    tens of hops, so one reactive width shared by all routes lets the
+    occasional deep slice blow a 10–25× p99/p50 spread. This controller
+    therefore keeps a **predicted-service-time model** fit online from
+    the per-slice ``(width, route, bfs_steps, entries, service_ms)``
+    stats the stream already records, and schedules with it three ways:
 
-    - **narrow** multiplicatively on an overshoot — the new rung is
-      predicted from the slice's observed per-query cost, so one bad
-      observation jumps straight to a fitting width instead of walking
-      down rung by rung while callers wait;
-    - **re-widen** one rung at a time, only after ``patience`` consecutive
-      full-width slices with clear headroom — a rung up is 2–8× the
-      queries, so widening is the cautious direction.
+    - **width planning** (``cap()``): the widest compiled ladder width
+      (``32·_WORD_WIDTHS`` — adapting never compiles a new kernel) whose
+      PREDICTED service time stays at or below ``target_ms``, where the
+      prediction is pessimistic over the routes seen recently — one slow
+      BFS observation immediately narrows the next slices instead of
+      waiting for the shared EWMA to catch up. The original reactive
+      narrow-fast / re-widen-slow ladder walk is retained underneath as
+      a safety net for cost regimes the model has not seen;
+    - **pre-dispatch splitting** (``entry_budget()``): the model's
+      ms-per-device-entry estimate converts ``target_ms`` into a device
+      entry budget, and ``_dispatch_slices`` splits a predicted-slow
+      chunk (wildcard fanout, deep host walks) into sub-slices BEFORE
+      dispatch — the ready-order window then interleaves them with fast
+      slices, so a monster chunk never serializes the stream;
+    - **tail guard**: the observed p99/p50 ratio of recent slices is
+      checked against ``tail_ratio`` (config ``serve.stream_tail_ratio``)
+      and a multiplicative guard scales both the planned width and the
+      entry budget down while the tail is blown, recovering gradually —
+      the direct control loop for the bench's slice-tail gate.
 
     ``floor`` bounds narrowing so a latency spike cannot collapse
     throughput (2048 queries/slice keeps > 50k checks/s even at 25
@@ -594,10 +827,22 @@ class StreamSliceController:
     WIDEN_FRAC = 0.5
     #: narrow when observed ms > NARROW_FRAC · target
     NARROW_FRAC = 1.25
+    #: a route binds the pessimistic prediction for this many slices
+    #: after it was last observed
+    ROUTE_RECENCY = 64
+    #: recompute the tail guard every this many observations
+    TAIL_EVERY = 32
 
-    def __init__(self, target_ms: float = 40.0, floor: int = 2048, patience: int = 2):
+    def __init__(
+        self,
+        target_ms: float = 40.0,
+        floor: int = 2048,
+        patience: int = 2,
+        tail_ratio: float = 5.0,
+    ):
         self._ladder = [32 * w for w in _WORD_WIDTHS]
         self.target_ms = float(target_ms)
+        self.tail_ratio = float(tail_ratio)
         self._lo = next(
             (i for i, c in enumerate(self._ladder) if c >= floor),
             len(self._ladder) - 1,
@@ -610,19 +855,111 @@ class StreamSliceController:
         self._i = max(self._lo, len(self._ladder) - 3)
         self._good = 0
         self._ewma_ms_per_q: Optional[float] = None
+        #: per-route cost model: route → {per_q, per_entry, bfs_steps,
+        #: last_seen} (EWMAs; last_seen is a slice counter)
+        self._routes: dict[str, dict] = {}
+        self._slices = 0
+        self._ring: collections.deque = collections.deque(maxlen=256)
+        self._guard = 1.0
+        self._tail_p50 = 0.0
+        self._tail_p99 = 0.0
+
+    def _recent_locked(self):
+        horizon = self._slices - self.ROUTE_RECENCY
+        return [
+            st for st in self._routes.values() if st["last_seen"] >= horizon
+        ]
+
+    def _model_cap_locked(self) -> Optional[int]:
+        """Widest ladder width whose predicted service time (pessimistic
+        per-query cost over recently seen routes, scaled by the tail
+        guard) fits the target; None before any observation."""
+        recent = self._recent_locked()
+        per_q = max((st["per_q"] for st in recent), default=None)
+        if per_q is None or per_q <= 0:
+            return None
+        limit = self.target_ms * self._guard / per_q
+        want = self._ladder[self._lo]
+        for c in self._ladder:
+            if c <= limit:
+                want = max(want, c)
+        return want
 
     def cap(self) -> int:
-        """Current per-slice query cap (always a compiled ladder width)."""
+        """Per-slice query cap for the NEXT slice: the reactive ladder
+        rung bounded by the model's predicted-service-time width (always
+        a compiled ladder width)."""
         with self._lock:
-            return self._ladder[self._i]
+            cap = self._ladder[self._i]
+            m = self._model_cap_locked()
+            return cap if m is None else max(self._ladder[self._lo], min(cap, m))
 
-    def observe(self, nq: int, ms: float) -> None:
+    def entry_budget(self) -> Optional[int]:
+        """Device entries one sub-chunk may carry before its predicted
+        service time overshoots the target — the pre-dispatch split
+        bound ``_dispatch_slices`` applies. None before the model has an
+        entry-cost estimate."""
+        with self._lock:
+            recent = self._recent_locked()
+            per_e = max(
+                (st["per_entry"] for st in recent if st["per_entry"] > 0),
+                default=None,
+            )
+            if per_e is None:
+                return None
+            return max(256, int(self.target_ms * self._guard / per_e))
+
+    def observe(
+        self,
+        nq: int,
+        ms: float,
+        route: str = "bfs",
+        bfs_steps: int = 0,
+        entries: Optional[int] = None,
+    ) -> None:
         """Feed one slice's service time: dispatch→ready when the pipeline
-        ran dry, ready→ready interval when saturated."""
+        ran dry, ready→ready interval when saturated. ``route``/
+        ``bfs_steps``/``entries`` (from the stream's per-slice info) fit
+        the per-route model; plain ``observe(nq, ms)`` still steers the
+        reactive ladder alone."""
         if nq <= 0:
             return
         per_q = ms / nq
         with self._lock:
+            self._slices += 1
+            st = self._routes.get(route)
+            if st is None:
+                st = {"per_q": per_q, "per_entry": 0.0, "bfs_steps": 0.0,
+                      "last_seen": 0, "n": 0}
+                self._routes[route] = st
+            else:
+                # asymmetric EWMA: a slowdown bumps the predicted cost
+                # HARD (the very next cap()/entry_budget() narrows —
+                # that is the tail control), while a speedup also decays
+                # fast so a cleared spike doesn't pin throughput low
+                old = st["per_q"]
+                st["per_q"] = (
+                    0.5 * old + 0.5 * per_q
+                    if per_q >= old
+                    else 0.3 * old + 0.7 * per_q
+                )
+            if entries:
+                pe = ms / max(1, entries)
+                old = st["per_entry"]
+                if old <= 0:
+                    st["per_entry"] = pe
+                else:
+                    st["per_entry"] = (
+                        0.5 * old + 0.5 * pe
+                        if pe >= old
+                        else 0.3 * old + 0.7 * pe
+                    )
+            st["bfs_steps"] = 0.7 * st["bfs_steps"] + 0.3 * float(bfs_steps)
+            st["last_seen"] = self._slices
+            st["n"] += 1
+            self._ring.append(ms)
+            if self._slices % self.TAIL_EVERY == 0:
+                self._retune_tail_locked()
             e = self._ewma_ms_per_q
             self._ewma_ms_per_q = per_q if e is None else 0.7 * e + 0.3 * per_q
             cap = self._ladder[self._i]
@@ -642,6 +979,22 @@ class StreamSliceController:
             else:
                 self._good = 0
 
+    def _retune_tail_locked(self) -> None:
+        vals = sorted(self._ring)
+        if len(vals) < 8:
+            return
+        self._tail_p50 = vals[len(vals) // 2]
+        self._tail_p99 = vals[min(len(vals) - 1, int(len(vals) * 0.99))]
+        blown = (
+            self._tail_p50 > 0
+            and self._tail_p99 > self.tail_ratio * self._tail_p50
+            and self._tail_p99 > self.target_ms
+        )
+        if blown:
+            self._guard = max(0.25, self._guard * 0.5)
+        else:
+            self._guard = min(1.0, self._guard * 1.1)
+
     def snapshot(self) -> dict:
         """Controller state for introspection (bench, /debug)."""
         with self._lock:
@@ -649,6 +1002,20 @@ class StreamSliceController:
                 "cap": self._ladder[self._i],
                 "target_ms": self.target_ms,
                 "ewma_ms_per_query": self._ewma_ms_per_q,
+                "model_cap": self._model_cap_locked(),
+                "tail_ratio": self.tail_ratio,
+                "tail_guard": self._guard,
+                "tail_p50_ms": round(self._tail_p50, 3),
+                "tail_p99_ms": round(self._tail_p99, 3),
+                "routes": {
+                    r: {
+                        "per_q_ms": round(st["per_q"], 6),
+                        "per_entry_ms": round(st["per_entry"], 6),
+                        "bfs_steps": round(st["bfs_steps"], 2),
+                        "slices": st["n"],
+                    }
+                    for r, st in self._routes.items()
+                },
             }
 
 
@@ -710,6 +1077,9 @@ class TpuCheckEngine:
         audit_sample_rate: float = 0.0,
         device_build_enabled: bool = True,
         build_chunk_rows: int = 262144,
+        native_pack_enabled: bool = True,
+        staging_enabled: bool = True,
+        stream_tail_ratio: float = 5.0,
     ):
         if it_cap < 1:
             raise ValueError("it_cap must be >= 1 (the answer pull needs one step)")
@@ -733,8 +1103,22 @@ class TpuCheckEngine:
         # shared across streams so a serving process stays converged, and
         # per-slice service times land in stream_slice_stats — the
         # controller, bench.py, and operators all read the same numbers
-        self.stream_ctrl = StreamSliceController(target_ms=stream_slice_target_ms)
+        self.stream_ctrl = StreamSliceController(
+            target_ms=stream_slice_target_ms, tail_ratio=stream_tail_ratio
+        )
         self.stream_slice_stats = DurationStats()
+        #: per-route slice service times + query/slice counts (route =
+        #: label | hybrid | bfs | host | cpu): the stream's landing path
+        #: records them, bench's per-route breakdown and the
+        #: keto_stream_route_slices_total family read them
+        self._route_stats: dict[str, DurationStats] = {}
+        self._route_slices: collections.Counter = collections.Counter()
+        self._route_queries: collections.Counter = collections.Counter()
+        # native pack path (native/pack.cpp via keto_tpu/check/
+        # native_pack.py): the host walk runs GIL-released when the
+        # library is present and the snapshot is overlay-eligible;
+        # False pins the numpy reference path
+        self._native_pack = bool(native_pack_enabled)
         #: device BFS iteration counts per dispatched slice (values are
         #: step counts, not ms) — bench reports bfs_steps_p50/p99 from
         #: here so the label win is attributable to killed frontier hops
@@ -869,7 +1253,22 @@ class TpuCheckEngine:
         # the rung is a no-op (nothing resident to drop)
         self._reverse_evict_cb: Optional[Callable[[], int]] = None
         self._reverse_restore_cb: Optional[Callable[[], None]] = None
+        # persistent entry staging (donated device buffers' host half):
+        # packed entry arrays concatenate into pooled per-geometry
+        # buffers leased until their slice LANDS, and — where the
+        # backend implements donation — ship through the donated kernel
+        # variants so the device-side staging allocation aliases into
+        # the output. The pool's bytes ride the governor's "staging"
+        # ledger tag; its rung is FIRST on the ladder (dropping it costs
+        # per-slice allocation churn, never coverage or correctness).
+        self._staging_enabled = bool(staging_enabled)
+        self._staging_suspended = False
+        self._staging = _StagingPool(
+            on_change=lambda b: self.hbm.register("staging", b)
+        )
+        self._donate_entries = self._staging_enabled and _donation_default()
         self.hbm.attach_rungs([
+            ("staging", self._evict_staging, self._restore_staging),
             ("labels", self._evict_labels, self._restore_labels),
             ("reverse", self._evict_reverse, self._restore_reverse),
             ("warm-ladder", self._evict_warm_ladder, self._restore_warm_ladder),
@@ -1212,6 +1611,54 @@ class TpuCheckEngine:
         if cb is not None:
             cb()
 
+    def _evict_staging(self) -> int:
+        """Rung 0 — drop the persistent entry staging pool and fall back
+        to per-slice allocation + device_put: pure churn cost, zero
+        coverage or correctness impact, which is why it is the first
+        thing pressure sheds. Outstanding leases release into the empty
+        pool harmlessly."""
+        self._staging_suspended = True
+        freed = self._staging.drop()
+        self.hbm.release("staging")
+        return freed
+
+    def _restore_staging(self) -> None:
+        # the pool refills lazily as slices dispatch
+        self._staging_suspended = False
+
+    def _staging_on(self) -> bool:
+        return self._staging_enabled and not self._staging_suspended
+
+    def _stage_acquire(self, n: int) -> Optional[np.ndarray]:
+        """Lease an ``n``-element int32 staging buffer, planning pool
+        growth against the HBM governor (``evict=False`` — staging never
+        evicts other families; an unplannable buffer just means this
+        slice allocates per-slice). None when staging is off/refused."""
+        if not self._staging_on():
+            return None
+        return self._staging.acquire(
+            n, plan=lambda b: self.hbm.plan(b, what="staging", evict=False)
+        )
+
+    def _stage_release(self, leases) -> None:
+        """Return a landed slice's staging buffers to the pool. Empties
+        the lease list, so releasing a record twice (land() plus a
+        stream-teardown sweep) can never hand the same buffer to the
+        free list twice."""
+        if not leases:
+            return
+        for buf in leases:
+            self._staging.release(buf)
+        del leases[:]
+
+    def staging_snapshot(self) -> dict:
+        """Pool introspection (bench, /debug, ledger reconciliation)."""
+        out = self._staging.snapshot()
+        out["enabled"] = self._staging_enabled
+        out["suspended"] = self._staging_suspended
+        out["donating"] = self._donate_entries
+        return out
+
     def _evict_labels(self) -> int:
         """Rung 1 — drop the 2-hop label arrays: coverage loss only (the
         router falls back to BFS bit-identically), and typically the
@@ -1234,13 +1681,17 @@ class TpuCheckEngine:
         self._kick_background_refresh()
 
     def _evict_warm_ladder(self) -> int:
-        """Rung 2 — trim the compile-width ladder to its lower rungs and
+        """Rung 3 (after labels and the list engine's reverse rung) —
+        trim the compile-width ladder to its lower rungs and
         drop the warm-compiled executables: wide-slice throughput falls,
         decisions do not change (the same kernels at narrower widths)."""
         self._width_trim = max(self._width_trim, len(_WORD_WIDTHS) - 4)
         freed = self.hbm.release("warmup")
         self._last_warm_bytes = max(self._last_warm_bytes, freed)
-        kerns: list = [_check_kernel, _label_kernel]
+        kerns: list = [
+            _check_kernel, _label_kernel,
+            _check_kernel_donated, _label_kernel_donated,
+        ]
         if self._sharded:
             from keto_tpu.parallel import sharded as shard_mod
 
@@ -1266,7 +1717,7 @@ class TpuCheckEngine:
         self._width_trim = 0
 
     def _evict_overlay_budget(self) -> int:
-        """Rung 3 — shrink the overlay edge budget so pending deltas fold
+        """Rung 4 (last) — shrink the overlay edge budget so pending deltas fold
         into the base layout (compaction retires the overlay's device
         arrays and keeps future overlays small)."""
         self._max_overlay_edges = max(64, self._configured_overlay_budget // 8)
@@ -1441,6 +1892,8 @@ class TpuCheckEngine:
                     count=len(batch),
                 )
                 self.maintenance.incr("fallback_checks", by=len(batch))
+                ms = (time.perf_counter() - t0) * 1e3
+                self._note_route("cpu", len(batch), ms)
                 if ordered:
                     yield out
                 elif with_info:
@@ -1448,9 +1901,7 @@ class TpuCheckEngine:
                         "width": len(batch),
                         "bfs_steps": 0,
                         "route": "cpu",
-                        "service_ms": round(
-                            (time.perf_counter() - t0) * 1e3, 3
-                        ),
+                        "service_ms": round(ms, 3),
                     }
                 else:
                     yield off, out
@@ -2797,19 +3248,30 @@ class TpuCheckEngine:
                         snap.snapshot_id, batch, shards=self._shard_count
                     )
                 if snap.n_nodes == 0 or snap.n_edges == 0:
-                    yield off, None, np.zeros(len(batch), dtype=bool), len(batch), batch
+                    yield (
+                        off, None, np.zeros(len(batch), dtype=bool),
+                        len(batch), batch, [], 0,
+                    )
                     off += len(batch)
                     continue
-                for dev, host_ans, nq, chunk in self._dispatch_slices(snap, batch):
-                    yield off, dev, host_ans, nq, chunk
+                for dev, host_ans, nq, chunk, leases, n_ent in (
+                    self._dispatch_slices(snap, batch)
+                ):
+                    yield off, dev, host_ans, nq, chunk, leases, n_ent
                     off += nq
 
         def land(rec):
             # unpack one slice (blocks iff its transfer hasn't finished);
             # a truncated frontier re-runs exactly, mid-stream
             nonlocal max_iters, t_prev_ready
-            _seq, off, dev, host_ans, nq, chunk, t_disp = rec
-            out, iters, truncated = self._unpack_slice(dev, host_ans, nq)
+            _seq, off, dev, host_ans, nq, chunk, leases, n_ent, t_disp = rec
+            try:
+                out, iters, truncated = self._unpack_slice(dev, host_ans, nq)
+            finally:
+                # the device output is fetched (or the slice failed and
+                # will be re-answered elsewhere): the H2D staging copy is
+                # over, the buffers may be re-leased
+                self._stage_release(leases)
             if dev is not None and not (
                 isinstance(dev, _HybridSlice) and dev.bfs_dev is None
             ):
@@ -2829,8 +3291,17 @@ class TpuCheckEngine:
             ms = (now - max(t_disp, t_prev_ready)) * 1e3
             t_prev_ready = now
             stats.observe(ms)
+            if dev is None:
+                route = "host"
+            elif isinstance(dev, _HybridSlice):
+                route = "label" if dev.bfs_dev is None else "hybrid"
+            else:
+                route = "bfs"
             if ctrl is not None:
-                ctrl.observe(nq, ms)
+                ctrl.observe(
+                    nq, ms, route=route, bfs_steps=int(iters), entries=n_ent
+                )
+            self._note_route(route, nq, ms)
             self._audit_sample(chunk, out, snap.snapshot_id)
             if not with_info:
                 return off, out
@@ -2838,12 +3309,6 @@ class TpuCheckEngine:
             # which kernel answered and what it did (the stats words the
             # kernels already carry, threaded per request instead of
             # summed into counters)
-            if dev is None:
-                route = "host"
-            elif isinstance(dev, _HybridSlice):
-                route = "label" if dev.bfs_dev is None else "hybrid"
-            else:
-                route = "bfs"
             info = {
                 "width": nq,
                 "bfs_steps": int(iters),
@@ -2871,53 +3336,65 @@ class TpuCheckEngine:
         done: dict[int, tuple[int, np.ndarray]] = {}  # landed, awaiting in-order yield
         seq = 0
         next_seq = 0
-        while True:
-            # keep the dispatch window full: resolve/pack/dispatch is host
-            # work that overlaps device execution of every in-flight slice
-            while not exhausted and len(inflight) < depth:
-                nxt = next(src, None)
-                if nxt is None:
-                    exhausted = True
+        try:
+            while True:
+                # keep the dispatch window full: resolve/pack/dispatch is host
+                # work that overlaps device execution of every in-flight slice
+                while not exhausted and len(inflight) < depth:
+                    nxt = next(src, None)
+                    if nxt is None:
+                        exhausted = True
+                        break
+                    off, dev, host_ans, nq, chunk, leases, n_ent = nxt
+                    if dev is not None:
+                        dev.copy_to_host_async()
+                    inflight.append((
+                        seq, off, dev, host_ans, nq, chunk, leases, n_ent,
+                        time.perf_counter(),
+                    ))
+                    seq += 1
+                if not inflight and exhausted:
                     break
-                off, dev, host_ans, nq, chunk = nxt
-                if dev is not None:
-                    dev.copy_to_host_async()
-                inflight.append((seq, off, dev, host_ans, nq, chunk, time.perf_counter()))
-                seq += 1
-            if not inflight and exhausted:
-                break
-            # ready-order landing: every finished slice unpacks now — an
-            # early finisher never waits behind a straggler's transfer
-            progressed = False
-            still = []
-            for rec in inflight:
-                if self._slice_ready(rec[2]):
-                    res = land(rec)
-                    if ordered:
-                        done[rec[0]] = res
+                # ready-order landing: every finished slice unpacks now — an
+                # early finisher never waits behind a straggler's transfer
+                progressed = False
+                still = []
+                for rec in inflight:
+                    if self._slice_ready(rec[2]):
+                        res = land(rec)
+                        if ordered:
+                            done[rec[0]] = res
+                        else:
+                            yield res
+                        progressed = True
                     else:
-                        yield res
-                    progressed = True
-                else:
-                    still.append(rec)
-            inflight = still
-            if ordered:
-                while next_seq in done:
-                    yield done.pop(next_seq)[1]
-                    next_seq += 1
-            if not progressed and inflight and (exhausted or len(inflight) >= depth):
-                # nothing ready and the window is full (or input is done):
-                # block on the oldest slice — in ordered mode it is the
-                # next to deliver anyway
-                rec = inflight.pop(0)
-                res = land(rec)
+                        still.append(rec)
+                inflight = still
                 if ordered:
-                    done[rec[0]] = res
                     while next_seq in done:
                         yield done.pop(next_seq)[1]
                         next_seq += 1
-                else:
-                    yield res
+                if not progressed and inflight and (exhausted or len(inflight) >= depth):
+                    # nothing ready and the window is full (or input is done):
+                    # block on the oldest slice — in ordered mode it is the
+                    # next to deliver anyway
+                    rec = inflight.pop(0)
+                    res = land(rec)
+                    if ordered:
+                        done[rec[0]] = res
+                        while next_seq in done:
+                            yield done.pop(next_seq)[1]
+                            next_seq += 1
+                    else:
+                        yield res
+        finally:
+            # a failed or abandoned stream discards its in-flight
+            # outputs — their staging buffers may recycle (the same
+            # discarded-computation argument as _collect's error path;
+            # _stage_release empties each lease list, so a record whose
+            # land() already released is a no-op here)
+            for rec in inflight:
+                self._stage_release(rec[6])
         self._after_batch(max_iters)
 
     def _slice_cap(self, snap: GraphSnapshot) -> int:
@@ -2982,12 +3459,21 @@ class TpuCheckEngine:
         records as each slice is enqueued (the device chews on earlier
         slices meanwhile; chunk_tuples lets a truncated slice re-run).
 
-        A slice whose resolved fan-out exceeds 4·B device entries (wildcard
+        A slice whose resolved fan-out exceeds the entry budget (wildcard
         patterns, high-out-degree static starts) is sub-chunked so entry
         arrays stay within the {B, 2B, 4B} pad geometries — workload can't
         force unbounded allocations or fresh kernel geometries (a single
         monster query still falls through to ``_entry_pad``'s pow2
-        fallback; there is no smaller unit to split)."""
+        fallback; there is no smaller unit to split). The budget is the
+        smaller of the geometric 4·B bound and the slice controller's
+        PREDICTED-service-time budget (``entry_budget``): a chunk the
+        model predicts slow splits BEFORE dispatch, and the stream's
+        ready-order window interleaves its sub-slices with fast ones —
+        the pre-dispatch half of the slice-tail control loop.
+
+        Yields ``[dev, host_ans, nq, chunk_tuples, leases, n_entries]``;
+        ``leases`` are staging buffers released only once the slice has
+        landed, ``n_entries`` feeds the controller's entry-cost model."""
         cap_q = self._slice_cap(snap)
         n = len(tuples)
         for s0 in range(0, n, cap_q):
@@ -2995,7 +3481,14 @@ class TpuCheckEngine:
             sd, tg, multi = self._resolve_bulk(snap, tuples[s0:s1])
             nq = s1 - s0
             W = next(w for w in _WORD_WIDTHS if 32 * w >= nq)
-            cap_e = 4 * 32 * W
+            B = 32 * W
+            cap_e = 4 * B
+            if not self._multiprocess:
+                # service-time-aware split bound (never below one B —
+                # the geometric floor keeps slice counts bounded)
+                budget = self.stream_ctrl.entry_budget()
+                if budget is not None:
+                    cap_e = min(cap_e, max(B, budget))
             cnt = self._entry_counts(snap, sd, tg, multi)
             if int(cnt.sum()) <= cap_e:
                 bounds = [(0, nq)]
@@ -3012,14 +3505,17 @@ class TpuCheckEngine:
             for a, b in bounds:
                 # sub-chunks keep the slice width: queries pad, geometry stays
                 if use_labels:
-                    dev, host_ans = self._device_batch_labeled(
+                    dev, host_ans, leases = self._device_batch_labeled(
                         snap, sd, tg, multi, a, b, W, it_cap=it_cap
                     )
                 else:
-                    dev, host_ans = self._device_batch(
+                    dev, host_ans, leases = self._device_batch(
                         snap, sd, tg, multi, a, b, W, it_cap=it_cap
                     )
-                yield [dev, host_ans, b - a, tuples[s0 + a : s0 + b]]
+                yield [
+                    dev, host_ans, b - a, tuples[s0 + a : s0 + b],
+                    leases, int(cnt[a:b].sum()),
+                ]
 
     @staticmethod
     def _decode_packed(f: np.ndarray, host_ans: np.ndarray, nq: int):
@@ -3130,10 +3626,17 @@ class TpuCheckEngine:
             parts = d.parts() if isinstance(d, _HybridSlice) else [d]
             devs.extend(self._raw_dev(p) for p in parts)
         flat = None
-        if devs:
-            cat = jnp.concatenate(devs) if len(devs) > 1 else devs[0]
-            cat.copy_to_host_async()
-            flat = jax.device_get(cat)
+        try:
+            if devs:
+                cat = jnp.concatenate(devs) if len(devs) > 1 else devs[0]
+                cat.copy_to_host_async()
+                flat = jax.device_get(cat)
+        finally:
+            # the single fetch has consumed every slice's staging copy —
+            # or the batch failed and its outputs are discarded (the CPU
+            # fallback re-answers): either way the leases may recycle
+            for rec in results:
+                self._stage_release(rec[4])
         out = np.zeros(n, dtype=bool)
         max_iters = 0
         trunc_idx: list[int] = []
@@ -3146,7 +3649,7 @@ class TpuCheckEngine:
             off += part.shape[0]
             return seg
 
-        for dev, host_ans, nq, _ in results:
+        for dev, host_ans, nq, _, _, _ in results:
             if dev is None:
                 out[pos : pos + nq] = host_ans[:nq]
             elif isinstance(dev, _HybridSlice):
@@ -3178,6 +3681,45 @@ class TpuCheckEngine:
                     trunc_idx.extend(range(pos, pos + nq))
             pos += nq
         return out, max_iters, trunc_idx
+
+    def _note_route(self, route: str, nq: int, ms: float) -> None:
+        """Record one landed slice's route (label | hybrid | bfs | host |
+        cpu) for the per-route breakdown bench and
+        ``keto_stream_route_slices_total`` read."""
+        st = self._route_stats.get(route)
+        if st is None:
+            st = self._route_stats.setdefault(route, DurationStats())
+        st.observe(ms)
+        self._route_slices[route] += 1
+        self._route_queries[route] += nq
+
+    def stream_route_snapshot(self) -> dict:
+        """Per-route stream breakdown: slice/query counts and service-
+        time percentiles per route since the last ``reset_route_stats``
+        (bench's per-route table; the metrics bridge reads the raw
+        counters)."""
+        out = {}
+        for route, st in list(self._route_stats.items()):
+            snap = st.snapshot()
+            out[route] = {
+                "slices": int(self._route_slices.get(route, 0)),
+                "queries": int(self._route_queries.get(route, 0)),
+                "p50_ms": snap["p50_ms"],
+                "p99_ms": snap["p99_ms"],
+                "mean_ms": snap["mean_ms"],
+            }
+        return out
+
+    def route_slice_counts(self) -> dict:
+        """route → landed slice count (the keto_stream_route_slices_total
+        scrape callback)."""
+        return dict(self._route_slices)
+
+    def reset_route_stats(self) -> None:
+        """Zero the per-route breakdown (bench passes start fresh)."""
+        self._route_stats.clear()
+        self._route_slices.clear()
+        self._route_queries.clear()
 
     def _after_batch(self, max_iters: int) -> None:
         # adapt the pull-block size so deep workloads converge within few
@@ -3231,10 +3773,13 @@ class TpuCheckEngine:
             # the eviction ladder dropped the labels between routing and
             # dispatch (concurrent OOM containment): BFS answers instead
             return self._device_batch(snap, sd, tg, multi, i0, i1, W, it_cap=it_cap)
-        packed, host_ans = pack_chunk(snap, sd, tg, multi, i0, i1, W)
+        packed, host_ans = pack_chunk(
+            snap, sd, tg, multi, i0, i1, W, native=self._native_pack
+        )
         nq = i1 - i0
+        leases: list = []
         if packed is None:
-            return None, host_ans  # nothing reaches any device path
+            return None, host_ans, leases  # nothing reaches any device path
         (e1r, e1q, e2r, e2q, ar, aq, targets) = packed
         ni = snap.num_int
         B = 32 * W
@@ -3321,13 +3866,25 @@ class TpuCheckEngine:
             faults.check("device-exec")
             P = _entry_pad(B, pa.size)
             pad = P - pa.size
-            entries = np.concatenate(
-                [
-                    np.concatenate([pa, np.full(pad, ni, np.int64)]),
-                    np.concatenate([pb, np.full(pad, ni, np.int64)]),
-                    np.concatenate([pq, np.zeros(pad, np.int64)]),
-                ]
-            ).astype(np.int32)
+            stg = self._stage_acquire(3 * P) if self._mesh is None else None
+            if stg is not None:
+                leases.append(stg)
+                entries = stg
+                entries[:P] = np.concatenate([pa, np.full(pad, ni, np.int64)])
+                entries[P : 2 * P] = np.concatenate(
+                    [pb, np.full(pad, ni, np.int64)]
+                )
+                entries[2 * P :] = np.concatenate(
+                    [pq, np.zeros(pad, np.int64)]
+                )
+            else:
+                entries = np.concatenate(
+                    [
+                        np.concatenate([pa, np.full(pad, ni, np.int64)]),
+                        np.concatenate([pb, np.full(pad, ni, np.int64)]),
+                        np.concatenate([pq, np.zeros(pad, np.int64)]),
+                    ]
+                ).astype(np.int32)
             dl = self._labels_dev(snap)
             if self._sharded:
                 # row-sharded label arrays + replicated pairs: the kernel
@@ -3345,14 +3902,24 @@ class TpuCheckEngine:
                 if self._multiprocess:
                     from jax.sharding import NamedSharding, PartitionSpec as P_
 
-                    ebuf = jax.device_put(
-                        entries, NamedSharding(self._mesh, P_())
-                    )
+                    def put_pairs():
+                        return jax.device_put(
+                            entries, NamedSharding(self._mesh, P_())
+                        )
+
+                    lkern = _label_kernel
                 else:
-                    ebuf = jnp.asarray(entries)
+                    def put_pairs():
+                        return jnp.asarray(entries)
+
+                    lkern = (
+                        _label_kernel_donated
+                        if self._donate_entries and self._mesh is None
+                        else _label_kernel
+                    )
                 ldev = self._guard_alloc(
                     "label-kernel",
-                    lambda: _label_kernel(dl[0], dl[1], ebuf, n_pairs=P, B=B),
+                    lambda: lkern(dl[0], dl[1], put_pairs(), n_pairs=P, B=B),
                 )
 
         bfs_dev = None
@@ -3366,13 +3933,14 @@ class TpuCheckEngine:
                 j: multi[int(i)] for j, i in enumerate(gidx) if int(i) in multi
             }
             W2 = next(w for w in _WORD_WIDTHS if 32 * w >= pos.size)
-            bfs_dev, _ = self._device_batch(
+            bfs_dev, _, bfs_leases = self._device_batch(
                 snap, sd2, tg2, multi2, 0, pos.size, W2, it_cap=it_cap
             )
+            leases.extend(bfs_leases)
             bfs_pos = pos
         if ldev is None and bfs_dev is None:
-            return None, host_ans
-        return _HybridSlice(ldev, bfs_dev, bfs_pos), host_ans
+            return None, host_ans, leases
+        return _HybridSlice(ldev, bfs_dev, bfs_pos), host_ans, leases
 
     def _device_batch(
         self,
@@ -3385,38 +3953,63 @@ class TpuCheckEngine:
         force_W: Optional[int] = None,
         it_cap: Optional[int] = None,
     ):
+        """Pack + dispatch one sub-chunk. Returns ``(dev, host_ans,
+        leases)`` — ``leases`` are pooled staging buffers the caller MUST
+        release only after the slice lands (``_stage_release``): the H2D
+        copy may complete asynchronously, so earlier reuse could corrupt
+        an in-flight slice."""
         faults.check("device-exec")
-        packed, host_ans = pack_chunk(snap, sd, tg, multi, i0, i1, force_W)
+        packed, host_ans = pack_chunk(
+            snap, sd, tg, multi, i0, i1, force_W, native=self._native_pack
+        )
+        leases: list = []
         if packed is None:
             # no query in the chunk reaches the device: host_ans is the
             # whole answer
-            return None, host_ans
+            return None, host_ans, leases
         if self._sharded and snap.device_shards is not None:
             return (
-                self._dispatch_sharded(snap, packed, it_cap or self._it_cap),
+                self._dispatch_sharded(
+                    snap, packed, it_cap or self._it_cap, leases=leases
+                ),
                 host_ans,
+                leases,
             )
         sharding = self._bitmap_sharding
         if self._mesh is not None:
             W = packed[-1].shape[0] // 32
             if W % self._mesh.shape.get("data", 1):
                 sharding = self._bitmap_sharding_rows_only
-        buf, sizes = pack_entries(packed)
-        if self._multiprocess:
-            # multi-controller runtime: jit inputs must be global arrays;
-            # every process holds identical host data (the lockstep
-            # contract, parallel/mesh.py init_distributed)
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            entries = jax.device_put(buf, NamedSharding(self._mesh, P()))
-        else:
-            entries = jnp.asarray(buf)
+        stg = None
+        if self._mesh is None:
+            stg = self._stage_acquire(sum(a.shape[0] for a in packed))
+            if stg is not None:
+                leases.append(stg)
+        buf, sizes = pack_entries(packed, out=stg)
         ov = snap.device_overlay
+
+        def put_entries():
+            # inside the guarded call: the donated path consumes its
+            # device buffer, so an OOM retry must re-stage from host
+            if self._multiprocess:
+                # multi-controller runtime: jit inputs must be global
+                # arrays; every process holds identical host data (the
+                # lockstep contract, parallel/mesh.py init_distributed)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                return jax.device_put(buf, NamedSharding(self._mesh, P()))
+            return jnp.asarray(buf)
+
+        kern = (
+            _check_kernel_donated
+            if self._donate_entries and self._mesh is None
+            else _check_kernel
+        )
         dev = self._guard_alloc(
             "check-kernel",
-            lambda: _check_kernel(
+            lambda: kern(
                 snap.device_buckets,
-                entries,
+                put_entries(),
                 ov_nbrs=None if ov is None else ov[0],
                 ov_dst=None if ov is None else ov[1],
                 sizes=sizes,
@@ -3428,19 +4021,36 @@ class TpuCheckEngine:
                 bitmap_sharding=sharding,
             ),
         )
-        return dev, host_ans
+        return dev, host_ans, leases
 
-    def _dispatch_sharded(self, snap: GraphSnapshot, packed, it_cap: int):
+    def _dispatch_sharded(
+        self, snap: GraphSnapshot, packed, it_cap: int, leases=None
+    ):
         """Route one packed chunk's entries to their owning shards and
         launch the shard_map BFS kernel (keto_tpu/parallel/sharded.py).
         Returns a ``_ShardedSlice`` whose packed ``uint32[W+3]`` output
         the collect paths decode — decisions bit-identical to the
-        single-device kernel, plus the halo/frontier stats words."""
+        single-device kernel, plus the halo/frontier stats words. The
+        routed entry stack stages through the same pooled-buffer seam as
+        the single-device path (``leases`` collects the buffers for
+        release at land time)."""
         from keto_tpu.parallel import sharded as shard_mod
 
         spec = snap.shard_spec
         B = packed[-1].shape[0]
-        entries, sizes = shard_mod.route_entries(spec, packed, B)
+
+        def out_alloc(shape):
+            if leases is None or self._multiprocess:
+                return None
+            flat = self._stage_acquire(shape[0] * shape[1])
+            if flat is None:
+                return None
+            leases.append(flat)
+            return flat.reshape(shape)
+
+        entries, sizes = shard_mod.route_entries(
+            spec, packed, B, out_alloc=out_alloc
+        )
         ebuf = jax.device_put(entries, self._shard_stack_sharding)
         ov = snap.device_shard_overlay
         dev = self._guard_alloc(

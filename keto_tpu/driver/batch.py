@@ -574,6 +574,18 @@ class CheckBatcher:
                 item.tl.stamp("pack")  # queue wait ended here
             n += item.n
         batch_cap = min(cap - n, self._sub_slice)
+        # service-time-aware sub-slicing: the engine's slice controller
+        # predicts how many queries fit one target-latency slice for the
+        # routes currently in play — a batch sub-slice wider than that
+        # would be split by the engine anyway, so bound the round here
+        # and let the freed capacity interleave the NEXT interactive
+        # round sooner (host-side sizing only: slice geometry on the
+        # device stays the engine's decision, lockstep-safe)
+        cap_fn = getattr(
+            getattr(self._engine, "stream_ctrl", None), "cap", None
+        )
+        if cap_fn is not None:
+            batch_cap = min(batch_cap, max(1, int(cap_fn())))
         while batchq and batch_cap > 0:
             head = batchq[0]
             if head.fut.done():

@@ -71,14 +71,20 @@ RESTORE_FRAC = 0.7
 #: the canonical ledger tags, in scrape order ("build" is the streaming
 #: snapshot pipeline's transient sort footprint — registered around each
 #: device-build dispatch and released before the snapshot installs,
-#: keto_tpu/graph/device_build.py GovernedSorter)
-TAGS = ("snapshot", "overlay", "labels", "reverse", "warmup", "build")
+#: keto_tpu/graph/device_build.py GovernedSorter; "staging" is the
+#: persistent entry-staging pool behind the donated dispatch buffers,
+#: keto_tpu/check/tpu_engine.py _StagingPool — reconciled against the
+#: pool's own accounting at every scrape)
+TAGS = ("snapshot", "overlay", "labels", "reverse", "warmup", "build",
+        "staging")
 
 #: the eviction ladder rung names, in descent order (the final "refuse
 #: the refresh" step is not a rung — it is plan() returning False).
+#: "staging" goes first: dropping the entry-staging pool reverts to
+#: per-slice allocation + device_put — pure churn cost, never coverage.
 #: "reverse" drops the list layouts' device arrays — reverse queries
 #: fall back to the CPU-reference lister bit-identically
-RUNGS = ("labels", "reverse", "warm-ladder", "overlay-budget")
+RUNGS = ("staging", "labels", "reverse", "warm-ladder", "overlay-budget")
 
 
 def device_budget_bytes(

@@ -277,6 +277,15 @@ class Registry:
                     build_chunk_rows=int(
                         self._config.get("serve.build_chunk_rows", 262144)
                     ),
+                    native_pack_enabled=bool(
+                        self._config.get("serve.native_pack_enabled", True)
+                    ),
+                    staging_enabled=bool(
+                        self._config.get("serve.staging_enabled", True)
+                    ),
+                    stream_tail_ratio=float(
+                        self._config.get("serve.stream_tail_ratio", 5.0)
+                    ),
                 )
                 # mirror per-slice service times into /metrics — the same
                 # numbers the adaptive width controller steers by
@@ -841,6 +850,56 @@ class Registry:
             label_coverage,
         )
 
+        # streaming slice scheduler: per-route landing counts, the
+        # observed tail ratio the service-time controller guards, and
+        # which pack path (native C++ vs numpy) built each chunk
+        STREAM_ROUTES = ("label", "hybrid", "bfs", "host", "cpu")
+
+        def route_slices():
+            engine = self.peek("permission_engine")
+            fn = getattr(engine, "route_slice_counts", None)
+            counts = fn() if fn is not None else {}
+            return [((r,), float(counts.get(r, 0))) for r in STREAM_ROUTES]
+
+        m.register_callback(
+            "keto_stream_route_slices_total", "counter",
+            "Streaming check slices landed, by answering route: label "
+            "(intersection kernel only), hybrid (label + BFS sub-batch), "
+            "bfs, host (no device work), cpu (degraded fallback).",
+            route_slices, ("route",),
+        )
+
+        def stream_tail_ratio():
+            engine = self.peek("permission_engine")
+            stats = getattr(engine, "stream_slice_stats", None)
+            snap = stats.snapshot() if stats is not None else None
+            if not snap or not snap.get("p50_ms"):
+                yield (), 0.0
+            else:
+                yield (), float(snap["p99_ms"]) / float(snap["p50_ms"])
+
+        m.register_callback(
+            "keto_stream_tail_ratio", "gauge",
+            "Observed per-slice service-time p99/p50 ratio over the "
+            "engine's sliding window — the number the slice controller's "
+            "tail guard (serve.stream_tail_ratio) steers and the "
+            "tail-smoke CI gate asserts.",
+            stream_tail_ratio,
+        )
+
+        def native_pack_paths():
+            from keto_tpu.check.native_pack import COUNTERS
+
+            return [((p,), float(COUNTERS.get(p, 0))) for p in ("native", "numpy")]
+
+        m.register_callback(
+            "keto_native_pack_chunks_total", "counter",
+            "Check chunks packed per host-walk path: native (GIL-released "
+            "C++ walk, native/pack.cpp) vs numpy (library absent/disabled, "
+            "or the snapshot carries host-visible overlay state).",
+            native_pack_paths, ("path",),
+        )
+
         # streaming snapshot build (keto_tpu/graph/stream_build.py): the
         # live pipeline phase plus cumulative ingest counters, read from
         # the engine's BuildProgress at scrape time — a multi-minute
@@ -954,8 +1013,9 @@ class Registry:
         m.register_callback(
             "keto_hbm_eviction_rung", "gauge",
             "Current eviction-ladder depth: 0 = full service, then "
-            "labels dropped -> warm ladder trimmed -> overlay budget "
-            "shrunk; refresh refusals ride keto_hbm_refusals_total.",
+            "staging pool dropped -> labels dropped -> reverse layouts "
+            "dropped -> warm ladder trimmed -> overlay budget shrunk; "
+            "refresh refusals ride keto_hbm_refusals_total.",
             hbm_scalar("rung"),
         )
 

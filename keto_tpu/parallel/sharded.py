@@ -190,7 +190,7 @@ def _route_rows(
     return out_r, out_q, P
 
 
-def route_entries(spec: ShardSpec, packed, B: int):
+def route_entries(spec: ShardSpec, packed, B: int, out=None, out_alloc=None):
     """Split pack_chunk's seven arrays by row ownership into the sharded
     kernel's single stacked ``int32[g, L]`` entry buffer + static sizes.
 
@@ -198,6 +198,12 @@ def route_entries(spec: ShardSpec, packed, B: int):
     the owner's fixpoint rows; targets become per-shard local rows with
     a not-owned sentinel — every shard receives the full query axis (the
     ``data`` replication) but only its own rows.
+
+    ``out`` (an int32 ``[g, L]`` buffer) or ``out_alloc`` (a
+    ``shape -> buffer|None`` allocator — the engine's staging-pool seam;
+    the stacked width L is only known after routing) receives the
+    concatenation in place, so repeated dispatches reuse one host
+    staging buffer instead of allocating per slice.
     """
     (e1r, e1q, e2r, e2q, ar, aq, targets) = packed
     g, rps, ni = spec.n_shards, spec.rows_per_shard, spec.n_int
@@ -209,7 +215,14 @@ def route_entries(spec: ShardSpec, packed, B: int):
     for s in range(g):
         own = (t >= s * rps) & (t < (s + 1) * rps)
         t_sh[s, own] = (t[own] - s * rps).astype(np.int32)
-    entries = np.concatenate([r1, q1, r2, q2, ra, qa, t_sh], axis=1)
+    parts = [r1, q1, r2, q2, ra, qa, t_sh]
+    if out is None and out_alloc is not None:
+        L = sum(p.shape[1] for p in parts)
+        out = out_alloc((g, L))
+    if out is not None and out.shape == (g, sum(p.shape[1] for p in parts)):
+        entries = np.concatenate(parts, axis=1, out=out)
+    else:
+        entries = np.concatenate(parts, axis=1)
     return np.ascontiguousarray(entries), (S1, S2, SA, t.shape[0])
 
 

@@ -104,7 +104,7 @@ def main() -> int:
         log(f"[mem-smoke] governor after boot: {snap}")
         if snap["rung"] == 0:
             problems.append("budget below footprint but no eviction rung walked")
-        if snap["evicted"][:1] != ["labels"]:
+        if snap["evicted"][:2] != ["staging", "labels"]:
             problems.append(f"ladder order wrong: {snap['evicted']}")
         if snap["forced_allocs"] < 1:
             problems.append("base snapshot was not force-allocated on cold boot")

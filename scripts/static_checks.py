@@ -17,6 +17,7 @@ Exit 0 only when every check that ran passed.
 from __future__ import annotations
 
 import importlib.util
+import json
 import subprocess
 import sys
 from pathlib import Path
@@ -28,6 +29,35 @@ def _have(module: str) -> bool:
     return importlib.util.find_spec(module) is not None
 
 
+def check_spec_canonical() -> int:
+    """spec/api.json must be byte-identical to its canonical
+    serialization (``json.dumps(obj, indent=2, ensure_ascii=True)`` plus
+    a trailing newline). Locking the byte format keeps spec diffs
+    SEMANTIC — an editor or script that re-indents the whole file (as a
+    PR-14 header edit once did) fails here instead of burying the real
+    change under 2000 whitespace lines. Fix-up one-liner:
+
+        python -c "import json; p='spec/api.json'; o=json.load(open(p)); \\
+open(p,'w').write(json.dumps(o, indent=2, ensure_ascii=True) + '\\n')"
+    """
+    path = ROOT / "spec" / "api.json"
+    raw = path.read_text()
+    try:
+        obj = json.loads(raw)
+    except ValueError as e:
+        print(f"spec-canonical: {path} is not valid JSON: {e}")
+        return 1
+    canon = json.dumps(obj, indent=2, ensure_ascii=True) + "\n"
+    if raw != canon:
+        print(
+            "spec-canonical: spec/api.json is not canonically serialized "
+            "(expected json.dumps(obj, indent=2, ensure_ascii=True) + "
+            "newline); re-serialize it so future diffs stay semantic"
+        )
+        return 1
+    return 0
+
+
 def main() -> int:
     results: list[tuple[str, str]] = []
     failed = False
@@ -36,6 +66,10 @@ def main() -> int:
         [sys.executable, str(ROOT / "scripts" / "keto_analyze.py")], cwd=ROOT
     )
     results.append(("keto-analyze", "ok" if rc == 0 else "FAILED"))
+    failed |= rc != 0
+
+    rc = check_spec_canonical()
+    results.append(("spec-canonical", "ok" if rc == 0 else "FAILED"))
     failed |= rc != 0
 
     if _have("ruff"):

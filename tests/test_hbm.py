@@ -4,8 +4,9 @@ shadow-parity auditor (keto_tpu/driver/hbm.py + the engine seams).
 The contract under test, end to end:
 
 - a budget forced below the device footprint walks the DETERMINISTIC
-  eviction ladder (drop labels -> trim the warm width ladder -> shrink
-  the overlay budget -> refuse the refresh and serve stale +
+  eviction ladder (drop the entry-staging pool -> drop labels -> drop
+  reverse layouts -> trim the warm width ladder -> shrink the overlay
+  budget -> refuse the refresh and serve stale +
   DEGRADED(memory_pressure)) with decision parity vs the CPU oracle
   after EVERY rung — coverage and throughput degrade, answers never;
 - pressure clearing walks back UP the ladder (labels rebuilt, widths
@@ -201,9 +202,10 @@ def test_tiny_budget_walks_every_rung_with_decision_parity(make_persister):
         assert engine.batch_check(queries) == expected
         snap = engine.hbm.snapshot()
         assert snap["evicted"] == [
-            "labels", "reverse", "warm-ladder", "overlay-budget",
+            "staging", "labels", "reverse", "warm-ladder", "overlay-budget",
         ]
         assert snap["forced_allocs"] >= 1
+        assert engine._staging_suspended
         assert engine._labels_suspended
         assert engine._snapshot.labels is None
         # rung 2 trimmed the compile-width ladder
@@ -226,11 +228,13 @@ def test_rungs_walk_stepwise_and_recover_when_pressure_clears(make_persister):
         assert led.get("labels", 0) > 0, "labels should be resident at a sane budget"
         resident = engine.hbm.resident_bytes()
 
-        # budget just below residency: planning the next (identical)
-        # snapshot swap must shed labels first — and answers hold
-        engine.hbm.set_budget_bytes(resident - 1)
+        # budget just below residency minus what the staging rung could
+        # free: planning the next (identical) snapshot swap must shed
+        # staging AND labels — and answers hold
+        engine.hbm.set_budget_bytes(resident - led.get("staging", 0) - 1)
         assert engine.hbm.plan(led["snapshot"], what="test swap")
-        assert engine.hbm.rung_depth >= 1
+        assert engine.hbm.rung_depth >= 2
+        assert engine._staging_suspended
         assert engine._labels_suspended
         assert engine.batch_check(queries) == expected
 

@@ -25,6 +25,19 @@ from keto_tpu.driver.registry import Registry
 
 SPEC = json.loads((Path(__file__).resolve().parents[1] / "spec" / "api.json").read_text())
 
+
+def test_spec_serialization_is_canonical():
+    """spec/api.json stays byte-identical to its canonical dump (indent
+    2, ensure_ascii, trailing newline) so spec diffs are always semantic
+    — a whole-file re-indent (as a PR-14 header edit once produced) can
+    never land again. scripts/static_checks.py gates the same invariant
+    in CI."""
+    raw = (Path(__file__).resolve().parents[1] / "spec" / "api.json").read_text()
+    assert raw == json.dumps(SPEC, indent=2, ensure_ascii=True) + "\n", (
+        "spec/api.json is not canonically serialized; re-dump it with "
+        "json.dumps(obj, indent=2, ensure_ascii=True) + newline"
+    )
+
 NAMESPACES = [{"id": 0, "name": "files"}, {"id": 1, "name": "teams"}]
 
 
