@@ -17,6 +17,10 @@ Prints ONE JSON line:
    "vs_baseline": ratio, ...detail fields}
 
 Env knobs: BENCH_TUPLES (~1e6), BENCH_CHECKS (1e5), BENCH_ORACLE_SAMPLE (2000).
+Write path (run_write_path): BENCH_WRITE (=0 skips), BENCH_WRITE_WRITERS
+("1,8,64"), BENCH_WRITE_S (seconds per round), BENCH_WRITE_OBJS,
+BENCH_WRITE_WINDOW_MS, BENCH_WRITE_OVERLAY_BUDGET, BENCH_WRITE_FOLD_SEGMENT,
+BENCH_WRITE_CHECK_RATE, BENCH_WRITE_ORACLE_SAMPLE.
 """
 
 from __future__ import annotations
@@ -1650,6 +1654,338 @@ def run_overload(rng):
     return out
 
 
+def run_write_path(rng):
+    """Group-commit write-path rounds against a live daemon on a REAL
+    sqlite store (fsync is the cost being amortized): sustained
+    closed-loop keyed writes/s through PATCH /relation-tuples at
+    1/8/64 concurrent writers with ack p50/p99, an interactive check
+    probe's p99 while the top-writer-count storm runs, the background
+    fold rate that bounds overlay occupancy, and a per-commit baseline
+    (serve.group_commit_enabled: false) at the top writer count on an
+    identical store. Every decision sampled at the end must match the
+    CPU oracle reading the same store."""
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from keto_tpu.check import CheckEngine
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID
+
+    writer_counts = [
+        int(w) for w in os.environ.get("BENCH_WRITE_WRITERS", "1,8,64").split(",")
+    ]
+    dur = float(os.environ.get("BENCH_WRITE_S", 3.0))
+    n_objs = int(os.environ.get("BENCH_WRITE_OBJS", 500))
+    check_rate_hz = float(os.environ.get("BENCH_WRITE_CHECK_RATE", 40.0))
+    oracle_sample = int(os.environ.get("BENCH_WRITE_ORACLE_SAMPLE", 200))
+
+    def boot(tag, grouped):
+        d = tempfile.mkdtemp(prefix=f"bench-write-{tag}-")
+        cfg = Config(
+            overrides={
+                "namespaces": [{"id": 0, "name": "acl"}],
+                "dsn": f"sqlite://{d}/store.db",
+                "serve.read.port": 0,
+                "serve.write.port": 0,
+                "serve.group_commit_enabled": grouped,
+                "serve.group_commit_window_ms": float(
+                    os.environ.get("BENCH_WRITE_WINDOW_MS", 2.0)
+                ),
+                # small budget + segment so folds actually run within a
+                # seconds-long storm (the fold-rate number is the point)
+                "serve.overlay_edge_budget": int(
+                    os.environ.get("BENCH_WRITE_OVERLAY_BUDGET", 512)
+                ),
+                "serve.fold_segment_edges": int(
+                    os.environ.get("BENCH_WRITE_FOLD_SEGMENT", 256)
+                ),
+                "log.level": "error",
+            }
+        )
+        daemon = Daemon(Registry(cfg))
+        daemon.serve_all(block=False)
+        store = daemon.registry.relation_tuple_manager()
+        store.write_relation_tuples(
+            *[
+                RelationTuple(
+                    namespace="acl", object=f"obj-{i}", relation="access",
+                    subject=SubjectID(f"user-{i}"),
+                )
+                for i in range(n_objs)
+            ]
+        )
+        # warm: snapshot + jit before any measured round
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.read_port}/check?namespace=acl"
+            f"&object=obj-0&relation=access&subject_id=user-0",
+            timeout=60,
+        ).read()
+        return daemon
+
+    def storm(daemon, n_writers, tag, probe=False):
+        """Closed-loop writers for ``dur`` seconds; returns the round's
+        report. Writers drive ``registry.transact_writes()`` — the exact
+        callable the REST/gRPC write handlers invoke — rather than HTTP:
+        on a GIL-bound Python HTTP server the transport is the ceiling
+        at high writer counts and would mask the store's commit
+        behavior, which is the thing under measurement. Every write is
+        keyed (the retry contract stays on) and inserts a distinct
+        tuple, so the delta stream is all real work. The interactive
+        check probe DOES go through REST — its tail under storm is an
+        end-to-end number."""
+        txn = daemon.registry.transact_writes()
+        rurl = f"http://127.0.0.1:{daemon.read_port}"
+        stop = [False]
+        lat, errs = [], []
+        lock = threading.Lock()
+
+        def writer(wi):
+            r = random.Random(9000 + wi)
+            mine, bad, i = [], 0, 0
+            while not stop[0]:
+                o = r.randrange(n_objs)
+                t = RelationTuple(
+                    namespace="acl", object=f"obj-{o}", relation="access",
+                    subject=SubjectID(f"{tag}-w{wi}-{i}"),
+                )
+                t0 = time.perf_counter()
+                try:
+                    txn([t], [], idempotency_key=f"{tag}-w{wi}-{i}")
+                    mine.append(time.perf_counter() - t0)
+                except Exception:
+                    bad += 1
+                i += 1
+            with lock:
+                lat.extend(mine)
+                errs.append(bad)
+
+        check_lat, check_bad = [], [0]
+
+        def prober():
+            r = random.Random(77)
+            while not stop[0]:
+                o = r.randrange(n_objs)
+                t0 = time.perf_counter()
+                try:
+                    with urllib.request.urlopen(
+                        f"{rurl}/check?namespace=acl&object=obj-{o}"
+                        f"&relation=access&subject_id=user-{o}",
+                        timeout=60,
+                    ) as resp:
+                        resp.read()
+                    check_lat.append(time.perf_counter() - t0)
+                except urllib.error.HTTPError as e:
+                    e.read()  # 403 = a definitive denial, still a served check
+                    if e.code == 403:
+                        check_lat.append(time.perf_counter() - t0)
+                    else:
+                        check_bad[0] += 1
+                except Exception:
+                    check_bad[0] += 1
+                time.sleep(max(0.0, 1.0 / check_rate_hz - (time.perf_counter() - t0)))
+
+        threads = [
+            threading.Thread(target=writer, args=(wi,)) for wi in range(n_writers)
+        ]
+        if probe:
+            threads.append(threading.Thread(target=prober))
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(dur)
+        stop[0] = True
+        for t in threads:
+            t.join(timeout=120)
+        wall = time.perf_counter() - t_start
+        n = len(lat)
+        out = {
+            "writers": n_writers,
+            "writes": n,
+            "writes_per_s": round(n / wall, 1),
+            "write_errors": sum(errs),
+            "ack": _pctls(lat),
+        }
+        if probe:
+            out["check_under_storm"] = {
+                **_pctls(check_lat),
+                "checks": len(check_lat),
+                "check_errors": check_bad[0],
+            }
+        return out
+
+    out = {"duration_s": dur}
+
+    # store-layer amortization, single-threaded (no scheduler/GIL noise,
+    # no serving engine): N keyed solo commits vs the same N writes in
+    # transact_many groups of the top writer count on a fresh sqlite
+    # store — the per-commit cost (BEGIN/COMMIT+fsync + per-statement
+    # round trips) the group path amortizes. This is the number the
+    # docs/concepts/performance.md microbenchmark note cites; the
+    # daemon rounds below measure the closed-loop end-to-end version.
+    from keto_tpu import namespace as namespace_pkg
+    from keto_tpu.persistence.sqlite import SQLitePersister
+    from keto_tpu.relationtuple.manager import TransactWrite
+
+    group_n = writer_counts[-1]
+    n_micro = int(os.environ.get("BENCH_WRITE_MICRO_N", 512))
+    n_micro -= n_micro % group_n or group_n  # whole groups
+    micro = {}
+    for mode in ("serial", "grouped"):
+        d = tempfile.mkdtemp(prefix=f"bench-write-micro-{mode}-")
+        store = SQLitePersister(
+            f"sqlite://{d}/m.db",
+            namespace_pkg.MemoryManager([namespace_pkg.Namespace(id=0, name="acl")]),
+        )
+        try:
+            t0 = time.perf_counter()
+            if mode == "serial":
+                for i in range(n_micro):
+                    store.transact_relation_tuples(
+                        [
+                            RelationTuple(
+                                namespace="acl", object=f"o{i % n_objs}",
+                                relation="access", subject=SubjectID(f"m{i}"),
+                            )
+                        ],
+                        [],
+                        idempotency_key=f"m{i}",
+                    )
+            else:
+                for b in range(n_micro // group_n):
+                    store.transact_many(
+                        [
+                            TransactWrite(
+                                insert=(
+                                    RelationTuple(
+                                        namespace="acl",
+                                        object=f"o{(b * group_n + j) % n_objs}",
+                                        relation="access",
+                                        subject=SubjectID(f"m{b * group_n + j}"),
+                                    ),
+                                ),
+                                idempotency_key=f"m{b * group_n + j}",
+                            )
+                            for j in range(group_n)
+                        ]
+                    )
+            micro[mode] = round(n_micro / (time.perf_counter() - t0), 1)
+        finally:
+            store.close()
+    out["store_amortization"] = {
+        "writes": n_micro,
+        "group_size": group_n,
+        "serial_writes_per_s": micro["serial"],
+        "grouped_writes_per_s": micro["grouped"],
+        "speedup": round(micro["grouped"] / max(1e-9, micro["serial"]), 1),
+    }
+    log(
+        f"[write] store amortization (groups of {group_n}, sqlite): "
+        f"{micro['grouped']:,.0f} vs {micro['serial']:,.0f} writes/s = "
+        f"{out['store_amortization']['speedup']}x"
+    )
+
+    # per-commit baseline at the TOP writer count: same store, same
+    # serving daemon, same interactive probe (the engine maintenance it
+    # drives is part of both rounds), one BEGIN/COMMIT+fsync per write
+    daemon = boot("base", grouped=False)
+    try:
+        out["baseline"] = storm(daemon, writer_counts[-1], "base", probe=True)
+        daemon.drain_and_shutdown()
+    finally:
+        daemon.shutdown()
+    log(
+        f"[write] baseline ({writer_counts[-1]} writers, per-commit): "
+        f"{out['baseline']['writes_per_s']:,.0f} writes/s "
+        f"ack p50={out['baseline']['ack']['p50_ms']} ms "
+        f"p99={out['baseline']['ack']['p99_ms']} ms"
+    )
+
+    # grouped rounds: 1/8/64 writers on one daemon (store state carries
+    # across rounds like a real instance's lifetime)
+    daemon = boot("grp", grouped=True)
+    try:
+        rounds = []
+        for w in writer_counts:
+            rep = storm(daemon, w, f"g{w}", probe=(w == writer_counts[-1]))
+            rounds.append(rep)
+            log(
+                f"[write] grouped {w} writers: {rep['writes_per_s']:,.0f} writes/s "
+                f"ack p50={rep['ack']['p50_ms']} ms p99={rep['ack']['p99_ms']} ms"
+            )
+        out["grouped"] = rounds
+
+        co = daemon.registry.peek("group_commit")
+        if co is not None:
+            out["coordinator"] = {
+                "flush_total": co.flush_total,
+                "writers_total": co.writers_total,
+                "mean_batch": round(co.writers_total / max(1, co.flush_total), 2),
+                "flush_errors": co.flush_errors,
+            }
+
+        # maintenance view: fold rate + final occupancy vs the hard cap
+        engine = daemon.registry.peek("permission_engine")
+        if engine is not None and hasattr(engine, "maintenance"):
+            m = engine.maintenance.snapshot()
+            out["maintenance"] = {
+                "fold_runs": m.get("fold_runs", 0),
+                "fold_runs_per_s": round(
+                    m.get("fold_runs", 0) / max(1e-9, dur * len(writer_counts)), 2
+                ),
+                "overlay_device_applies": m.get("overlay_device_applies", 0),
+                "compactions": m.get("compactions", 0),
+                "overlay_edges": m.get("overlay_edges", 0),
+                "overlay_budget": m.get("overlay_budget", 0),
+            }
+
+        # parity: sampled decisions vs the CPU oracle on the same store
+        store = daemon.registry.relation_tuple_manager()
+        oracle = CheckEngine(store)
+        r = random.Random(4242)
+        mismatches = 0
+        base = f"http://127.0.0.1:{daemon.read_port}"
+        for _ in range(oracle_sample):
+            o = r.randrange(n_objs)
+            u = f"user-{r.randrange(n_objs)}"
+            try:
+                with urllib.request.urlopen(
+                    f"{base}/check?namespace=acl&object=obj-{o}"
+                    f"&relation=access&subject_id={u}",
+                    timeout=60,
+                ) as resp:
+                    got = json.loads(resp.read())["allowed"]
+            except urllib.error.HTTPError as e:  # 403 carries the body too
+                got = json.loads(e.read())["allowed"]
+            want = oracle.subject_is_allowed(
+                RelationTuple(
+                    namespace="acl", object=f"obj-{o}", relation="access",
+                    subject=SubjectID(u),
+                )
+            )
+            mismatches += got != want
+        out["oracle_sample"] = oracle_sample
+        out["oracle_mismatches"] = mismatches
+        daemon.drain_and_shutdown()
+    finally:
+        daemon.shutdown()
+
+    top = out["grouped"][-1]
+    out["speedup_vs_per_commit"] = round(
+        top["writes_per_s"] / max(1e-9, out["baseline"]["writes_per_s"]), 1
+    )
+    log(
+        f"[write] group-commit speedup at {writer_counts[-1]} writers: "
+        f"{out['speedup_vs_per_commit']}x "
+        f"({top['writes_per_s']:,.0f} vs {out['baseline']['writes_per_s']:,.0f} "
+        f"writes/s); oracle mismatches: {mismatches}/{oracle_sample}"
+    )
+    return out
+
+
 def run_reverse_query(rng):
     """Reverse-query rounds against a live daemon: ListObjects /
     ListSubjects latency (p50/p99 measured at the REST surface) and
@@ -2381,6 +2717,17 @@ def main():
             log(f"[overload] FAILED: {e!r}")
             overload = {"error": repr(e)}
 
+    # write path: group-commit writes/s at 1/8/64 writers vs the
+    # per-commit baseline, ack + check-under-storm tails, fold rate
+    # (failures degrade to an error field)
+    write_path = None
+    if os.environ.get("BENCH_WRITE", "1") != "0":
+        try:
+            write_path = run_write_path(random.Random(8042))
+        except Exception as e:  # pragma: no cover - diagnostic path
+            log(f"[write] FAILED: {e!r}")
+            write_path = {"error": repr(e)}
+
     # depth tax sweep: the 2-hop label fast path vs the BFS loop at
     # depth 2/4/8/16 (failures degrade to an error field)
     depth_sweep = None
@@ -2513,6 +2860,7 @@ def main():
                     "scrape_overhead": scrape_overhead,
                     "timeline_overhead": timeline_overhead,
                     "overload": overload,
+                    "write_path": write_path,
                     "slice_tail": slice_tail,
                     "depth_sweep": depth_sweep,
                     "reverse_query": reverse_query,

@@ -1066,6 +1066,7 @@ class TpuCheckEngine:
         lockstep_verify: bool = True,
         stream_slice_target_ms: float = 40.0,
         overlay_edge_budget: int = 4096,
+        fold_segment_edges: int = 2048,
         snapshot_cache_dir: Optional[str] = None,
         degraded_probe_s: float = 5.0,
         device_error_threshold: int = 3,
@@ -1193,6 +1194,27 @@ class TpuCheckEngine:
         self.maintenance.set_gauge("overlay_edges", 0)
         self._peel_seed_cap = peel_seed_cap
         self._overlay_born: Optional[float] = None
+        # log-structured snapshot maintenance: the engine keeps the last
+        # overlay-free snapshot (_fold_base) plus the ordered delta
+        # segments applied since ((base_id, wm, ops) triples) — a fold
+        # pass replays the OLDEST segments into the base and compacts
+        # just those, bounded per pass by fold_segment_edges, while new
+        # writes keep landing in the newest segment. Overlay occupancy
+        # is bounded by fold rate instead of a hard budget trip, and the
+        # serving path never pays a compaction wall.
+        self._fold_segment_edges = max(1, int(fold_segment_edges))
+        self._fold_base: Optional[GraphSnapshot] = None
+        self._seg_log: list = []
+        self._pending_seg = None
+        # host mirror of the device-resident overlay pack ([K, C] gather
+        # matrix + dst vector, slot map, per-row fill): committed delta
+        # edges scatter into the resident arrays (functional .at[].set)
+        # instead of re-packing and re-uploading the whole matrix; a
+        # delta that outgrows the capacity falls back to a full re-pack
+        # with pow2 headroom. Single-device path only — mesh/sharded
+        # placements re-route and re-upload (their stacked layouts are
+        # rebuilt host-side anyway).
+        self._ov_pack: Optional[dict] = None
         # supervised maintenance (x/supervise.py): refresh and cache-save
         # run under crash-containing workers with jittered backoff and
         # crash counters instead of ad-hoc threads that die silently;
@@ -1416,9 +1438,11 @@ class TpuCheckEngine:
                     got = None
                 if got is not None:
                     if self._overlay_edge_count(got) > self._max_overlay_edges:
-                        # serve fresh NOW; fold the oversized overlay into
-                        # the base layout off the serving path
-                        self._kick_background_refresh(force_full=True)
+                        # serve fresh NOW; the supervised worker folds the
+                        # oldest overlay segments off the serving path
+                        # (bounded per pass — occupancy is governed by
+                        # fold rate, not a synchronous compaction wall)
+                        self._kick_background_refresh()
                     return got
             finally:
                 self._lock.release()
@@ -1979,9 +2003,20 @@ class TpuCheckEngine:
         wm = self._store.watermark()
         if snap is None and self._cache_dir is not None and not delta_only:
             snap = self._load_cache_locked(wm)
+        # an over-budget overlay owes a fold even when the snapshot is
+        # already current: the maintenance pass falls through to the
+        # delta path (an empty delta) so the fold below runs — serving
+        # callers keep the early return and never pay it
+        needs_fold = (
+            snap is not None
+            and snap.has_overlay
+            and self._in_maintenance_pass
+            and not delta_only
+            and self._overlay_edge_count(snap) > self._max_overlay_edges
+        )
         if snap is not None and snap.snapshot_id == wm and not (
             force_full and snap.has_overlay
-        ):
+        ) and not needs_fold:
             self._behind_since = None
             if self._in_maintenance_pass and not delta_only:
                 # an already-current engine has no install step, so the
@@ -1999,27 +2034,47 @@ class TpuCheckEngine:
         if snap is not None:
             new = self._try_delta(snap, wild_ns_ids)
             if new is not None:
+                # segment log: record the delta for the background fold
+                # (append-at-install would be cleaner, but the fold below
+                # needs the newest segment already on the log; a failed
+                # install leaves a dangling entry the continuity check in
+                # _fold_locked detects and discards)
+                seg, self._pending_seg = self._pending_seg, None
+                if seg is not None and (seg[2] or seg[0] != seg[1]):
+                    self._seg_log.append(seg)
+                if len(self._seg_log) > 4096:
+                    # runaway log (fold persistently losing to the write
+                    # rate): drop the replay history; the next fold runs
+                    # as one full compaction
+                    self._fold_base, self._seg_log = None, []
                 self.maintenance.incr("delta_applies")
                 n_ov = self._overlay_edge_count(new)
                 self.maintenance.set_gauge("overlay_edges", n_ov)
                 over = force_full or n_ov > self._max_overlay_edges
                 if over and new.has_overlay and not delta_only:
-                    try:
-                        compacted = self._compact_locked(new)
-                    except Exception:
-                        # a broken compaction must not kill the refresh:
-                        # count it, log it, and let the full rebuild
-                        # below re-establish a clean base layout
-                        self.maintenance.incr("compaction_failures")
-                        _log.warning(
-                            "overlay compaction failed; falling back to a full rebuild",
-                            exc_info=True,
-                        )
-                        compacted = None
-                    if compacted is not None:
-                        new = compacted
-                    elif force_full or n_ov > self._max_overlay_edges:
-                        new = None  # fold requires a real re-layout
+                    if not self._in_maintenance_pass:
+                        # serving caller tripped the budget: NEVER fold on
+                        # the caller's thread — install the oversized
+                        # overlay (the hard cap in _try_delta still bounds
+                        # it) and let the supervised worker fold it
+                        self._refresh_task.kick()
+                    else:
+                        try:
+                            folded = self._fold_locked(new, full=force_full)
+                        except Exception:
+                            # a broken fold must not kill the refresh:
+                            # count it, log it, and let the full rebuild
+                            # below re-establish a clean base layout
+                            self.maintenance.incr("compaction_failures")
+                            _log.warning(
+                                "overlay fold failed; falling back to a full rebuild",
+                                exc_info=True,
+                            )
+                            folded = None
+                        if folded is not None:
+                            new = folded
+                        elif force_full or n_ov > self._max_overlay_edges:
+                            new = None  # fold requires a real re-layout
         if new is None:
             if delta_only:
                 return None
@@ -2065,7 +2120,18 @@ class TpuCheckEngine:
         if new.has_overlay:
             if self._overlay_born is None:
                 self._overlay_born = time.monotonic()
+            if (
+                self._in_maintenance_pass
+                and self._overlay_edge_count(new) > self._max_overlay_edges
+            ):
+                # a bounded fold left the overlay over budget: fold more
+                # next pass (each pass retires at least one segment, so
+                # this converges whenever writes pause)
+                self._refresh_task.kick()
         else:
+            # overlay-free install: this snapshot is the new fold base
+            # and the segment history behind it is retired
+            self._fold_base, self._seg_log = new, []
             self._overlay_born = None
             self.maintenance.set_gauge("overlay_edges", 0)
             self._kick_cache_save(new)
@@ -2121,7 +2187,12 @@ class TpuCheckEngine:
         if n_ov > max(4 * self._max_overlay_edges, 65536):
             return None
         faults.check("overlay-apply")
-        return apply_delta(base, ops, new_wm, wild_ns_ids)
+        got = apply_delta(base, ops, new_wm, wild_ns_ids)
+        if got is not None:
+            # stash the raw segment for the log-structured fold: the
+            # caller appends it to the segment log with the delta
+            self._pending_seg = (int(base.snapshot_id), int(new_wm), list(ops))
+        return got
 
     def _compact_locked(self, snap: GraphSnapshot) -> Optional[GraphSnapshot]:
         """Fold ``snap``'s overlay into its base layout (caller holds the
@@ -2131,6 +2202,9 @@ class TpuCheckEngine:
         from keto_tpu.graph.compaction import compact_snapshot
 
         faults.check("compaction")
+        # the compacted snapshot gets a fresh (usually empty) overlay —
+        # the resident device pack no longer matches any lineage
+        self._ov_pack = None
         t0 = time.monotonic()
         # flush pending device-bucket patches first: compaction reuses
         # untouched device buckets, which is only sound when they agree
@@ -2179,6 +2253,79 @@ class TpuCheckEngine:
             ms, len(got.touched_buckets),
         )
         return new
+
+    def _fold_locked(
+        self, snap: GraphSnapshot, full: bool = False
+    ) -> Optional[GraphSnapshot]:
+        """Log-structured fold (caller holds the lock): replay the OLDEST
+        delta segments onto the last overlay-free base, compact just
+        those, then re-apply the remaining segments — so a fold pass
+        costs ``fold_segment_edges`` worth of work no matter how large
+        the overlay has grown, and new writes keep landing in the newest
+        segment meanwhile. With ``full`` (the quiet-overlay timer path)
+        every segment folds in one pass. Returns the refreshed snapshot
+        (which may still carry the newest segments' overlay), or None
+        when the overlay's shape needs the full-rebuild fallback."""
+        from keto_tpu.graph.overlay import apply_delta
+
+        fb, log = self._fold_base, self._seg_log
+        # continuity: the log must replay fb → snap exactly (a crashed
+        # install or a direct _snapshot swap leaves gaps — detect, drop
+        # the history, and fold everything at once)
+        intact = (
+            fb is not None
+            and log
+            and log[0][0] == fb.snapshot_id
+            and log[-1][1] == snap.snapshot_id
+            and all(log[i][1] == log[i + 1][0] for i in range(len(log) - 1))
+        )
+        if not intact:
+            got = self._compact_locked(snap)
+            if got is not None and not got.has_overlay:
+                self._fold_base, self._seg_log = got, []
+            return got
+        if full:
+            take = len(log)
+        else:
+            take, tot = 0, 0
+            while take < len(log) and (
+                take == 0 or tot + len(log[take][2]) <= self._fold_segment_edges
+            ):
+                tot += len(log[take][2])
+                take += 1
+        prefix, rest = log[:take], log[take:]
+        t0 = time.monotonic()
+        wild_ns_ids = frozenset(
+            n.id for n in self._nm().namespaces() if n.name == ""
+        )
+        mid = fb
+        for _base_id, seg_wm, ops in prefix:
+            mid = apply_delta(mid, ops, seg_wm, wild_ns_ids)
+            if mid is None:
+                return None  # segment needs a re-layout — full rebuild
+            # flush each segment's device-bucket patches before stacking
+            # the next (apply_delta replaces, not extends, ell_patch)
+            self._apply_ell_patch(mid)
+        new_base = self._compact_locked(mid) if mid.has_overlay else mid
+        if new_base is None or new_base.has_overlay:
+            return None
+        cur = new_base
+        for _base_id, seg_wm, ops in rest:
+            cur = apply_delta(cur, ops, seg_wm, wild_ns_ids)
+            if cur is None:
+                return None
+            self._apply_ell_patch(cur)
+        self._fold_base, self._seg_log = new_base, rest
+        # the replayed overlay is a different lineage than the resident
+        # device pack — force a re-pack on the upload below
+        self._ov_pack = None
+        self.maintenance.incr("fold_runs")
+        self.maintenance.observe_ms("fold", (time.monotonic() - t0) * 1e3)
+        _log.info(
+            "overlay fold: %d/%d segments folded in %.1f ms (%d remain)",
+            take, take + len(rest), (time.monotonic() - t0) * 1e3, len(rest),
+        )
+        return cur
 
     # -- snapshot cache ------------------------------------------------------
 
@@ -2396,16 +2543,118 @@ class TpuCheckEngine:
         self.hbm.register("snapshot", need)
         self.hbm.register_shards("snapshot", spec.owned_bucket_bytes)
 
+    def _apply_overlay_delta(self, snap: GraphSnapshot, delta) -> bool:
+        """Scatter one delta's added/dropped overlay-ELL edges into the
+        device-RESIDENT gather matrix (functional ``.at[].set`` — the
+        base snapshot's arrays stay untouched for in-flight batches).
+        True when the delta landed; False when it can't (no resident
+        pack, lineage mismatch, or capacity outgrown) and the caller
+        must re-pack from scratch. Layout invariants the kernel needs:
+        one row per destination, holes are the ``num_int`` sentinel
+        (all-zero bitmap row, OR-neutral), pad rows scatter-drop via
+        ``num_active`` — row order is irrelevant to the OR-gather."""
+        pack = self._ov_pack
+        if pack is None or delta is None:
+            return False
+        base_id, added, dropped = delta
+        if pack["snap_id"] != base_id:
+            return False
+        nbrs, dst = pack["nbrs"], pack["dst"]
+        K, C = nbrs.shape
+        slot, row_of, fill = pack["slot"], pack["row_of"], pack["fill"]
+        rows: list = []
+        cols: list = []
+        vals: list = []
+        drows: list = []
+        dvals: list = []
+        num_int = snap.num_int
+        # host mirror mutates as we go: any bail past this point must
+        # invalidate the pack (the re-pack rebuilds it from ov_ell)
+        for s, d in dropped:
+            rc = slot.pop((s, d), None)
+            if rc is None:
+                self._ov_pack = None
+                return False
+            r, c = rc
+            nbrs[r, c] = num_int
+            rows.append(r)
+            cols.append(c)
+            vals.append(num_int)
+        for s, d in added:
+            r = row_of.get(d)
+            if r is None:
+                r = pack["rows_used"]
+                if r >= K:
+                    self._ov_pack = None
+                    return False  # destination rows outgrew capacity
+                pack["rows_used"] = r + 1
+                row_of[d] = r
+                fill[r] = 0
+                dst[r] = d
+                drows.append(r)
+                dvals.append(d)
+            c = int(fill[r])
+            if c >= C:
+                self._ov_pack = None
+                return False  # a row outgrew its column capacity
+            fill[r] = c + 1
+            nbrs[r, c] = s
+            slot[(s, d)] = (r, c)
+            rows.append(r)
+            cols.append(c)
+            vals.append(s)
+        dev_n, dev_d = pack["dev"]
+
+        def patch():
+            out_n, out_d = dev_n, dev_d
+            if rows:
+                out_n = out_n.at[
+                    np.asarray(rows, np.int32), np.asarray(cols, np.int32)
+                ].set(jnp.asarray(np.asarray(vals, np.int32)))
+            if drows:
+                out_d = out_d.at[np.asarray(drows, np.int32)].set(
+                    jnp.asarray(np.asarray(dvals, np.int32))
+                )
+            return out_n, out_d
+
+        try:
+            got = self._guard_alloc("overlay-apply", patch)
+        except Exception:
+            # host mirror already moved — never reuse it
+            self._ov_pack = None
+            raise
+        pack["dev"] = got
+        pack["snap_id"] = int(snap.snapshot_id)
+        snap.device_overlay = got
+        snap.device_shard_overlay = None
+        self.hbm.register("overlay", int(nbrs.nbytes + dst.nbytes))
+        self.maintenance.incr("overlay_device_applies")
+        return True
+
     def _upload_overlay(self, snap: GraphSnapshot) -> None:
         """Group overlay-ELL edges by destination into a [K, C] gather
         matrix (pow2-padded so repeated small deltas reuse compiled
-        geometries) and place it on device."""
+        geometries) and place it on device. On the single-device path a
+        delta whose edges fit the resident matrix's capacity scatters
+        into it in place (one tiny ``.at[].set`` — no host re-pack, no
+        full re-upload): the group-commit write path applies committed
+        edges device-resident instead of mirroring every group through
+        host numpy."""
+        delta = snap.ov_ell_delta
+        snap.ov_ell_delta = None
         if snap.ov_ell is None or snap.ov_ell.shape[0] == 0:
+            self._ov_pack = None
             snap.device_overlay = None
             snap.device_shard_overlay = None
             self.hbm.register("overlay", 0)
             if self._sharded:
                 self.hbm.register_shards("overlay", [0] * self._shard_count)
+            return
+        if (
+            not self._sharded
+            and self._mesh is None
+            and self._apply_overlay_delta(snap, delta)
+        ):
             return
         from keto_tpu.graph.overlay import overlay_device_bytes
 
@@ -2458,6 +2707,27 @@ class TpuCheckEngine:
                 "overlay-upload",
                 lambda: (jax.device_put(nbrs), jax.device_put(dst_pad)),
             )
+            # host mirror of the resident pack: later deltas scatter into
+            # the spare pow2 capacity instead of re-packing (fill is the
+            # next free column per row — tombstoned slots become sentinel
+            # holes, harmless to the OR-gather, reclaimed at the next
+            # re-pack or fold)
+            fill = np.zeros(K, np.int64)
+            fill[: counts.shape[0]] = counts
+            self._ov_pack = {
+                "snap_id": int(snap.snapshot_id),
+                "nbrs": nbrs,
+                "dst": dst_pad,
+                "dev": snap.device_overlay,
+                "row_of": {int(d): i for i, d in enumerate(uniq)},
+                "fill": fill,
+                "rows_used": int(uniq.shape[0]),
+                "slot": {
+                    (int(src[s0 + j]), int(uniq[i])): (i, j)
+                    for i, (s0, c) in enumerate(zip(starts, counts))
+                    for j in range(int(c))
+                },
+            }
         else:
             snap.device_overlay = self._guard_alloc(
                 "overlay-upload",

@@ -125,6 +125,36 @@ CONFIG_SCHEMA = {
                     "default": 64,
                     "description": "Floor of the AIMD admission window (queued batch-lane tuples): even in deep overload this much batch work stays admitted, so the lane drains and recovery is observable.",
                 },
+                "group_commit_enabled": {
+                    "type": "boolean",
+                    "default": True,
+                    "description": "Group-commit write path: concurrent write transactions coalesce in the driver's commit coordinator and commit as ONE durable SQL transaction (batched executemany row inserts, one fsync), with per-writer snaptokens, idempotency keys, and traceparents preserved — each writer still gets its own replayable key row and its own token from the group's commit sequence. false pins every write to its own BEGIN/COMMIT (the pre-group-commit behavior).",
+                },
+                "group_commit_max_writers": {
+                    "type": "integer",
+                    "default": 128,
+                    "description": "Group-commit size cap: at most this many writers coalesce into one durable transaction. The coordinator flushes at this size or at group_commit_window_ms, whichever lands first; larger groups amortize the commit cost further but lengthen the failure blast radius (every writer in a failed group sees the same error and retries).",
+                },
+                "group_commit_window_ms": {
+                    "type": "number",
+                    "default": 2.0,
+                    "description": "Group-commit coalescing window (milliseconds): how long the coordinator holds the FIRST writer of a forming group waiting for company before flushing. The direct ack-latency tax a lone writer pays for batching — keep it well under the write SLO; 0 flushes every collector pass (batching only what arrived concurrently).",
+                },
+                "group_commit_max_pending": {
+                    "type": "integer",
+                    "default": 4096,
+                    "description": "Group-commit queue depth: past this many queued writers, enqueue blocks (bounded by the caller's timeout) instead of growing the queue — blocking backpressure, not shedding, because a write has no cheap retry answer. Effective floor is group_commit_max_writers.",
+                },
+                "watch_gc_max_rows": {
+                    "type": "integer",
+                    "default": 10000,
+                    "description": "Watch-log GC pass cap: the interval-guarded retention GC that piggybacks on the write path prunes at most this many delete-log rows per pass (boundary commit-time ties may exceed it slightly), so a long-idle backlog drains across passes instead of stalling a group commit behind one unbounded DELETE sweep. 0 removes the cap.",
+                },
+                "fold_segment_edges": {
+                    "type": "integer",
+                    "default": 2048,
+                    "description": "Log-structured compaction: target overlay-edge count folded into the base snapshot per background fold pass. Each pass folds the oldest overlay segments (up to this many edges) through the device-splice compactor while new writes keep landing in the newest segment — overlay occupancy is bounded by fold rate instead of a stop-the-world budget trip. Smaller = shorter passes, more of them.",
+                },
                 "idempotency_ttl_s": {
                     "type": "number",
                     "default": 86400.0,

@@ -219,6 +219,18 @@ class Daemon:
                     "drain timed out after %.1fs with %d checks in flight",
                     drain_s, getattr(batcher, "inflight", -1),
                 )
+        # group-commit coordinator: let queued writers flush durably
+        # before teardown (an acked snaptoken must survive this exit;
+        # unflushed writers were never acked, so a timeout loses nothing
+        # a client could have observed)
+        co = self.registry.peek("group_commit")
+        if co is not None:
+            if not co.drain(max(0.5, deadline - time.monotonic())):
+                self._count_shutdown_failure("drain_group_commit_timeouts")
+                self.registry.logger().warning(
+                    "group-commit drain timed out with %d writers in flight",
+                    getattr(co, "inflight", -1),
+                )
         # the batcher resolving a future is not the response reaching the
         # wire: wait for the REST backends to flush every accepted
         # exchange before connections are torn down

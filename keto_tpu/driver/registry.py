@@ -98,6 +98,9 @@ class Registry:
                 store.watch_log_retention_s = float(
                     self._config.get("serve.watch_log_retention_s", 3600.0)
                 )
+                store.watch_gc_max_rows = int(
+                    self._config.get("serve.watch_gc_max_rows", 10000)
+                )
                 return store
             dsn = self._config.dsn
             if dsn == "memory":
@@ -127,9 +130,86 @@ class Registry:
             store.watch_log_retention_s = float(
                 self._config.get("serve.watch_log_retention_s", 3600.0)
             )
+            # one piggybacked watch-GC pass prunes at most this many rows
+            # (a group commit must never stall behind an unbounded sweep)
+            store.watch_gc_max_rows = int(
+                self._config.get("serve.watch_gc_max_rows", 10000)
+            )
             return store
 
         return self._memo("manager", build)
+
+    def write_coordinator(self):
+        """The group-commit coordinator
+        (keto_tpu/driver/group_commit.py): batches concurrent write
+        transactions into one durable ``transact_many`` group. ``None``
+        on replicas (read-only) and when
+        ``serve.group_commit_enabled: false`` — callers fall back to
+        per-commit ``transact_relation_tuples``."""
+        if self.is_replica():
+            return None
+        if not bool(self._config.get("serve.group_commit_enabled", True)):
+            return None
+
+        def build():
+            from keto_tpu.driver.group_commit import GroupCommitCoordinator
+
+            co = GroupCommitCoordinator(
+                self.relation_tuple_manager(),
+                max_writers=int(
+                    self._config.get("serve.group_commit_max_writers", 128)
+                ),
+                window_ms=float(
+                    self._config.get("serve.group_commit_window_ms", 2.0)
+                ),
+                max_pending=int(
+                    self._config.get("serve.group_commit_max_pending", 4096)
+                ),
+                wait_histogram=self.metrics().histogram(
+                    "keto_group_commit_wait_seconds",
+                    "Time a writer spent queued in the group-commit "
+                    "coordinator before its group's durable transaction "
+                    "started (the coalescing cost the "
+                    "serve.group_commit_window_ms knob trades against "
+                    "fsyncs).",
+                    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                             0.05, 0.1, 0.25, 1.0),
+                ),
+                batch_histogram=self.metrics().histogram(
+                    "keto_group_commit_batch_size",
+                    "Writers coalesced per durable group transaction "
+                    "(1 = no batching benefit; the ceiling is "
+                    "serve.group_commit_max_writers).",
+                    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+                ),
+            )
+            co.start()
+            return co
+
+        return self._memo("group_commit", build)
+
+    def transact_writes(self):
+        """The write-path entry point the serving layers call: a
+        ``(insert, delete, idempotency_key=None) -> TransactResult``
+        callable routed through the group-commit coordinator when one is
+        enabled, else straight to the store's solo transact. Per-writer
+        results (snaptoken, replay flag) are identical either way."""
+        co = self.write_coordinator()
+        if co is not None:
+            def route(insert, delete, idempotency_key=None):
+                return co.transact(
+                    insert, delete, idempotency_key=idempotency_key
+                )
+
+            return route
+        store = self.relation_tuple_manager()
+
+        def solo(insert, delete, idempotency_key=None):
+            return store.transact_relation_tuples(
+                insert, delete, idempotency_key=idempotency_key
+            )
+
+        return solo
 
     def replica_controller(self):
         """The replica lifecycle owner (keto_tpu/replica/controller.py):
@@ -248,6 +328,9 @@ class Registry:
                     ),
                     overlay_edge_budget=int(
                         self._config.get("serve.overlay_edge_budget", 4096)
+                    ),
+                    fold_segment_edges=int(
+                        self._config.get("serve.fold_segment_edges", 2048)
                     ),
                     snapshot_cache_dir=(
                         str(self._config.get("serve.snapshot_cache_dir", "") or "")
@@ -1418,6 +1501,83 @@ class Registry:
             store_attr("idempotent_replays"),
         )
 
+        # group-commit write path (keto_tpu/driver/group_commit.py):
+        # flush counter bridged from the coordinator; wait/batch-size
+        # histograms are recorded directly by the coordinator (attached
+        # in write_coordinator()). Declared eagerly so scrapes expose
+        # the documented family before the first write.
+        m.histogram(
+            "keto_group_commit_wait_seconds",
+            "Time a writer spent queued in the group-commit "
+            "coordinator before its group's durable transaction "
+            "started (the coalescing cost the "
+            "serve.group_commit_window_ms knob trades against "
+            "fsyncs).",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                     0.05, 0.1, 0.25, 1.0),
+        )
+        m.histogram(
+            "keto_group_commit_batch_size",
+            "Writers coalesced per durable group transaction "
+            "(1 = no batching benefit; the ceiling is "
+            "serve.group_commit_max_writers).",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+        )
+
+        def group_commit_attr(attr):
+            def read():
+                co = self.peek("group_commit")
+                yield (), float(getattr(co, attr, 0) if co is not None else 0)
+
+            return read
+
+        m.register_callback(
+            "keto_group_commit_flush_total", "counter",
+            "Durable group transactions committed by the write "
+            "coordinator (each covers keto_group_commit_batch_size "
+            "writers with one BEGIN/COMMIT).",
+            group_commit_attr("flush_total"),
+        )
+        m.register_callback(
+            "keto_group_commit_errors_total", "counter",
+            "Group transactions that failed (every writer in the group "
+            "observed the same error and retries individually).",
+            group_commit_attr("flush_errors"),
+        )
+
+        # device-resident overlay apply + log-structured fold
+        # (keto_tpu/check/tpu_engine.py): bridged from the engine's
+        # maintenance stats like the other maintenance families
+        m.register_callback(
+            "keto_overlay_device_applies_total", "counter",
+            "Delta-overlay installs applied directly to the resident "
+            "device ELL via scatter patches (no full host re-pack + "
+            "re-upload; the complement re-packs, e.g. on capacity "
+            "growth).",
+            maint_counter("overlay_device_applies"),
+        )
+        m.register_callback(
+            "keto_fold_runs_total", "counter",
+            "Background log-structured fold passes: oldest overlay "
+            "segments folded into the base snapshot while new writes "
+            "keep landing in the newest (the replacement for the "
+            "stop-the-world compaction cliff).",
+            maint_counter("fold_runs"),
+        )
+
+        def fold_duration():
+            _, _, durations = maintenance_raw()
+            d = durations.get("fold")
+            yield (), float(d["total_ms"]) * 1e-3 if d else 0.0
+
+        m.register_callback(
+            "keto_fold_duration_seconds_total", "counter",
+            "Cumulative wall time spent in background fold passes "
+            "(rate against keto_fold_runs_total for the mean fold "
+            "cost; folds run off the serving path).",
+            fold_duration,
+        )
+
     def tracer(self):
         from keto_tpu.x.tracing import DEFAULT_OTLP_ENDPOINT, Tracer
 
@@ -1455,6 +1615,12 @@ class Registry:
         batcher = self._singletons.get("check_batcher")
         if batcher:
             batcher.stop()
+        # the write coordinator must stop before the store closes: a
+        # group mid-commit against a closed connection would fail every
+        # writer in it
+        co = self._singletons.get("group_commit")
+        if co is not None:
+            co.stop()
         engine = self._singletons.get("permission_engine")
         if engine is not None and hasattr(engine, "close"):
             engine.close()

@@ -469,6 +469,21 @@ def apply_delta(
     if ell:
         ell_arr = np.unique(np.asarray(ell, np.int64), axis=0)
 
+    # per-delta ELL change record: what this delta added to / dropped from
+    # the overlay gather matrix, so the engine can scatter-patch the
+    # device-resident [K, C] overlay in place instead of re-packing and
+    # re-uploading the whole matrix on every group commit
+    base_ell_set = set(
+        (int(e[0]), int(e[1]))
+        for e in (() if base.ov_ell is None else base.ov_ell)
+    )
+    final_ell_set = set((int(a), int(b)) for a, b in ell)
+    ov_ell_delta = (
+        int(base.snapshot_id),
+        tuple(sorted(final_ell_set - base_ell_set)),
+        tuple(sorted(base_ell_set - final_ell_set)),
+    )
+
     removed_arr = None
     if removed:
         removed_arr = np.sort(np.fromiter(removed, np.int64, len(removed)))
@@ -485,6 +500,7 @@ def apply_delta(
         ov_fwd=ov_fwd or None,
         ov_ell=ell_arr,
         ov_removed=removed_arr,
+        ov_ell_delta=ov_ell_delta,
         ell_patch=ell_patch or None,
         lst_ov_edges=lst_edges or None,
         lst_patch=lst_patch or None,

@@ -286,6 +286,11 @@ class GraphSnapshot:
     #: to the base's device_buckets; the engine applies + clears them
     ell_patch: Optional[list] = None
     device_overlay: Any = None  # (ov_nbrs, ov_dst) jnp arrays or None
+    #: per-delta overlay-ELL change record relative to the base snapshot:
+    #: ``(base_snapshot_id, added, dropped)`` where added/dropped are
+    #: (src, dst) tuples — the engine's incremental device-overlay apply
+    #: consumes (and clears) it like ``ell_patch``; None means "repack"
+    ov_ell_delta: Any = None
 
     # -- reverse-query layouts (keto_tpu/list/) ------------------------------
     #: transposed CSR over ALL device ids (in-neighbors per node) —

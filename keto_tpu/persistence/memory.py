@@ -33,7 +33,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from keto_tpu import namespace as namespace_pkg
-from keto_tpu.relationtuple.manager import Manager, TransactResult
+from keto_tpu.relationtuple.manager import Manager, TransactResult, TransactWrite
 from keto_tpu.x import faults
 from keto_tpu.relationtuple.model import RelationQuery, RelationTuple, SubjectID, SubjectSet
 from keto_tpu.x.errors import ErrMalformedPageToken, ErrNilSubject
@@ -229,6 +229,14 @@ class MemoryPersister(Manager):
         #: keyed write retries answered from the dedup map instead of
         #: re-applying (the /metrics replay counter, matching sql_base)
         self.idempotent_replays = 0
+        #: log entries one watch-GC pass may prune (0 = unbounded) — the
+        #: GC piggybacks on the write path, so a long backlog must drain
+        #: across passes instead of stalling a group commit (matching
+        #: sql_base.watch_gc_max_rows / serve.watch_gc_max_rows)
+        self.watch_gc_max_rows = 10000
+        #: group-transact introspection (matching sql_base)
+        self.group_commits = 0
+        self.group_commit_writers = 0
 
     @property
     def namespaces(self):
@@ -503,13 +511,50 @@ class MemoryPersister(Manager):
         an already-applied key re-applies nothing and replays the
         original snaptoken."""
         with self._shared.lock:
+            return self._transact_locked_one(insert, delete, idempotency_key)
+
+    def transact_many(
+        self, writes: Sequence[TransactWrite]
+    ) -> list[Optional[TransactResult]]:
+        """Group commit: N writers under ONE lock hold, per-writer
+        tokens from the shared watermark sequence (matching the SQL
+        stores' one-BEGIN/COMMIT group path). Fault points bracket the
+        group: a ``group-commit`` kill applies no writer, ``group-ack``
+        fires with every writer applied."""
+        if not writes:
+            return []
+        with self._shared.lock:
+            faults.check("transact-commit")
+            faults.check("group-commit")
+            results = [
+                self._transact_locked_one(
+                    w.insert, w.delete, w.idempotency_key, fire_faults=False
+                )
+                for w in writes
+            ]
+            self.group_commits += 1
+            self.group_commit_writers += len(writes)
+            faults.check("transact-ack")
+            faults.check("group-ack")
+            return results
+
+    def _transact_locked_one(
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+        idempotency_key: Optional[str] = None,
+        fire_faults: bool = True,
+    ) -> TransactResult:
+        # RLock: re-entrant under transact_many's group-wide hold
+        with self._shared.lock:
             if idempotency_key is not None:
                 dedup = self._shared.idempotency.setdefault(self.network_id, {})
                 got = dedup.get(idempotency_key)
                 if got is not None:
                     self.idempotent_replays += 1
                     return TransactResult(snaptoken=got[0], replayed=True)
-            faults.check("transact-commit")
+            if fire_faults:
+                faults.check("transact-commit")
             new_sorted: Optional[list[InternalRow]] = None
             bundle = None
             make_rows = None
@@ -665,7 +710,8 @@ class MemoryPersister(Manager):
                     (wm, time.time())
                 )
                 self._gc_watch_logs_locked(nid, time.time())
-            faults.check("transact-ack")
+            if fire_faults:
+                faults.check("transact-ack")
             return TransactResult(snaptoken=wm)
 
     def watermark(self) -> int:
@@ -694,6 +740,32 @@ class MemoryPersister(Manager):
             i += 1
         if i == 0:
             return 0
+        cap = int(self.watch_gc_max_rows)
+        if cap > 0:
+            # bound the pass: lower the floor to the cap-th oldest
+            # prunable entry's watermark so a backlog drains across
+            # passes instead of stalling the write that hosts this GC
+            prunable = sorted(
+                [
+                    w
+                    for w, _ in self._shared.insert_log.get(nid, ())
+                    if w <= floor_wm
+                ]
+                + [
+                    w
+                    for w, _ in self._shared.delete_log.get(nid, ())
+                    if w <= floor_wm
+                ]
+            )
+            if len(prunable) > cap:
+                floor_wm = prunable[cap - 1]
+                # keep the commit-time entries above the lowered floor
+                # so the next pass resumes where this one stopped
+                i = 0
+                while i < len(times) and times[i][0] <= floor_wm:
+                    i += 1
+                if i == 0:
+                    return 0
         del times[:i]
         pruned = 0
         log = self._shared.insert_log.get(nid)

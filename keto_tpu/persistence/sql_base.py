@@ -31,7 +31,7 @@ from typing import Callable, Optional, Sequence
 
 from keto_tpu import namespace as namespace_pkg
 from keto_tpu.persistence.memory import InternalRow
-from keto_tpu.relationtuple.manager import Manager, TransactResult
+from keto_tpu.relationtuple.manager import Manager, TransactResult, TransactWrite
 from keto_tpu.relationtuple.model import RelationQuery, RelationTuple, SubjectID, SubjectSet
 from keto_tpu.x import faults
 from keto_tpu.x.errors import ErrMalformedPageToken, ErrNilSubject
@@ -357,6 +357,14 @@ class SQLPersisterBase(Manager):
         # opportunistic GC runs at most this often, piggybacked on writes
         self._watch_gc_interval_s = 60.0
         self._last_watch_gc = 0.0
+        #: rows one piggybacked watch-GC pass may prune (ties on the
+        #: boundary commit_time may exceed it by one transaction's
+        #: deletes); 0 = unbounded. A group commit must never stall
+        #: behind an unbounded DELETE sweep (serve.watch_gc_max_rows).
+        self.watch_gc_max_rows = 10000
+        #: group-transact introspection (the /metrics bridges read these)
+        self.group_commits = 0
+        self.group_commit_writers = 0
         #: budget for reconnect+retry after a mid-query connection loss
         self.reconnect_max_wait_s = 30.0
         #: times the live connection was re-dialed after a detected loss
@@ -850,6 +858,208 @@ class SQLPersisterBase(Manager):
             self._safe_rollback()
             raise
 
+    def transact_many(
+        self, writes: Sequence[TransactWrite]
+    ) -> list[Optional[TransactResult]]:
+        """Group commit: N independent writers, ONE durable transaction.
+
+        Semantically identical to N serial ``transact_relation_tuples``
+        calls in input order — each writer gets its own commit_time from
+        the watermark sequence (consecutive, monotone), its own
+        idempotency-key row, and replay detection against both the table
+        and earlier writers in the same group — but the durability cost
+        (BEGIN/COMMIT, fsync) is paid once, and row/delete-log inserts
+        batch into executemany calls spanning the whole group. The group
+        is all-or-nothing: a crash before the shared COMMIT loses every
+        writer (``group-commit`` kill point), after it loses none
+        (``group-ack``)."""
+        if not writes:
+            return []
+        resolved = [
+            (
+                [self._row_values(rt) for rt in w.insert],
+                [self._row_values(rt) for rt in w.delete],
+                w.idempotency_key,
+            )
+            for w in writes
+        ]
+        all_keyed = all(k is not None for _, _, k in resolved)
+
+        def run():
+            with self._lock:
+                return self._transact_many_locked(resolved)
+
+        # the retry contract matches the solo path, per group: a blind
+        # re-run is only safe when EVERY writer can be deduplicated
+        return self._with_reconnect(run, retry=all_keyed)
+
+    def _transact_many_locked(self, resolved: list) -> list:
+        self._exec("BEGIN")
+        try:
+            results: list[Optional[TransactResult]] = [None] * len(resolved)
+            group_keys: dict[str, int] = {}  # keys committed BY THIS GROUP
+            pending_ins: list[tuple] = []  # row inserts deferred for one
+            # executemany (flushed early only when a later writer deletes,
+            # to preserve the serial inserts-then-deletes visibility)
+            pending_del_log: list[tuple] = []
+            pending_idem: list[tuple] = []
+            last_del_ct = 0
+            any_changed = False
+
+            def flush_ins():
+                if not pending_ins:
+                    return
+                self._executemany(
+                    "INSERT INTO keto_relation_tuples (shard_id, nid, "
+                    "namespace_id, object, relation, subject_id, "
+                    "subject_set_namespace_id, subject_set_object, "
+                    "subject_set_relation, commit_time) "
+                    "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    pending_ins,
+                )
+                pending_ins.clear()
+
+            null_safe = " AND ".join(
+                self._null_safe_eq(col) for col in (
+                    "subject_id",
+                    "subject_set_namespace_id",
+                    "subject_set_object",
+                    "subject_set_relation",
+                )
+            )
+            for idx, (ins_rows, del_rows, key) in enumerate(resolved):
+                if key is not None:
+                    tok = group_keys.get(key)
+                    if tok is None:
+                        row = self._exec(
+                            "SELECT snaptoken FROM keto_idempotency "
+                            "WHERE nid = ? AND idem_key = ?",
+                            (self.network_id, key),
+                        ).fetchone()
+                        if row is not None:
+                            tok = int(row[0])
+                    if tok is not None:
+                        # retry of an already-applied key (possibly from
+                        # an earlier writer in this very group): re-apply
+                        # nothing, answer the original token
+                        self.idempotent_replays += 1
+                        results[idx] = TransactResult(snaptoken=tok, replayed=True)
+                        continue
+                commit_time = self._alloc_commit_time()
+                changed = bool(ins_rows)
+                if ins_rows:
+                    shard_ids = uuid.uuid4().hex
+                    pending_ins.extend(
+                        (f"{shard_ids}-{i}", self.network_id)
+                        + values
+                        + (commit_time,)
+                        for i, values in enumerate(ins_rows)
+                    )
+                effective_dels: list[tuple] = []
+                if del_rows:
+                    # deletes must see every insert that serially
+                    # preceded them — including this writer's own
+                    flush_ins()
+                    for values in dict.fromkeys(del_rows):
+                        cur = self._exec(
+                            "DELETE FROM keto_relation_tuples WHERE nid = ? "
+                            "AND namespace_id = ? AND object = ? "
+                            "AND relation = ? AND " + null_safe,
+                            (self.network_id,) + values,
+                        )
+                        if cur.rowcount > 0:
+                            effective_dels.append(values)
+                    changed = changed or bool(effective_dels)
+                if effective_dels:
+                    # delete_wm = this writer's commit_time (the watermark
+                    # column holds exactly that right now — later writers
+                    # haven't allocated yet)
+                    self._exec(
+                        "UPDATE keto_watermarks SET delete_wm = watermark "
+                        "WHERE nid = ?",
+                        (self.network_id,),
+                    )
+                    pending_del_log.extend(
+                        (self.network_id,) + values + (commit_time,)
+                        for values in effective_dels
+                    )
+                    last_del_ct = commit_time
+                token = int(commit_time)
+                if changed:
+                    any_changed = True
+                else:
+                    # no data moved for this writer: undo its pre-allocated
+                    # bump INSIDE the group transaction (never ROLLBACK —
+                    # that would discard earlier writers). The next writer
+                    # re-allocates the same value; tokens stay monotone.
+                    self._exec(
+                        "UPDATE keto_watermarks SET watermark = watermark - 1 "
+                        "WHERE nid = ?",
+                        (self.network_id,),
+                    )
+                    token = int(commit_time) - 1
+                if key is not None:
+                    pending_idem.append((self.network_id, key, token))
+                    group_keys[key] = token
+                results[idx] = TransactResult(snaptoken=token)
+
+            flush_ins()
+            if pending_del_log:
+                self._executemany(
+                    "INSERT INTO keto_tuple_delete_log (nid, namespace_id, "
+                    "object, relation, subject_id, subject_set_namespace_id, "
+                    "subject_set_object, subject_set_relation, commit_time, "
+                    f"created_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, "
+                    f"{self._epoch_expr()})",
+                    pending_del_log,
+                )
+                floor = last_del_ct - _DELETE_LOG_KEEP
+                if floor > 0:
+                    self._exec(
+                        "DELETE FROM keto_tuple_delete_log "
+                        "WHERE nid = ? AND commit_time <= ?",
+                        (self.network_id, floor),
+                    )
+                    self._exec(
+                        "UPDATE keto_watermarks SET del_log_floor = ? "
+                        "WHERE nid = ?",
+                        (floor, self.network_id),
+                    )
+            if (
+                self.watch_log_retention_s > 0
+                and time.monotonic() - self._last_watch_gc
+                > self._watch_gc_interval_s
+            ):
+                self._gc_watch_logs_in_txn()
+                self._last_watch_gc = time.monotonic()
+            if pending_idem:
+                self._executemany(
+                    "INSERT INTO keto_idempotency (nid, idem_key, snaptoken, "
+                    f"created_at) VALUES (?, ?, ?, {self._epoch_expr()})",
+                    pending_idem,
+                )
+                self._exec(
+                    "DELETE FROM keto_idempotency WHERE nid = ? "
+                    f"AND created_at <= {self._epoch_expr()} - ?",
+                    (self.network_id, int(self.idempotency_ttl_s)),
+                )
+            if not any_changed and not pending_idem:
+                # every writer was a replay or an unkeyed no-op: nothing
+                # to make durable, and rolling back un-lands the bumps
+                self._exec("ROLLBACK")
+                return results
+            faults.check("transact-commit")
+            faults.check("group-commit")
+            self._exec("COMMIT")
+            self.group_commits += 1
+            self.group_commit_writers += len(resolved)
+            faults.check("transact-ack")
+            faults.check("group-ack")
+            return results
+        except Exception:
+            self._safe_rollback()
+            raise
+
     def watermark(self) -> int:
         def run():
             with self._lock:
@@ -868,7 +1078,15 @@ class SQLPersisterBase(Manager):
         (wall clock) and raise ``del_log_floor`` beneath them. Runs
         inside an already-open transaction; returns rows pruned. The
         tuple rows themselves double as the insert log and are data, not
-        log — they are never GC'd."""
+        log — they are never GC'd.
+
+        Each pass prunes at most ``watch_gc_max_rows`` rows (plus
+        boundary-commit_time ties): the GC piggybacks on the write path
+        inside the open transaction, and an unbounded sweep over a long
+        backlog would stall every writer in a group commit behind it.
+        The floor only rises as far as the pass actually pruned, so the
+        backlog drains across passes without ever expiring a watcher
+        past rows that still exist."""
         ret = self.watch_log_retention_s
         if ret <= 0:
             return 0
@@ -880,6 +1098,19 @@ class SQLPersisterBase(Manager):
         if row is None or row[0] is None:
             return 0
         floor = int(row[0])
+        cap = int(self.watch_gc_max_rows)
+        if cap > 0:
+            # bound the sweep without DELETE ... LIMIT (absent from the
+            # tier-1 sqlite floor, 3.34): lower the floor to the cap-th
+            # oldest eligible row's commit_time
+            nth = self._exec(
+                "SELECT commit_time FROM keto_tuple_delete_log "
+                "WHERE nid = ? AND commit_time <= ? "
+                "ORDER BY commit_time LIMIT 1 OFFSET ?",
+                (self.network_id, floor, cap - 1),
+            ).fetchone()
+            if nth is not None:
+                floor = min(floor, int(nth[0]))
         cur = self._exec(
             "DELETE FROM keto_tuple_delete_log "
             "WHERE nid = ? AND commit_time <= ?",

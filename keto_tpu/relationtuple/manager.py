@@ -35,6 +35,19 @@ class TransactResult:
     replayed: bool = False
 
 
+@dataclass(frozen=True)
+class TransactWrite:
+    """One writer's intent inside a multi-writer group transact
+    (:meth:`Manager.transact_many`): the same (insert, delete,
+    idempotency_key) triple a solo ``transact_relation_tuples`` call
+    takes, carried as data so a commit coordinator can batch many
+    writers into one durable transaction."""
+
+    insert: Sequence[RelationTuple] = ()
+    delete: Sequence[RelationTuple] = ()
+    idempotency_key: Optional[str] = None
+
+
 class Manager(abc.ABC):
     @abc.abstractmethod
     def get_relation_tuples(
@@ -64,6 +77,33 @@ class Manager(abc.ABC):
         CRDB-style answer to ambiguous-commit retries). Implementations
         return a :class:`TransactResult`; the base contract allows None
         for legacy stores without a watermark concept."""
+
+    def transact_many(
+        self, writes: Sequence[TransactWrite]
+    ) -> list[Optional[TransactResult]]:
+        """Apply many independent write transactions in one durable
+        group: one BEGIN/COMMIT (SQL stores), one lock hold (memory), N
+        per-writer outcomes in input order.
+
+        The per-writer semantics are EXACTLY those of N serial
+        ``transact_relation_tuples`` calls in the same order: each
+        writer gets its own snaptoken from the group's commit sequence
+        (consecutive, monotone), its own replayable idempotency-key row
+        committed atomically with its rows, and replay detection against
+        both prior transactions and earlier writers in the same group.
+        Atomicity is all-or-nothing for the GROUP: either every writer's
+        effects are durable or none are (the chaos kill points
+        ``group-commit`` / ``group-ack`` bracket the shared COMMIT).
+
+        The base implementation loops over ``transact_relation_tuples``
+        — correct but per-commit-durable; stores override it with a real
+        batched path (sql_base/memory)."""
+        return [
+            self.transact_relation_tuples(
+                w.insert, w.delete, idempotency_key=w.idempotency_key
+            )
+            for w in writes
+        ]
 
     def watermark(self) -> int:
         """Monotonic write counter, used by the TPU engine to detect staleness
@@ -104,6 +144,11 @@ class ManagerWrapper(Manager):
         return self.manager.transact_relation_tuples(
             insert, delete, idempotency_key=idempotency_key
         )
+
+    def transact_many(
+        self, writes: Sequence[TransactWrite]
+    ) -> list[Optional[TransactResult]]:
+        return self.manager.transact_many(writes)
 
     def watermark(self) -> int:
         return self.manager.watermark()

@@ -400,7 +400,8 @@ class WriteService:
                 idem_key = v
                 break
         manager = self.registry.relation_tuple_manager()
-        result = manager.transact_relation_tuples(
+        # routed through the group-commit coordinator when enabled
+        result = self.registry.transact_writes()(
             insert, delete, idempotency_key=idem_key
         )
         if result is not None:

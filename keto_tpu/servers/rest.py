@@ -836,7 +836,10 @@ class RestApp:
         except json.JSONDecodeError as e:
             raise ErrBadRequest(str(e)) from None
         rel = RelationTuple.from_json(obj)
-        result = self.registry.relation_tuple_manager().transact_relation_tuples(
+        # routed through the group-commit coordinator when enabled (one
+        # durable transaction per batch of concurrent writers, same
+        # per-writer snaptoken/replay semantics)
+        result = self.registry.transact_writes()(
             [rel], (), idempotency_key=self._idempotency_key_from(headers)
         )
         self._note_commit(result)
@@ -846,7 +849,7 @@ class RestApp:
 
     def _delete_relation_tuple(self, query, headers=None):
         rel = RelationTuple.from_url_query(query)
-        result = self.registry.relation_tuple_manager().transact_relation_tuples(
+        result = self.registry.transact_writes()(
             (), [rel], idempotency_key=self._idempotency_key_from(headers)
         )
         self._note_commit(result)
@@ -871,7 +874,7 @@ class RestApp:
                 delete.append(RelationTuple.from_json(raw))
             else:
                 raise ErrBadRequest(f"unknown action {action}")
-        result = self.registry.relation_tuple_manager().transact_relation_tuples(
+        result = self.registry.transact_writes()(
             insert, delete, idempotency_key=self._idempotency_key_from(headers)
         )
         self._note_commit(result)

@@ -35,6 +35,13 @@ exactly that line. The durable write/maintenance sites are instrumented:
 - ``transact-commit`` — inside a write transaction, before COMMIT
 - ``transact-ack``    — after COMMIT, before the caller is answered
   (the ambiguous-failure window idempotency keys exist for)
+- ``group-commit``    — inside a GROUP transaction (many writers batched
+  by the commit coordinator, keto_tpu/driver/group_commit.py), before
+  the shared COMMIT: every writer in the group must be atomically absent
+  after recovery
+- ``group-ack``       — after the shared COMMIT, before any writer in
+  the group is answered: every writer must be durably present and every
+  keyed retry must replay its own original token
 - ``refresh-read``    — mid snapshot refresh
 - ``overlay-apply``   — mid delta-overlay application
 - ``compaction``      — mid overlay compaction
@@ -60,6 +67,8 @@ POINTS = (
     "check-dispatch",
     "transact-commit",
     "transact-ack",
+    "group-commit",
+    "group-ack",
     "overlay-apply",
 )
 

@@ -185,8 +185,13 @@ def lint(text: str) -> list[str]:
             )
         if not name.startswith("keto_"):
             problems.append(f"family {name} missing the keto_ namespace prefix")
-        if fam["type"] == "histogram" and not name.endswith("_seconds"):
-            problems.append(f"histogram {name} should use base unit seconds (_seconds)")
+        if fam["type"] == "histogram" and not name.endswith(
+            ("_seconds", "_bytes", "_size")
+        ):
+            problems.append(
+                f"histogram {name} should carry a base unit suffix "
+                "(_seconds, _bytes, or _size)"
+            )
     if len(exposed) < 12:
         problems.append(f"only {len(exposed)} families exposed; the spine promises >= 12")
     return problems

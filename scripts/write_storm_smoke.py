@@ -1,0 +1,158 @@
+"""write-storm-smoke: the CI gate on the group-commit write path.
+
+Runs bench.py's write_path rounds (real daemon, real sqlite store, CPU
+shapes) and asserts the properties the group-commit + background-fold
+design promises:
+
+1. the store-layer amortization is real: N keyed writes through
+   transact_many groups sustain >= 10x the one-BEGIN/COMMIT-per-write
+   serial rate on the same store (the fsync/statement batching the
+   coordinator exists to buy);
+2. the end-to-end closed-loop storm (writers through the exact
+   registry.transact_writes() seam the servers call, checks through
+   REST) is faster grouped than per-commit, with a LOWER ack median --
+   batching must not buy throughput by taxing the individual writer;
+3. writes never fail and the group path never errors a flush
+   (all-or-nothing grouping engaged cleanly);
+4. the serving plane stays live under the storm: the interactive check
+   probe gets answers (no starvation), and every sampled decision
+   matches the CPU oracle reading the same store -- grouping and folds
+   change no answer;
+5. overlay occupancy stays bounded by the background fold rate (folds
+   actually ran; occupancy ends under the engine's hard cap) -- the
+   serving path never paid a rebuild cliff;
+6. under KETO_TPU_SANITIZE=1 the whole storm ran on instrumented locks:
+   zero lock-order inversions, zero deadlock-watchdog trips.
+
+Exit 0 when all hold; 1 with the violations listed.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+# small CPU shapes unless the caller already pinned them: short rounds,
+# a tight overlay budget so folds demonstrably run inside the storm, and
+# modest writer counts (the top one still exercises real coalescing)
+os.environ.setdefault("BENCH_WRITE_WRITERS", "1,8,64")
+os.environ.setdefault("BENCH_WRITE_S", "2.0")
+os.environ.setdefault("BENCH_WRITE_OBJS", "200")
+os.environ.setdefault("BENCH_WRITE_OVERLAY_BUDGET", "256")
+os.environ.setdefault("BENCH_WRITE_FOLD_SEGMENT", "128")
+os.environ.setdefault("BENCH_WRITE_ORACLE_SAMPLE", "200")
+
+
+def main() -> int:
+    from bench import log, run_write_path
+
+    out = run_write_path(random.Random(8042))
+    problems: list[str] = []
+
+    from keto_tpu.x import lockwatch
+
+    if lockwatch.installed():
+        problems.extend(lockwatch.violations())
+        rep = lockwatch.report()
+        log(
+            f"[write-storm] lockwatch: {rep['acquires']} acquires, "
+            f"{len(rep['inversions'])} inversions, "
+            f"{len(rep['watchdog_trips'])} watchdog trips"
+        )
+
+    micro = out.get("store_amortization") or {}
+    if not micro.get("speedup"):
+        problems.append("store amortization round missing")
+    elif micro["speedup"] < 10.0:
+        problems.append(
+            f"store-layer group speedup {micro['speedup']}x < 10x at "
+            f"groups of {micro.get('group_size')} — executemany batching "
+            "is not amortizing the per-commit cost"
+        )
+
+    base = out.get("baseline") or {}
+    rounds = out.get("grouped") or []
+    top = rounds[-1] if rounds else {}
+    if not base.get("writes") or not top.get("writes"):
+        problems.append("missing baseline or grouped storm round")
+    else:
+        if base.get("write_errors") or any(r.get("write_errors") for r in rounds):
+            problems.append(
+                f"write errors: baseline={base.get('write_errors')} "
+                f"grouped={[r.get('write_errors') for r in rounds]}"
+            )
+        if not out.get("speedup_vs_per_commit", 0) > 1.0:
+            problems.append(
+                f"grouped storm ({top.get('writes_per_s')} writes/s) not "
+                f"faster than per-commit ({base.get('writes_per_s')})"
+            )
+        if (
+            top.get("ack", {}).get("p50_ms") is not None
+            and base.get("ack", {}).get("p50_ms") is not None
+            and not top["ack"]["p50_ms"] < base["ack"]["p50_ms"]
+        ):
+            problems.append(
+                f"grouped ack p50 ({top['ack']['p50_ms']} ms) not below "
+                f"per-commit ack p50 ({base['ack']['p50_ms']} ms) — "
+                "batching is taxing the individual writer"
+            )
+
+    co = out.get("coordinator") or {}
+    if co.get("flush_errors"):
+        problems.append(f"coordinator flush errors: {co['flush_errors']}")
+    if not co.get("mean_batch", 0) > 1.0:
+        problems.append(
+            f"mean batch {co.get('mean_batch')} — the coordinator never coalesced"
+        )
+
+    probe = top.get("check_under_storm") or {}
+    if not probe.get("checks"):
+        problems.append("interactive check probe starved under the write storm")
+    if probe.get("check_errors"):
+        problems.append(f"check errors under storm: {probe['check_errors']}")
+
+    if out.get("oracle_mismatches") != 0:
+        problems.append(
+            f"{out.get('oracle_mismatches')} decisions diverged from the "
+            f"CPU oracle after the storm"
+        )
+
+    maint = out.get("maintenance") or {}
+    if not maint.get("fold_runs"):
+        problems.append(
+            "zero background fold runs — the storm never exercised "
+            "log-structured maintenance (budget too large for the shape?)"
+        )
+    budget = maint.get("overlay_budget") or 0
+    if budget and maint.get("overlay_edges", 0) > max(4 * budget, 65536):
+        problems.append(
+            f"overlay occupancy {maint['overlay_edges']} ended past the "
+            f"hard cap (budget {budget}) — folds are not bounding it"
+        )
+
+    log(
+        "[write-storm] "
+        + f"store amortization {micro.get('speedup')}x; "
+        + f"e2e {out.get('speedup_vs_per_commit')}x at "
+        + f"{(out.get('grouped') or [{}])[-1].get('writers')} writers; "
+        + f"fold_runs={maint.get('fold_runs')} "
+        + f"overlay={maint.get('overlay_edges')}/{budget}; "
+        + f"oracle mismatches {out.get('oracle_mismatches')}/"
+        + f"{out.get('oracle_sample')}"
+    )
+    if problems:
+        print("write-storm-smoke FAILED:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print("write-storm-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,8 @@ REPO = Path(__file__).resolve().parents[1]
 CRASH_POINTS = [
     "transact-ack",      # post-COMMIT, pre-ack: the ambiguous window
     "transact-commit",   # pre-COMMIT: the write must NOT survive
+    "group-ack",         # post-COMMIT of a group commit, pre-fanout
+    "group-commit",      # pre-COMMIT of a group commit: atomically absent
     "overlay-apply",     # mid delta application
     "cache-save",        # mid snapshot-cache serialization
     "refresh-read",      # mid snapshot refresh (often at boot warm)
@@ -281,18 +283,20 @@ def test_chaos_kill_and_recover(tmp_path):
             client = survivor.client(retry_max_wait_s=4.0)
 
             # ambiguous keyed writes retry safely: dedup replays a landed
-            # commit (transact-ack kills MUST replay — the kill fired
-            # after COMMIT), a lost one applies fresh (transact-commit
-            # kills MUST NOT replay — the kill fired before COMMIT)
+            # commit (transact-ack / group-ack kills MUST replay — the
+            # kill fired after COMMIT), a lost one applies fresh
+            # (transact-commit / group-commit kills MUST NOT replay —
+            # the kill fired before the shared COMMIT, so every writer
+            # in the group is atomically absent)
             for key, t in ambiguous + failed_refused:
                 resp = client.patch_relation_tuples([t], idempotency_key=key)
                 assert resp.snaptoken is not None
                 if (key, t) in ambiguous:
-                    if point == "transact-ack":
+                    if point in ("transact-ack", "group-ack"):
                         assert resp.replayed, (
                             f"cycle {cycle}: post-commit crash retry did not replay"
                         )
-                    if point == "transact-commit":
+                    if point in ("transact-commit", "group-commit"):
                         assert not resp.replayed, (
                             f"cycle {cycle}: pre-commit crash retry claims replay"
                         )

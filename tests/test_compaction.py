@@ -10,6 +10,7 @@ deletes, wildcard-bearing graphs, and sink-class rows.
 """
 
 import random
+import time
 
 import numpy as np
 import pytest
@@ -168,11 +169,11 @@ def test_compaction_tombstones_and_restore():
     assert snap is None or snap.ov_removed is None
 
 
-def test_inline_compaction_applies_pending_restore_patch():
+def test_fold_applies_pending_restore_patch():
     """Tombstone an iterated edge (device slot sentinel-patched), then
-    re-insert it in the same delta that overflows the budget: the inline
-    compaction must flush the pending restore patch before reusing the
-    untouched device bucket, or the edge stays dead on device."""
+    re-insert it in the same delta that overflows the budget: the
+    background fold must flush the pending restore patch before reusing
+    the untouched device bucket, or the edge stays dead on device."""
     p = make_store()
     p.write_relation_tuples(
         T("d", "doc", "view", SubjectSet("g", "a", "m")),
@@ -195,7 +196,13 @@ def test_inline_compaction_applies_pending_restore_patch():
         T("g", "b", "m", SubjectID("x3")),  # burst past the budget
     )
     s2 = engine.snapshot()
-    assert not s2.has_overlay, "budget overflow should have compacted inline"
+    # the serving path NEVER folds inline: the burst installs fresh with
+    # its overlay intact, and the supervised maintenance pass folds it
+    assert s2.has_overlay, "serving snapshot() must not pay the fold"
+    deadline = time.time() + 10.0
+    while engine._snapshot.has_overlay and time.time() < deadline:
+        engine._refresh_pass()
+    assert not engine._snapshot.has_overlay, "maintenance pass never folded"
     oracle = CheckEngine(p)
     for u in ("u2", "x1", "x2", "x3", "ghost"):
         q = T("d", "doc", "view", SubjectID(u))
@@ -289,9 +296,11 @@ def test_compaction_fuzz_parity(seed):
     assert exercised >= 1, "fuzz never exercised compaction — universe too hostile"
 
 
-def test_engine_write_burst_compacts_without_rebuild():
-    """A write burst past the overlay budget is absorbed by compaction:
-    no full rebuild, no overlay left, decisions match the oracle."""
+def test_engine_write_burst_folds_without_rebuild():
+    """A write burst past the overlay budget is absorbed by the
+    background fold: no full rebuild, no overlay left once maintenance
+    catches up, decisions match the oracle — and the serving snapshot()
+    call itself never pays the fold."""
     p = make_store()
     p.write_relation_tuples(
         T("d", "doc", "view", SubjectSet("g", "team", "member")),
@@ -315,7 +324,14 @@ def test_engine_write_burst_compacts_without_rebuild():
         burst = [T("g", "core", "member", SubjectID(f"b{i}")) for i in range(40)]
         p.write_relation_tuples(*burst)
         snap = engine.snapshot()
-        assert not snap.has_overlay, "budget overflow should have compacted"
+        # fresh (read-your-writes) but the fold stays off the caller
+        assert snap.snapshot_id == p.watermark()
+        assert snap.has_overlay, "serving snapshot() must not pay the fold"
+        deadline = time.time() + 10.0
+        while engine._snapshot.has_overlay and time.time() < deadline:
+            engine._refresh_pass()
+        snap = engine._snapshot
+        assert not snap.has_overlay, "maintenance fold never compacted"
         assert snap.snapshot_id == p.watermark()
         assert engine.maintenance.snapshot().get("compactions", 0) >= 1
         oracle = CheckEngine(p)

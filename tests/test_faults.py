@@ -473,9 +473,10 @@ def test_compaction_fault_falls_back_to_rebuild(make_persister):
     try:
         assert engine.batch_check([T("docs", "readme", "view", SubjectID("alice"))]) == [True]
         faults.inject("compaction")
-        # push the overlay past its budget: compaction is attempted,
-        # raises, and the refresh falls back to a full rebuild instead of
-        # dying — decisions stay correct
+        # push the overlay past its budget: the serving path installs the
+        # oversized overlay without paying the fold; the maintenance pass
+        # attempts the fold, its compaction raises, and the refresh falls
+        # back to a full rebuild instead of dying — decisions stay correct
         p.write_relation_tuples(
             *[T("docs", f"doc{i}", "view", SubjectID("bob")) for i in range(8)]
         )
@@ -486,9 +487,19 @@ def test_compaction_fault_falls_back_to_rebuild(make_persister):
                 T("docs", "doc3", "view", SubjectID("alice")),
             ]
         ) == [True, True, False]
+        deadline = time.monotonic() + 10.0
+        while (
+            engine.maintenance.snapshot().get("compaction_failures", 0) < 1
+            and time.monotonic() < deadline
+        ):
+            engine._refresh_pass()
         stats = engine.maintenance.snapshot()
         assert stats.get("compaction_failures", 0) >= 1
         assert stats.get("full_rebuilds", 0) >= 2
+        # the fault cleared nothing mid-flight: decisions survive the rebuild
+        assert engine.batch_check(
+            [T("docs", "doc3", "view", SubjectID("bob"))]
+        ) == [True]
     finally:
         engine.close()
 

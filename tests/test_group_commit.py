@@ -1,0 +1,508 @@
+"""Group-commit write path: batched durable transacts + fold hygiene.
+
+Three contracts under test:
+
+- ``Manager.transact_many`` (sql_base + memory): per-writer semantics
+  EXACTLY those of N serial ``transact_relation_tuples`` calls — own
+  snaptoken from the group's commit sequence, own replayable
+  idempotency-key row, replay detection against earlier group members —
+  while the GROUP is all-or-nothing durable.
+- ``GroupCommitCoordinator`` (keto_tpu/driver/group_commit.py):
+  concurrent writers coalesce into few flushes, every writer gets its
+  own result, a store error fails the whole group, stop fails leftovers.
+- The serving path NEVER pays a compaction/fold wall (the old
+  inline-compaction-on-budget-trip stall): a budget-tripping burst
+  installs fresh with its overlay intact and the supervised maintenance
+  pass folds it off-path — proven with a delay fault armed at the
+  compaction crash point.
+
+The fuzz suite asserts group-committed state == serially-committed
+state == CPU oracle decisions across tombstones, wildcards, and
+sink-class rows, including stacked folds.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.check import CheckEngine
+from keto_tpu.check.tpu_engine import TpuCheckEngine
+from keto_tpu.driver.group_commit import GroupCommitCoordinator
+from keto_tpu.persistence.memory import MemoryPersister
+from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+from keto_tpu.relationtuple.manager import TransactWrite
+from keto_tpu.relationtuple.model import RelationQuery
+from keto_tpu.x import faults
+
+
+def T(ns, obj, rel, sub):
+    return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+
+NSS = [namespace_pkg.Namespace(id=1, name="g"), namespace_pkg.Namespace(id=2, name="d")]
+
+
+def mem_store():
+    return MemoryPersister(namespace_pkg.MemoryManager(NSS))
+
+
+def sqlite_store(tmp_path, name="gc.db"):
+    from keto_tpu.persistence.sqlite import SQLitePersister
+
+    return SQLitePersister(
+        f"sqlite://{tmp_path / name}", namespace_pkg.MemoryManager(NSS)
+    )
+
+
+# -- transact_many: group == N serial transacts -------------------------------
+
+
+def _group_scenario(p):
+    """One group covering the tricky per-writer shapes: plain insert,
+    keyed insert, insert+delete in one writer, a no-op writer, an
+    in-group replay of an earlier member's key, and a delete of an
+    earlier writer's insert (serial visibility inside the group)."""
+    a = T("g", "grp", "m", SubjectID("a"))
+    b = T("g", "grp", "m", SubjectID("b"))
+    c = T("g", "grp", "m", SubjectID("c"))
+    results = p.transact_many([
+        TransactWrite(insert=(a,)),
+        TransactWrite(insert=(b,), idempotency_key="k1"),
+        TransactWrite(insert=(c,), delete=(a,)),          # sees writer 0's row
+        TransactWrite(delete=(T("g", "grp", "m", SubjectID("ghost")),)),  # no-op
+        TransactWrite(insert=(b,), idempotency_key="k1"),  # in-group replay
+    ])
+    toks = [r.snaptoken for r in results]
+    replayed = [r.replayed for r in results]
+    assert replayed == [False, False, False, False, True]
+    # the replay returns the ORIGINAL member's token
+    assert toks[4] == toks[1]
+    # effective writers got consecutive monotone tokens
+    assert toks[1] == toks[0] + 1 and toks[2] == toks[1] + 1
+    # watermark reflects the group's last allocation
+    assert p.watermark() >= toks[2]
+    got, _ = p.get_relation_tuples(RelationQuery(namespace="g"))
+    subs = sorted(t.subject.id for t in got)
+    assert subs == ["b", "c"]  # a inserted then deleted within the group
+    # a keyed retry AFTER the group replays the original token
+    r = p.transact_relation_tuples([b], [], idempotency_key="k1")
+    assert r.replayed and r.snaptoken == toks[1]
+
+
+def test_transact_many_memory():
+    _group_scenario(mem_store())
+
+
+def test_transact_many_sqlite(tmp_path):
+    p = sqlite_store(tmp_path)
+    try:
+        _group_scenario(p)
+    finally:
+        p.close()
+
+
+def _parity_pair(p_group, p_serial, rng, rounds=12):
+    """Drive both stores with the SAME logical writes — grouped on one,
+    serial on the other — and assert tokens, watermarks, and surviving
+    tuples agree round by round."""
+    objects = [f"o{i}" for i in range(5)]
+    users = [f"u{i}" for i in range(5)]
+    live: list[RelationTuple] = []
+    for rnd in range(rounds):
+        writes = []
+        for _ in range(rng.randrange(1, 6)):
+            if live and rng.random() < 0.35:
+                writes.append(TransactWrite(delete=(rng.choice(live),)))
+            else:
+                t = T(
+                    "g",
+                    rng.choice(objects),
+                    "m",
+                    SubjectID(rng.choice(users))
+                    if rng.random() < 0.7
+                    else SubjectSet("g", rng.choice(objects), "m"),
+                )
+                key = f"r{rnd}-{len(writes)}" if rng.random() < 0.5 else None
+                writes.append(TransactWrite(insert=(t,), idempotency_key=key))
+        got_g = p_group.transact_many(writes)
+        got_s = [
+            p_serial.transact_relation_tuples(
+                w.insert, w.delete, idempotency_key=w.idempotency_key
+            )
+            for w in writes
+        ]
+        assert [r.snaptoken for r in got_g] == [r.snaptoken for r in got_s]
+        assert [r.replayed for r in got_g] == [r.replayed for r in got_s]
+        assert p_group.watermark() == p_serial.watermark()
+        rows_g, _ = p_group.get_relation_tuples(RelationQuery())
+        rows_s, _ = p_serial.get_relation_tuples(RelationQuery())
+        key = lambda t: (t.namespace, t.object, t.relation, str(t.subject))
+        assert sorted(map(key, rows_g)) == sorted(map(key, rows_s))
+        live = list(rows_g)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_group_vs_serial_parity_memory(seed):
+    _parity_pair(mem_store(), mem_store(), random.Random(100 + seed))
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_group_vs_serial_parity_sqlite(tmp_path, seed):
+    pg = sqlite_store(tmp_path, "g.db")
+    ps = sqlite_store(tmp_path, "s.db")
+    try:
+        _parity_pair(pg, ps, random.Random(200 + seed))
+    finally:
+        pg.close()
+        ps.close()
+
+
+def test_group_commit_stats_and_watch_groups():
+    """Each writer's token is its own Watch commit group (the replica
+    contract is untouched by grouping), and the store counts groups."""
+    p = mem_store()
+    writes = [
+        TransactWrite(insert=(T("g", "grp", "m", SubjectID(f"u{i}")),))
+        for i in range(6)
+    ]
+    results = p.transact_many(writes)
+    assert p.group_commits == 1 and p.group_commit_writers == 6
+    groups, _ = p.watch_changes_since(results[0].snaptoken - 1)
+    by_tok = {tok for tok, _events in groups}
+    for r in results:
+        assert r.snaptoken in by_tok, "writer lost its own watch commit group"
+
+
+# -- watch-log GC row cap (satellite: GC can't stall a group commit) ----------
+
+
+def test_memory_watch_gc_row_cap():
+    p = mem_store()
+    p.watch_log_retention_s = 3600.0
+    p.watch_gc_max_rows = 4
+    for i in range(12):
+        p.write_relation_tuples(T("g", "grp", "m", SubjectID(f"u{i}")))
+    # everything is "old": an uncapped pass would prune all 12 entries
+    pruned = p.gc_watch_logs(now=time.time() + 3601.0)
+    assert 0 < pruned <= 4, f"cap ignored: pruned {pruned}"
+    # repeated passes drain the backlog incrementally
+    total = pruned
+    for _ in range(10):
+        got = p.gc_watch_logs(now=time.time() + 3601.0)
+        if got == 0:
+            break
+        assert got <= 4
+        total += got
+    assert total == 12, f"capped GC never drained the backlog ({total}/12)"
+
+
+def test_sqlite_watch_gc_row_cap(tmp_path):
+    p = sqlite_store(tmp_path)
+    try:
+        p.watch_gc_max_rows = 2
+        for i in range(6):
+            p.write_relation_tuples(T("g", "grp", "m", SubjectID(f"u{i}")))
+        for i in range(6):
+            p.delete_relation_tuples(T("g", "grp", "m", SubjectID(f"u{i}")))
+        p.watch_log_retention_s = 0.5  # sub-second: every row is already old
+        time.sleep(1.1)
+        pruned = p.gc_watch_logs()
+        # floor-lowering cap: ties on commit_time may slightly exceed the
+        # cap, but the pass must stay bounded well below the backlog
+        assert 0 < pruned <= 3, f"cap ignored: pruned {pruned}"
+        total = pruned
+        for _ in range(10):
+            got = p.gc_watch_logs()
+            if got == 0:
+                break
+            total += got
+        assert total == 6, f"capped GC never drained the backlog ({total}/6)"
+    finally:
+        p.close()
+
+
+# -- the coordinator ----------------------------------------------------------
+
+
+def test_coordinator_coalesces_and_preserves_tokens():
+    p = mem_store()
+    co = GroupCommitCoordinator(p, max_writers=64, window_ms=100.0)
+    co.start()
+    try:
+        n = 32
+        barrier = threading.Barrier(n)
+        results: list = [None] * n
+        errors: list = []
+
+        def writer(i):
+            try:
+                barrier.wait()
+                results[i] = co.transact(
+                    [T("g", "grp", "m", SubjectID(f"w{i}"))], []
+                )
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        toks = sorted(r.snaptoken for r in results)
+        assert len(set(toks)) == n, "writers shared a snaptoken"
+        assert toks == list(range(toks[0], toks[0] + n)), "tokens not consecutive"
+        assert co.writers_total == n
+        assert co.flush_total <= 4, f"no coalescing: {co.flush_total} flushes"
+        assert p.group_commit_writers == n
+        # a keyed retry through the coordinator replays the original
+        t0 = T("g", "grp", "m", SubjectID("keyed"))
+        r1 = co.transact([t0], [], idempotency_key="ck")
+        r2 = co.transact([t0], [], idempotency_key="ck")
+        assert not r1.replayed and r2.replayed and r2.snaptoken == r1.snaptoken
+        assert co.drain(5.0)
+    finally:
+        co.stop()
+
+
+def test_coordinator_store_error_fails_every_writer():
+    p = mem_store()
+    boom = RuntimeError("store down")
+    orig = p.transact_many
+    fail_once = {"armed": True}
+
+    def flaky(writes):
+        if fail_once.pop("armed", None):
+            raise boom
+        return orig(writes)
+
+    p.transact_many = flaky
+    co = GroupCommitCoordinator(p, max_writers=8, window_ms=50.0)
+    co.start()
+    try:
+        errs: list = []
+        oks: list = []
+
+        def writer(i):
+            try:
+                oks.append(co.transact([T("g", "grp", "m", SubjectID(f"e{i}"))], []))
+            except RuntimeError as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # every writer of the failed group saw the SAME store error
+        assert errs and all(e is boom for e in errs)
+        assert co.flush_errors == 1
+        # the coordinator keeps serving after a failed group
+        r = co.transact([T("g", "grp", "m", SubjectID("after"))], [])
+        assert r.snaptoken is not None
+    finally:
+        co.stop()
+
+
+def test_coordinator_stop_fails_leftovers():
+    p = mem_store()
+    co = GroupCommitCoordinator(p, max_writers=128, window_ms=30000.0)
+    co.start()
+    got: list = []
+
+    def writer():
+        try:
+            got.append(co.transact([T("g", "grp", "m", SubjectID("x"))], []))
+        except RuntimeError as e:
+            got.append(e)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    time.sleep(0.2)  # let the writer enqueue into the open window
+    co.stop()
+    t.join(timeout=10)
+    assert len(got) == 1
+    # either the collector flushed it on stop, or it failed cleanly —
+    # never a hang, never a silent drop
+    assert isinstance(got[0], RuntimeError) or got[0].snaptoken is not None
+
+
+# -- fuzz: group-committed == serially-committed == CPU oracle ---------------
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_group_commit_overlay_fuzz_parity(seed):
+    """Random keyed/unkeyed grouped writes (tombstones, wildcards,
+    sink-class subjects) against a TPU engine with a tiny overlay budget
+    and segment-bounded folds: decisions must stay bit-identical to the
+    CPU oracle on the same store AND to a serially-committed twin."""
+    rng = random.Random(3000 + seed)
+    p = mem_store()
+    twin = mem_store()
+    base = [
+        T("d", "doc", "view", SubjectSet("g", "s0", "m")),
+        T("g", "grp", "", SubjectID("seed")),  # wildcard key in g
+    ]
+    N = 6
+    for i in range(N):
+        base.append(T("g", f"s{i}", "m", SubjectSet("g", f"s{(i + 1) % N}", "m")))
+    base.append(T("g", "s2", "m", SubjectID("u0")))
+    p.write_relation_tuples(*base)
+    twin.write_relation_tuples(*base)
+    engine = TpuCheckEngine(
+        p, p.namespaces,
+        compact_after_s=3600.0, overlay_edge_budget=6, fold_segment_edges=3,
+    )
+    engine.snapshot()
+    oracle = CheckEngine(p)
+    users = [f"u{i}" for i in range(4)] + ["ghost"]
+    queries = [
+        T("d", "doc", "view", SubjectID(u)) for u in users
+    ] + [
+        T("g", f"s{i}", "m", SubjectID(u)) for i in range(N) for u in users[:2]
+    ]
+    live: list[RelationTuple] = list(base)
+    for rnd in range(8):
+        writes = []
+        for _ in range(rng.randrange(1, 5)):
+            if live and rng.random() < 0.3:
+                writes.append(TransactWrite(delete=(rng.choice(live),)))
+            else:
+                sub = (
+                    SubjectID(rng.choice(users))
+                    if rng.random() < 0.5
+                    else SubjectSet("g", f"s{rng.randrange(N)}", "m")
+                )
+                writes.append(
+                    TransactWrite(
+                        insert=(T("g", f"s{rng.randrange(N)}", "m", sub),),
+                        idempotency_key=(
+                            f"f{seed}-{rnd}-{len(writes)}"
+                            if rng.random() < 0.5
+                            else None
+                        ),
+                    )
+                )
+        got_g = p.transact_many(writes)
+        got_s = [
+            twin.transact_relation_tuples(
+                w.insert, w.delete, idempotency_key=w.idempotency_key
+            )
+            for w in writes
+        ]
+        assert [r.snaptoken for r in got_g] == [r.snaptoken for r in got_s]
+        live = p.get_relation_tuples(RelationQuery())[0]
+        twin_rows = twin.get_relation_tuples(RelationQuery())[0]
+        key = lambda t: (t.namespace, t.object, t.relation, str(t.subject))
+        assert sorted(map(key, live)) == sorted(map(key, twin_rows))
+        # fresh read-your-writes snapshot, decisions vs the oracle
+        engine.snapshot()
+        got = engine.batch_check(queries)
+        for q, g in zip(queries, got):
+            assert g == oracle.subject_is_allowed(q), f"seed={seed} rnd={rnd}: {q}"
+        # stack folds mid-stream: maintenance passes fold the oldest
+        # segments while later rounds keep writing
+        if rnd % 3 == 2:
+            for _ in range(6):
+                engine._refresh_pass()
+                if not engine._snapshot.has_overlay:
+                    break
+            got = engine.batch_check(queries)
+            for q, g in zip(queries, got):
+                assert g == oracle.subject_is_allowed(q), (
+                    f"seed={seed} rnd={rnd} post-fold: {q}"
+                )
+
+
+# -- satellite: the serving path never pays the fold -------------------------
+
+
+def test_serving_never_blocks_on_compaction():
+    """Arm a DELAY fault at the compaction crash point and trip the
+    overlay budget: the serving ``snapshot()`` (read-your-writes) and
+    ``snapshot_serving()`` calls must return without eating the delay —
+    the fold happens in the supervised maintenance pass only."""
+    p = mem_store()
+    base = [T("d", "doc", "view", SubjectSet("g", "s0", "m"))]
+    N = 6
+    for i in range(N):
+        base.append(T("g", f"s{i}", "m", SubjectSet("g", f"s{(i + 1) % N}", "m")))
+    base.append(T("g", "s1", "m", SubjectID("u0")))
+    p.write_relation_tuples(*base)
+    engine = TpuCheckEngine(
+        p, p.namespaces, compact_after_s=3600.0, overlay_edge_budget=4
+    )
+    engine.snapshot()
+    DELAY = 1.5
+    with faults.injected("compaction", delay_s=DELAY):
+        burst = [
+            T("g", f"s{i % N}", "m", SubjectID(f"b{i}")) for i in range(12)
+        ]
+        p.write_relation_tuples(*burst)
+        t0 = time.monotonic()
+        snap = engine.snapshot()  # read-your-writes across the burst
+        dt = time.monotonic() - t0
+        assert snap.snapshot_id == p.watermark()
+        assert snap.has_overlay, "serving snapshot() folded inline"
+        assert dt < DELAY, f"serving snapshot() ate the fold wall ({dt:.2f}s)"
+        # while the background fold sleeps in the fault, the serving
+        # plane keeps answering from the installed snapshot
+        for _ in range(3):
+            t0 = time.monotonic()
+            engine.snapshot_serving()
+            assert time.monotonic() - t0 < DELAY / 2
+    # fault cleared: maintenance folds and decisions stay oracle-true
+    deadline = time.monotonic() + 20.0
+    while engine._snapshot.has_overlay and time.monotonic() < deadline:
+        engine._refresh_pass()
+    assert not engine._snapshot.has_overlay
+    oracle = CheckEngine(p)
+    qs = [T("d", "doc", "view", SubjectID(f"b{i}")) for i in range(12)]
+    qs.append(T("d", "doc", "view", SubjectID("nope")))
+    got = engine.batch_check(qs)
+    assert got == [oracle.subject_is_allowed(q) for q in qs]
+
+
+def test_fold_runs_are_segment_bounded():
+    """A large overlay folds across MULTIPLE bounded passes (no rebuild
+    cliff): each maintenance pass retires at least one segment and the
+    fold_runs counter tracks them."""
+    p = mem_store()
+    base = [T("d", "doc", "view", SubjectSet("g", "s0", "m"))]
+    N = 8
+    for i in range(N):
+        base.append(T("g", f"s{i}", "m", SubjectSet("g", f"s{(i + 1) % N}", "m")))
+    p.write_relation_tuples(*base)
+    engine = TpuCheckEngine(
+        p, p.namespaces,
+        compact_after_s=3600.0, overlay_edge_budget=2, fold_segment_edges=1,
+    )
+    engine.snapshot()
+    # several separate deltas -> several segments on the log
+    for i in range(5):
+        p.write_relation_tuples(T("g", f"s{i}", "m", SubjectID(f"x{i}")))
+        engine.snapshot()
+    assert len(engine._seg_log) >= 3
+    runs0 = engine.maintenance.snapshot().get("fold_runs", 0)
+    deadline = time.monotonic() + 20.0
+    # bounded folds retire segments until occupancy is back under budget;
+    # the residue inside budget waits for the quiet timer (no cliff)
+    while (
+        engine._overlay_edge_count(engine._snapshot) > engine._max_overlay_edges
+        and time.monotonic() < deadline
+    ):
+        engine._refresh_pass()
+    m = engine.maintenance.snapshot()
+    assert (
+        engine._overlay_edge_count(engine._snapshot) <= engine._max_overlay_edges
+    ), "maintenance passes never brought the overlay back under budget"
+    assert m.get("fold_runs", 0) - runs0 >= 2, (
+        "large overlay folded in one cliff instead of bounded segments"
+    )
+    oracle = CheckEngine(p)
+    qs = [T("d", "doc", "view", SubjectID(f"x{i}")) for i in range(5)]
+    got = engine.batch_check(qs)
+    assert got == [oracle.subject_is_allowed(q) for q in qs]
