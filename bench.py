@@ -497,10 +497,15 @@ def run_depth_sweep(rng):
     Each chain carries a back-edge (bottom level → top) so its interior
     rows stay active instead of peeling into the host walk: the sweep
     must measure the ITERATED depth the 10M depth-8 config pays, not the
-    host-propagated kind. Knobs: BENCH_DEPTH_TUPLES / BENCH_DEPTH_CHECKS
-    / BENCH_DEPTHS; BENCH_DEPTH_ASSERT=1 (CI bench-smoke) additionally
-    asserts a nonzero label hit rate and zero mismatches vs the CPU
-    oracle at every depth."""
+    host-propagated kind. Each depth also runs a landmark-budget sweep —
+    a second engine capped at BENCH_LANDMARK_CAP landmarks (default a
+    quarter of the interior rows) against the default uncapped device
+    stream — reporting both hit rates and build times. Knobs:
+    BENCH_DEPTH_TUPLES / BENCH_DEPTH_CHECKS / BENCH_DEPTHS /
+    BENCH_LANDMARK_CAP; BENCH_DEPTH_ASSERT=1 (CI bench-smoke)
+    additionally asserts a nonzero label hit rate, zero mismatches vs
+    the CPU oracle at every depth, and that the uncapped hit rate never
+    trails the capped one."""
     from keto_tpu import namespace as namespace_pkg
     from keto_tpu.check import CheckEngine
     from keto_tpu.check.tpu_engine import TpuCheckEngine
@@ -574,6 +579,7 @@ def run_depth_sweep(rng):
         t0 = time.perf_counter()
         snap = eng_on.snapshot()
         build_s = time.perf_counter() - t0
+        eng_on.labels_settled()  # join the overlapped build before timing
         maint0 = eng_on.maintenance.snapshot()
         got_on, qps_on = timed_pass(eng_on)
         maint1 = eng_on.maintenance.snapshot()
@@ -603,6 +609,11 @@ def run_depth_sweep(rng):
             "label_build_s": round(
                 eng_on.maintenance.snapshot().get("label_build_last_ms", 0.0) / 1e3, 3
             ),
+            "label_build_s_device": round(
+                eng_on.maintenance.snapshot().get("label_build_device_last_ms", 0.0)
+                / 1e3,
+                3,
+            ),
             "label_coverage": eng_on.maintenance.snapshot().get("label_coverage"),
             "snapshot_build_s": round(build_s, 2),
             "bfs_steps_p50": steps["p50_ms"],
@@ -611,18 +622,54 @@ def run_depth_sweep(rng):
             "label_oracle_mismatches": mism_on,
             "bfs_oracle_mismatches": mism_off,
         }
+        # landmark-budget sweep: the capped build (the pre-device 128k-cap
+        # world, scaled to this graph) vs the default uncapped stream.
+        # Coverage is the tentpole's whole point — the uncapped hit rate
+        # must never trail the capped one
+        cap = int(os.environ.get("BENCH_LANDMARK_CAP", 0)) or max(
+            1, snap.num_int // 4
+        )
+        eng_cap = TpuCheckEngine(store, store.namespaces, labels_landmarks=cap)
+        eng_cap.labels_settled()
+        mc0 = eng_cap.maintenance.snapshot()
+        got_cap = eng_cap.batch_check(queries)
+        mc1 = eng_cap.maintenance.snapshot()
+        served_c = mc1.get("label_checks", 0) - mc0.get("label_checks", 0)
+        fell_c = mc1.get("label_fallbacks", 0) - mc0.get("label_fallbacks", 0)
+        capped_hit = served_c / max(1, served_c + fell_c)
+        assert got_cap == got_on, (
+            f"depth {D}: landmark cap changed decisions — caps may only "
+            "shrink coverage, never correctness"
+        )
+        rec["landmark_budget"] = {
+            "capped_landmarks": cap,
+            "capped_hit_rate": round(capped_hit, 4),
+            "capped_coverage": mc1.get("label_coverage"),
+            "capped_label_build_s": round(
+                mc1.get("label_build_last_ms", 0.0) / 1e3, 3
+            ),
+            "uncapped_hit_rate": round(hit_rate, 4),
+        }
+        eng_cap.close()
+
         out[f"depth_{D}"] = rec
         log(
             f"[depth] D={D}: labels {qps_on:,.0f} checks/s vs bfs "
             f"{qps_off:,.0f} ({rec['label_speedup']}x), hit rate "
-            f"{hit_rate:.1%}, build {rec['label_build_s']}s, bfs steps "
-            f"p50={steps['p50_ms']:.0f} p99={steps['p99_ms']:.0f}, "
+            f"{hit_rate:.1%} (capped@{cap}: {capped_hit:.1%}), build "
+            f"{rec['label_build_s']}s (device {rec['label_build_s_device']}s), "
+            f"bfs steps p50={steps['p50_ms']:.0f} p99={steps['p99_ms']:.0f}, "
             f"mismatches on={mism_on} off={mism_off}"
         )
         if must_assert:
             assert hit_rate > 0, f"depth {D}: label path never engaged"
             assert mism_on == 0, f"depth {D}: label path diverged from oracle"
             assert wrong_on == 0, f"depth {D}: wrong decisions vs analytic expectation"
+            assert hit_rate >= capped_hit - 1e-9, (
+                f"depth {D}: uncapped hit rate {hit_rate:.4f} trails the "
+                f"capped build's {capped_hit:.4f} — the no-cap stream lost "
+                "coverage"
+            )
     return out
 
 
@@ -791,6 +838,7 @@ def run_config4(rng):
     t0 = time.perf_counter()
     engine.batch_check(queries)
     log(f"[c4] warmup/compile: {time.perf_counter()-t0:.1f}s")
+    engine.labels_settled()  # join the overlapped label build before timing
 
     reps = int(os.environ.get("BENCH_REPS", 3))
     engine.bfs_steps_stats.reset()
@@ -814,8 +862,12 @@ def run_config4(rng):
     lab_fell = maint1.get("label_fallbacks", 0) - maint0.get("label_fallbacks", 0)
     label_hit_rate = round(lab_served / max(1, lab_served + lab_fell), 4)
     label_build_s = round(maint1.get("label_build_last_ms", 0.0) / 1e3, 3)
+    label_build_s_device = round(
+        maint1.get("label_build_device_last_ms", 0.0) / 1e3, 3
+    )
     log(
-        f"[c4] label hit rate {label_hit_rate:.1%}, build {label_build_s}s; "
+        f"[c4] label hit rate {label_hit_rate:.1%}, build {label_build_s}s "
+        f"(device sweeps {label_build_s_device}s); "
         f"bfs steps p50={bfs_steps['p50_ms']:.0f} p99={bfs_steps['p99_ms']:.0f} "
         f"over {bfs_steps['count']} BFS slices"
     )
@@ -870,6 +922,7 @@ def run_config4(rng):
         "bfs_slices": bfs_steps["count"],
         "label_hit_rate": label_hit_rate,
         "label_build_s": label_build_s,
+        "label_build_s_device": label_build_s_device,
         **stream_metrics,
         "stream_wrong": stream_wrong,
         "ingest_s": round(ingest_s, 2),

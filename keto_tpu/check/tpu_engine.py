@@ -1074,6 +1074,10 @@ class TpuCheckEngine:
         labels_enabled: bool = True,
         labels_max_width: int = 64,
         labels_landmarks: int = 0,
+        labels_device_build: bool = True,
+        labels_min_gain: float = 0.0,
+        labels_batch: int = 64,
+        labels_device_min_edges: int = 65536,
         hbm_budget_bytes: int = 0,
         audit_sample_rate: float = 0.0,
         device_build_enabled: bool = True,
@@ -1132,6 +1136,21 @@ class TpuCheckEngine:
         self._labels_enabled = bool(labels_enabled)
         self._labels_max_width = int(labels_max_width)
         self._labels_landmarks = int(labels_landmarks)
+        # device label construction (keto_tpu/graph/label_build.py):
+        # batched frontier sweeps replace the per-landmark host BFS on
+        # graphs past labels_device_min_edges interior edge slots —
+        # entry-identical by contract, landmark cap LIFTED (the
+        # min_gain early exit bounds the build instead), and the build
+        # overlaps the snapshot pipeline's host phases (cache_save)
+        self._labels_device_build = bool(labels_device_build)
+        self._labels_min_gain = float(labels_min_gain)
+        self._labels_batch = int(labels_batch)
+        self._labels_device_min_edges = int(labels_device_min_edges)
+        #: the in-flight background label build (full-rebuild overlap);
+        #: snapcache.save_snapshot joins it via labels_wait just before
+        #: writing the label segments, so bulk segment writing and the
+        #: device sweeps genuinely overlap
+        self._label_build_thread: Optional[threading.Thread] = None
         # snapshot id last counted as a label invalidation (overlay
         # mutated the interior subgraph) — one count per transition
         self._label_blocked_snap: Optional[int] = None
@@ -1543,6 +1562,7 @@ class TpuCheckEngine:
         self._refresh_task.stop()
         self._cache_task.stop()
         self._audit_task.stop()
+        self._label_build_wait()
 
     # -- HBM budget governor (keto_tpu/driver/hbm.py) ------------------------
 
@@ -2001,6 +2021,7 @@ class TpuCheckEngine:
         stale; oversized overlays still apply and compact off-path)."""
         snap = self._snapshot
         wm = self._store.watermark()
+        fold_failed = False
         if snap is None and self._cache_dir is not None and not delta_only:
             snap = self._load_cache_locked(wm)
         # an over-budget overlay owes a fold even when the snapshot is
@@ -2062,10 +2083,13 @@ class TpuCheckEngine:
                         try:
                             folded = self._fold_locked(new, full=force_full)
                         except Exception:
-                            # a broken fold must not kill the refresh:
-                            # count it, log it, and let the full rebuild
-                            # below re-establish a clean base layout
-                            self.maintenance.incr("compaction_failures")
+                            # a broken fold must not kill the refresh: log
+                            # it and let the full rebuild below re-establish
+                            # a clean base layout. The failure counter is
+                            # deferred until the rebuild is recorded so an
+                            # unlocked reader never observes the failure
+                            # without its fallback
+                            fold_failed = True
                             _log.warning(
                                 "overlay fold failed; falling back to a full rebuild",
                                 exc_info=True,
@@ -2095,13 +2119,17 @@ class TpuCheckEngine:
                 chunk_rows=self._build_chunk_rows,
             )
             self._upload_buckets(new)
-            with self.build_progress.phase("labels"):
-                self._ensure_labels(new)
+            # labels phase overlaps the rest of the pipeline: the device
+            # sweeps run on a background thread while cache_save and the
+            # remaining host work proceed; BFS serves the gap
+            self._start_label_build(new)
             self._last_full_build_s = time.monotonic() - t0
             self.maintenance.incr("full_rebuilds")
             self.maintenance.observe_ms(
                 "full_rebuild", self._last_full_build_s * 1e3
             )
+            if fold_failed:
+                self.maintenance.incr("compaction_failures")
         self._apply_ell_patch(new)
         self._upload_overlay(new)
         self._snapshot = new
@@ -2215,7 +2243,9 @@ class TpuCheckEngine:
         # re-derivation sorts run on the device under the same governed
         # policy as full builds — write-heavy tenants stop paying the
         # host-side rebuild tail (keto_tpu/graph/device_build.py)
-        got = compact_snapshot(snap, sorter=self._build_sorter)
+        got = compact_snapshot(
+            snap, sorter=self._build_sorter, label_patcher=self._label_patcher
+        )
         if got is None:
             return None
         new = got.snapshot
@@ -2242,6 +2272,13 @@ class TpuCheckEngine:
         if got.labels == "patched":
             self.maintenance.incr("label_patches")
             self.maintenance.observe_ms("label_patch", new.labels.build_ms)
+        elif got.labels == "patch_abort":
+            # the incremental patch ran past its visit budget (or the
+            # resume sets were truncated) — no longer invisible: counted,
+            # bridged to /metrics, and the device rebuild below (this IS
+            # the supervised maintenance pass) replaces the stale index
+            self.maintenance.incr("label_patch_aborts")
+            self.maintenance.incr("label_rebuilds")
         elif got.labels == "rebuild":
             self.maintenance.incr("label_rebuilds")
         self._ensure_labels(new)
@@ -2394,7 +2431,8 @@ class TpuCheckEngine:
         t0 = time.monotonic()
         with self.build_progress.phase("cache_save"):
             path = snapcache.save_snapshot(
-                snap, self._cache_dir, shards=max(1, self._shard_count)
+                snap, self._cache_dir, shards=max(1, self._shard_count),
+                labels_wait=self._label_build_wait,
             )
         if path is not None:
             self.maintenance.incr("cache_saves")
@@ -2413,7 +2451,8 @@ class TpuCheckEngine:
 
         t0 = time.monotonic()
         path = snapcache.save_snapshot(
-            snap, self._cache_dir, shards=max(1, self._shard_count)
+            snap, self._cache_dir, shards=max(1, self._shard_count),
+            labels_wait=self._label_build_wait,
         )
         if path is not None:
             self.maintenance.incr("cache_saves")
@@ -2757,14 +2796,9 @@ class TpuCheckEngine:
         if not self._labels_enabled or self._labels_suspended:
             return
         if snap.labels is None:
-            from keto_tpu.graph.labels import build_labels
-
-            landmarks = self._labels_landmarks
-            if landmarks == 0:
-                landmarks = min(snap.num_int, self.LABELS_AUTO_CAP)
-            snap.labels = build_labels(
-                snap, max_width=self._labels_max_width, landmarks=landmarks
-            )
+            snap.labels = self._build_label_index(snap)
+            if snap.labels is None:
+                return
             self.maintenance.incr("label_builds")
             self.maintenance.observe_ms("label_build", snap.labels.build_ms)
         if self._labels_dev(snap) is None:
@@ -2797,6 +2831,225 @@ class TpuCheckEngine:
         the row-striped stacks in sharded mode, the replicated pair
         otherwise."""
         return snap.device_shard_labels if self._sharded else snap.device_labels
+
+    def _interior_ell_slots(self, snap: GraphSnapshot) -> int:
+        """Padded interior ELL edge slots — the cheap size signal the
+        device-build gate compares against labels_device_min_edges
+        (below it, dispatch + transfer overhead beats the host BFS)."""
+        return sum(
+            int(b.n) * int(np.asarray(b.nbrs).shape[1]) for b in snap.buckets
+        )
+
+    def _build_label_index(self, snap: GraphSnapshot):
+        """Construct the 2-hop index for ``snap`` through the configured
+        path. Device (keto_tpu/graph/label_build.py): batched frontier
+        sweeps, NO landmark auto-cap — the ``labels_min_gain`` early
+        exit bounds the build — with the transient sweep footprint
+        planned ``evict=False`` under the governor's ``build`` tag like
+        every other device-build transient (a label build must never
+        push serving state off the chip). Host: the original
+        per-landmark BFS with the 128k auto-cap, the fallback for tiny
+        graphs, missing backends, plan refusals, and device errors —
+        entry-identical by the builder's contract either way. Any
+        truncation (cap or min_gain) is now LOUD: a structured warning
+        with the achieved coverage plus the
+        ``keto_label_build_truncated_total`` family."""
+        from keto_tpu.graph.labels import build_labels
+
+        n = snap.num_int
+        landmarks = self._labels_landmarks
+        if self._labels_device_build and n > 0:
+            from keto_tpu.graph import label_build
+            from keto_tpu.graph.device_build import device_available
+
+            eligible = (
+                device_available()
+                and self._interior_ell_slots(snap) >= self._labels_device_min_edges
+            )
+            if eligible:
+                need = label_build.estimate_build_bytes(
+                    n, self._labels_max_width, self._labels_batch
+                )
+                if not self.hbm.plan(need, what="label build transient", evict=False):
+                    # memory pressure: the build yields, serving state
+                    # stays — same policy as GovernedSorter
+                    self.maintenance.incr("label_device_build_skipped")
+                else:
+                    self.hbm.register("build", need)
+                    try:
+                        idx, info = label_build.device_build_labels(
+                            snap,
+                            max_width=self._labels_max_width,
+                            landmarks=landmarks,
+                            min_gain=self._labels_min_gain,
+                            batch=self._labels_batch,
+                            mesh=self._mesh if self._sharded else None,
+                            shard_count=self._shard_count,
+                            progress_cb=self._label_build_progress,
+                        )
+                    except Exception:
+                        _log.warning(
+                            "device label build failed; falling back to the "
+                            "host path (entry-identical)",
+                            exc_info=True,
+                        )
+                        self.maintenance.incr("label_device_build_errors")
+                    else:
+                        self.maintenance.incr("label_device_builds")
+                        self.maintenance.observe_ms(
+                            "label_build_device", idx.build_ms
+                        )
+                        self.maintenance.set_gauge(
+                            "label_build_batches", info.batches
+                        )
+                        if info.truncated:
+                            self._note_label_truncation(info.truncated, idx)
+                        return idx
+                    finally:
+                        self.hbm.release("build")
+        if landmarks == 0:
+            landmarks = min(n, self.LABELS_AUTO_CAP)
+        idx = build_labels(
+            snap, max_width=self._labels_max_width, landmarks=landmarks
+        )
+        if landmarks < n:
+            self._note_label_truncation("cap", idx)
+        return idx
+
+    def _label_build_progress(self, done: int, total: int, entries: int) -> None:
+        """Batch-level narration for an in-flight label build: gauges
+        BuildProgress/health read while the sweeps run."""
+        self.maintenance.set_gauge("label_build_landmarks", done)
+        self.maintenance.set_gauge("label_build_landmarks_total", total)
+        self.maintenance.set_gauge("label_build_entries", entries)
+
+    def _note_label_truncation(self, reason: str, idx) -> None:
+        """Coverage truncation is a serving-quality event, not a silent
+        default: count it by reason (``cap`` — the landmark budget, or
+        ``min_gain`` — the marginal-coverage early exit) and log the
+        achieved coverage so operators can see exactly what the depth
+        tax falls back to BFS for."""
+        self.maintenance.incr(f"label_build_truncated_{reason}")
+        _log.warning(
+            "label build truncated (%s): %d/%d landmarks processed, "
+            "coverage_ratio=%.4f — uncovered deep checks fall back to the "
+            "BFS kernel (bit-identically)",
+            reason, idx.n_landmarks, idx.n, idx.coverage,
+        )
+
+    def _start_label_build(self, snap: GraphSnapshot) -> None:
+        """The full-rebuild pipeline's labels phase, overlapped: kick
+        the (device) label construction on a background thread so
+        ``cache_save`` and the rest of the refresh's host work proceed
+        while the sweeps run; the engine serves the fresh snapshot with
+        the BFS fallback until the index installs under the lock.
+        Synchronous when the index is already present (cache reload —
+        placement is cheap), in multi-controller mode (background
+        collectives must not interleave with serving dispatches across
+        hosts), or when labels are off."""
+        if not self._labels_enabled or self._labels_suspended:
+            return
+        if snap.labels is not None or self._multiprocess:
+            with self.build_progress.phase("labels"):
+                self._ensure_labels(snap)
+            return
+
+        def work():
+            with self.build_progress.phase("labels"):
+                try:
+                    idx = self._build_label_index(snap)
+                except Exception:
+                    self.maintenance.incr("label_build_failures")
+                    _log.warning(
+                        "background label build failed; serving stays on "
+                        "the BFS path",
+                        exc_info=True,
+                    )
+                    return
+            with self._lock:
+                if (
+                    self._closing
+                    or self._labels_suspended
+                    or not self._labels_enabled
+                ):
+                    return
+                self._install_labels_locked(snap, idx)
+
+        t = threading.Thread(target=work, name="label-build", daemon=True)
+        self._label_build_thread = t
+        t.start()
+
+    def _install_labels_locked(self, snap: GraphSnapshot, idx) -> None:
+        """Land a background-built index (caller holds the lock) — ONLY
+        onto the exact snapshot it was built for. A later snapshot that
+        merely matches on num_int is not safe: a fold or rebuild can
+        change the interior edge set at the same node count, and a stale
+        index would serve wrong denies. Deltas that extend ``snap``'s
+        overlay in place are fine (the label path already gates on
+        lab_dirty); if serving moved to a different snapshot object, the
+        index is dropped and the next rebuild's build starts fresh."""
+        if idx is None:
+            return
+        if snap.labels is None and snap.num_int == idx.n:
+            snap.labels = idx
+            self.maintenance.incr("label_builds")
+            self.maintenance.observe_ms("label_build", idx.build_ms)
+        if snap.labels is idx and self._snapshot is snap:
+            self._ensure_labels(snap)
+
+    def _label_build_wait(self) -> None:
+        """Join the in-flight background label build (the
+        ``labels_wait`` seam snapcache.save_snapshot invokes just before
+        writing the label segments — everything before them overlaps
+        the sweeps, and the saved cache still carries the index)."""
+        t = self._label_build_thread
+        if t is not None and t.is_alive():
+            t.join()
+
+    def labels_settled(self) -> bool:
+        """Force the lazy snapshot refresh and block until the overlapped
+        label build (if any) has installed. Serving never needs this —
+        checks fall back to the BFS kernel bit-identically while the
+        build is in flight — but deterministic consumers (tests, benches,
+        warm-up hooks) use it to pin down the moment the label fast path
+        is live. Returns whether the serving snapshot carries an index."""
+        self.snapshot()
+        self._label_build_wait()
+        snap = self._snapshot
+        return snap is not None and snap.labels is not None
+
+    def _label_patcher(self, idx, snap, added_edges, visit_budget: int = 65536):
+        """Compaction's incremental label patch, routed through the
+        device sweep path when eligible (``device_patch_labels`` — the
+        exact ``patch_labels`` semantics, including the abort outcome,
+        as bit-packed lane sweeps) and through the host walk otherwise.
+        None means the patch aborted and the caller must rebuild."""
+        if self._labels_device_build:
+            from keto_tpu.graph.device_build import device_available
+
+            if (
+                device_available()
+                and self._interior_ell_slots(snap) >= self._labels_device_min_edges
+            ):
+                from keto_tpu.graph import label_build
+
+                try:
+                    return label_build.device_patch_labels(
+                        idx, snap, added_edges, visit_budget=visit_budget,
+                        batch=self._labels_batch,
+                        mesh=self._mesh if self._sharded else None,
+                        shard_count=self._shard_count,
+                    )
+                except Exception:
+                    _log.warning(
+                        "device label patch failed; retrying on the host "
+                        "path (entry-identical)",
+                        exc_info=True,
+                    )
+                    self.maintenance.incr("label_device_build_errors")
+        from keto_tpu.graph.labels import patch_labels
+
+        return patch_labels(idx, snap, added_edges, visit_budget=visit_budget)
 
     def _upload_labels(self, snap: GraphSnapshot) -> None:
         idx = snap.labels

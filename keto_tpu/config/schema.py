@@ -173,7 +173,27 @@ CONFIG_SCHEMA = {
                 "labels_landmarks": {
                     "type": "integer",
                     "default": 0,
-                    "description": "How many degree-ranked interior nodes to process as 2-hop landmarks. 0 = auto (all interior rows up to a 131072 cap — full coverage on every graph the depth tax hurts, bounded build time on huge shallow ones). Fewer landmarks shrink label build time and coverage; uncovered pairs fall back to BFS, never to a wrong answer.",
+                    "description": "How many degree-ranked interior nodes to process as 2-hop landmarks. 0 = auto: the device build (serve.labels_device_build) streams ALL interior rows — no coverage cap — stopping early only via serve.labels_min_gain; the host fallback keeps the 131072 safety cap (its per-landmark BFS is serial Python). Fewer landmarks shrink build time and coverage; uncovered pairs fall back to BFS, never to a wrong answer. Either truncation emits keto_label_build_truncated_total with the achieved coverage ratio.",
+                },
+                "labels_device_build": {
+                    "type": "boolean",
+                    "default": True,
+                    "description": "Build the 2-hop label index as batched landmark BFS sweeps on the accelerator (bit-packed frontier waves, 64 landmarks per dispatch, PLL pruning as an ANDNOT against covered rows) instead of the serial host walk — entry-set-identical, orders of magnitude faster on deep graphs, and overlapped with the rest of the snapshot pipeline. The build's transient footprint is planned against the HBM governor (evict=False) and falls back to the host path when the plan is refused, the graph is under serve.labels_device_min_edges, or the sweep errors.",
+                },
+                "labels_min_gain": {
+                    "type": "number",
+                    "default": 0.0,
+                    "description": "Early-exit threshold for the uncapped device label build: stop streaming landmarks once a batch's marginal coverage gain (new label entries per landmark per interior row) drops below this. 0.0 processes every landmark (exact full build). Nonzero values trade tail coverage for build time on graphs whose low-degree tail adds nothing — truncation is reported via keto_label_build_truncated_total{reason=\"min_gain\"} and the uncovered pairs fall back to BFS.",
+                },
+                "labels_batch": {
+                    "type": "integer",
+                    "default": 64,
+                    "description": "Landmarks swept concurrently per device dispatch (lanes of the bit-packed frontier words, rounded up to a multiple of 32 internally). Larger batches amortize dispatch overhead but widen the frontier/covered matrices (HBM transient scales linearly) and raise the odds of an intra-batch dependency restart; 64 is right for almost everyone.",
+                },
+                "labels_device_min_edges": {
+                    "type": "integer",
+                    "default": 65536,
+                    "description": "Interior adjacency slots (ELL rows x width) below which the label build skips the device path and uses the host walk directly — tiny graphs finish on host faster than one XLA dispatch. Set 0 to force the device path everywhere (parity tests do).",
                 },
                 "hbm_budget_bytes": {
                     "type": "integer",

@@ -68,10 +68,12 @@ FALLBACK_BUDGET_BYTES = 4 << 30
 #: of the budget — hysteresis so the ladder doesn't oscillate at the edge
 RESTORE_FRAC = 0.7
 
-#: the canonical ledger tags, in scrape order ("build" is the streaming
-#: snapshot pipeline's transient sort footprint — registered around each
-#: device-build dispatch and released before the snapshot installs,
-#: keto_tpu/graph/device_build.py GovernedSorter; "staging" is the
+#: the canonical ledger tags, in scrape order ("build" is the snapshot
+#: pipeline's transient device footprint — the GovernedSorter's sort
+#: workspace (keto_tpu/graph/device_build.py) and the label build's
+#: frontier/cover matrices (keto_tpu/graph/label_build.py), registered
+#: around the dispatches and released before the result installs;
+#: "staging" is the
 #: persistent entry-staging pool behind the donated dispatch buffers,
 #: keto_tpu/check/tpu_engine.py _StagingPool — reconciled against the
 #: pool's own accounting at every scrape)

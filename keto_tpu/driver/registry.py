@@ -348,6 +348,18 @@ class Registry:
                     labels_landmarks=int(
                         self._config.get("serve.labels_landmarks", 0)
                     ),
+                    labels_device_build=bool(
+                        self._config.get("serve.labels_device_build", True)
+                    ),
+                    labels_min_gain=float(
+                        self._config.get("serve.labels_min_gain", 0.0)
+                    ),
+                    labels_batch=int(
+                        self._config.get("serve.labels_batch", 64)
+                    ),
+                    labels_device_min_edges=int(
+                        self._config.get("serve.labels_device_min_edges", 65536)
+                    ),
                     hbm_budget_bytes=int(
                         self._config.get("serve.hbm_budget_bytes", 0)
                     ),
@@ -931,6 +943,44 @@ class Registry:
             "label build/patch/invalidation events ride "
             "keto_maintenance_events_total.",
             label_coverage,
+        )
+
+        def label_truncations():
+            counters, _, _ = maintenance_raw()
+            return [
+                (("cap",), float(counters.get("label_build_truncated_cap", 0))),
+                (
+                    ("min_gain",),
+                    float(counters.get("label_build_truncated_min_gain", 0)),
+                ),
+            ]
+
+        m.register_callback(
+            "keto_label_build_truncated_total", "counter",
+            "Label builds that stopped before processing every interior "
+            "landmark, by reason (cap: the host path's 131072 landmark "
+            "safety cap; min_gain: the device build's "
+            "serve.labels_min_gain early exit). Each one logs the "
+            "achieved coverage ratio; uncovered deep checks fall back to "
+            "the BFS kernel bit-identically, paying the depth tax the "
+            "labels exist to remove.",
+            label_truncations, ("reason",),
+        )
+
+        def label_patch_aborts():
+            counters, _, _ = maintenance_raw()
+            yield (), float(counters.get("label_patch_aborts", 0))
+
+        m.register_callback(
+            "keto_label_patch_aborts_total", "counter",
+            "Incremental label patches (compaction folding overlay "
+            "inserts into the index) abandoned on the visit budget — "
+            "each abort schedules a full device rebuild in the same "
+            "supervised maintenance pass (rides "
+            "keto_maintenance_events_total as label_rebuilds). A rising "
+            "rate means overlay inserts land in dense regions; raise the "
+            "budget or compact more often.",
+            label_patch_aborts,
         )
 
         # streaming slice scheduler: per-route landing counts, the

@@ -67,8 +67,10 @@ class CompactionResult:
     #: what happened to the 2-hop label index (keto_tpu/graph/labels.py):
     #: "none" (no index on the input), "kept" (interior subgraph
     #: unchanged — index reused as-is), "patched" (folded ELL inserts
-    #: applied incrementally), or "rebuild" (folded ELL deletions, or
-    #: the incremental patch ran past its budget — the engine rebuilds)
+    #: applied incrementally), "patch_abort" (the incremental patch ran
+    #: past its visit budget or the resume sets were truncated — the
+    #: engine counts ``label_patch_aborts`` and rebuilds), or "rebuild"
+    #: (folded ELL deletions — deletion from a 2-hop cover is a rebuild)
     labels: str = "none"
     #: device bytes the touched-bucket re-uploads will place (the old and
     #: new copies of a touched bucket are co-resident while in-flight
@@ -95,7 +97,7 @@ def _removed_mask(keys: np.ndarray, removed: Optional[np.ndarray]) -> np.ndarray
 
 
 def compact_snapshot(
-    snap: GraphSnapshot, max_ext: int = 65536, sorter=None
+    snap: GraphSnapshot, max_ext: int = 65536, sorter=None, label_patcher=None
 ) -> Optional[CompactionResult]:
     """Fold ``snap``'s overlay into its base layout. Returns the compacted
     snapshot (same watermark, no overlay) plus the touched bucket indices,
@@ -104,7 +106,10 @@ def compact_snapshot(
     expensive tail — re-deriving the transposed CSR and both list layouts
     from the spliced forward CSR — runs its edge-scale sorts on the
     device when given, bit-identically (the splice itself is O(E)
-    vectorized scatters and stays host-side)."""
+    vectorized scatters and stays host-side). ``label_patcher`` swaps the
+    incremental label patch implementation (the engine passes its
+    device-sweep resumption, keto_tpu/graph/label_build.py) — same
+    ``patch_labels`` signature and abort contract."""
     if not snap.has_overlay:
         return CompactionResult(snapshot=snap)
 
@@ -427,16 +432,19 @@ def compact_snapshot(
             # too — leave labels off; the engine rebuilds off-path
             labels_state = "rebuild"
         elif ov_ell is not None and ov_ell.shape[0]:
-            from keto_tpu.graph.labels import patch_labels
+            if label_patcher is None:
+                from keto_tpu.graph.labels import patch_labels as label_patcher
 
-            patched = patch_labels(
+            patched = label_patcher(
                 idx, new_snap, [tuple(e) for e in ov_ell.tolist()]
             )
             if patched is not None:
                 new_snap.labels = patched
                 labels_state = "patched"
             else:
-                labels_state = "rebuild"  # budget/truncation — be safe
+                # budget/truncation — be safe; the engine counts the
+                # abort and schedules the (device) rebuild
+                labels_state = "patch_abort"
         else:
             # interior subgraph untouched (sink splices, host-walk edges,
             # host-masked tombstones only): the index is still exact

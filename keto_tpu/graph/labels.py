@@ -127,6 +127,10 @@ class LabelIndex:
     build_ms: float = 0.0
     #: total stored entries (both sides) — operators size budgets off this
     n_entries: int = 0
+    #: which construction path produced the index: "host" (this module's
+    #: per-landmark Python BFS) or "device" (the batched frontier sweeps
+    #: of keto_tpu/graph/label_build.py — entry-identical by contract)
+    backend: str = "host"
     device: object = field(default=None, compare=False)  # jnp arrays, engine-set
 
     @property
@@ -216,6 +220,19 @@ def _finalize(
     )
 
 
+def landmark_order(
+    out_indptr: np.ndarray, in_indptr: np.ndarray, n: int
+) -> np.ndarray:
+    """THE landmark processing order: degree descending, device id
+    ascending on ties — deterministic across hosts (the multi-controller
+    lockstep contract). Shared by ``build_labels`` and the device
+    builder (keto_tpu/graph/label_build.py) so their entry-identity
+    contract starts from the identical rank list."""
+    out_deg = np.diff(out_indptr)
+    in_deg = np.diff(in_indptr)
+    return np.lexsort((np.arange(n), -(out_deg + in_deg)))
+
+
 def _csr_row(indptr, indices, u: int) -> np.ndarray:
     return indices[indptr[u] : indptr[u + 1]]
 
@@ -291,10 +308,8 @@ def build_labels(snap, max_width: int = 64, landmarks: int = 0) -> LabelIndex:
     t0 = time.monotonic()
     n = snap.num_int
     out_indptr, out_indices, in_indptr, in_indices = interior_adjacency(snap)
-    out_deg = np.diff(out_indptr)
-    in_deg = np.diff(in_indptr)
     # rank: degree descending, id ascending (deterministic across hosts)
-    order = np.lexsort((np.arange(n), -(out_deg + in_deg)))
+    order = landmark_order(out_indptr, in_indptr, n)
     K = n if landmarks <= 0 else min(int(landmarks), n)
 
     out_sets: list = [set() for _ in range(n)]

@@ -306,7 +306,7 @@ class CachedInterned:
 
 
 def save_snapshot(
-    snap: GraphSnapshot, cache_dir: str, shards: int = 1
+    snap: GraphSnapshot, cache_dir: str, shards: int = 1, labels_wait=None
 ) -> Optional[str]:
     """Serialize ``snap`` under ``cache_dir``; returns the cache path, or
     None when the snapshot isn't cacheable (pending overlay, an interner
@@ -316,7 +316,12 @@ def save_snapshot(
     ``shards > 1`` (the sharded engine passes its graph-axis count)
     stripes each bucket matrix into per-shard row segments along the
     serve-time shard assignment, so a mesh cold start loads shards in
-    parallel; reassembly is byte-identical to the single-file layout."""
+    parallel; reassembly is byte-identical to the single-file layout.
+
+    ``labels_wait`` is called right before the label segments are read:
+    the engine overlaps its label build with this save and passes a join
+    so an in-flight index still lands in the cache instead of being
+    silently dropped (a warm reload would otherwise rebuild it)."""
     if snap.has_overlay:
         return None
     shards = max(1, int(shards))
@@ -396,6 +401,8 @@ def save_snapshot(
         # a present index is exactly the base graph's): the segment
         # manifest below covers them like every other array, and a
         # corrupted label segment quarantines the whole cache
+        if labels_wait is not None:
+            labels_wait()  # join an overlapped label build before reading
         lab_meta = None
         idx = snap.labels
         if idx is not None:
@@ -409,6 +416,7 @@ def save_snapshot(
                 "max_width": int(idx.max_width),
                 "n_landmarks": int(idx.n_landmarks),
                 "n_entries": int(idx.n_entries),
+                "backend": str(idx.backend),
             }
         for kind, strings in (
             ("obj", _obj_strings(interned, n_obj)),
@@ -698,6 +706,7 @@ def load_snapshot(path: str, verify: bool = True, sorter=None) -> GraphSnapshot:
             max_width=int(lm["max_width"]),
             n_landmarks=int(lm["n_landmarks"]),
             n_entries=int(lm.get("n_entries", 0)),
+            backend=str(lm.get("backend", "host")),
         )
     snap = GraphSnapshot(
         snapshot_id=int(meta["watermark"]),

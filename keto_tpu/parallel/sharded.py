@@ -291,6 +291,36 @@ def halo_bytes_per_round(spec: ShardSpec, W: int) -> int:
     return (spec.n_shards - 1) * spec.rows_per_shard * W * 4
 
 
+def route_label_ell(groups, n: int, n_shards: int, rps: int):
+    """Route the label builder's pull-ELL groups (``graph/label_build.py
+    build_ell_groups`` output: global neighbor ids with gather sentinel
+    ``n``, global destination rows) by destination-row ownership — the
+    SAME row ranges that stripe the serving label arrays
+    (``route_labels``) and bucket slabs (``make_shard_spec``), so the
+    rows a sweep writes are the rows the shard will later serve. Returns
+    per group ``(int32[g, rb, cap] nbrs, int32[g, rb] local dst)`` with
+    scatter sentinel ``rps`` (dropped) and gather ids left GLOBAL: the
+    sweep gathers from the halo-exchanged full bitmap."""
+    g = max(1, int(n_shards))
+    routed = []
+    for nbrs, dst in groups:
+        dst64 = np.asarray(dst, np.int64)
+        owner = np.minimum(dst64 // rps, g - 1)
+        counts = np.bincount(owner, minlength=g)
+        rb = _ceil_pow2(int(counts.max()) if counts.size else 0) or 1
+        cap = nbrs.shape[1]
+        sb = np.full((g, rb, cap), np.int32(n), np.int32)
+        db = np.full((g, rb), np.int32(rps), np.int32)
+        for s in range(g):
+            sel = owner == s
+            k = int(np.count_nonzero(sel))
+            if k:
+                sb[s, :k] = nbrs[sel]
+                db[s, :k] = (dst64[sel] - s * rps).astype(np.int32)
+        routed.append((np.ascontiguousarray(sb), np.ascontiguousarray(db)))
+    return routed
+
+
 # -- kernels -----------------------------------------------------------------
 
 
@@ -523,4 +553,86 @@ def label_kernel(mesh):
 
     return partial(jax.jit, static_argnames=("n_pairs", "B", "rl"))(
         partial(sharded_label_step, mesh)
+    )
+
+
+def sharded_label_sweep_step(
+    mesh,
+    nbrs,  # per ELL group: int32 [g, rb, cap], global ids, sentinel n
+    dst,  # per ELL group: int32 [g, rb], local rows, sentinel rps
+    V,  # uint32 [g, rps, Wt] visited slabs
+    X,  # uint32 [g, rps, Wt] frontier slabs
+    S,  # uint32 [g, rps, Wt] stored slabs
+    cov,  # uint32 [g, rps, Wt] covered slabs (frozen per batch)
+    *,
+    rps: int,
+    prune_expansion: bool = True,
+):
+    """One wave of the batched label-construction sweep
+    (``graph/label_build.py``) as a ``shard_map`` program: the frontier
+    slabs halo-exchange over the graph axis exactly like
+    ``sharded_check_step``'s BFS hop, then each shard runs the local
+    gather-OR pull over its routed ELL rows and applies the PLL pruning
+    ANDNOT (``covered``) to its owned rows. OR is OR on any topology, so
+    the wave sequence — and therefore the stored entry set — is
+    bit-identical to the single-device sweep; the wave loop stays on
+    host because the builder meters budgets and transfers per wave."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def f(b_nbrs, b_dst, v, x, s, c):
+        b_nbrs = tuple(a[0] for a in b_nbrs)
+        b_dst = tuple(a[0] for a in b_dst)
+        v = v[0]
+        x = x[0]
+        s = s[0]
+        c = c[0]
+        xfull = lax.all_gather(x, GRAPH_AXIS, axis=0, tiled=True)
+        p = jnp.zeros_like(v)
+        for nb, d in zip(b_nbrs, b_dst):
+            cap = nb.shape[1]
+            acc = None
+            for c0 in range(0, cap, _DEGREE_CHUNK):
+                gathered = xfull[nb[:, c0 : c0 + _DEGREE_CHUNK]]
+                part = lax.reduce(gathered, np.uint32(0), lax.bitwise_or, (1,))
+                acc = part if acc is None else lax.bitwise_or(acc, part)
+            p = p.at[d].set(acc, mode="drop")
+        newly = p & ~v
+        store = newly & ~c
+        v2 = v | newly
+        x2 = store if prune_expansion else newly
+        s2 = s | store
+        active = lax.psum(jnp.any(x2 != 0).astype(jnp.int32), GRAPH_AXIS) > 0
+        visits = lax.psum(
+            jnp.sum(lax.population_count(newly), dtype=jnp.int32), GRAPH_AXIS
+        )
+        # keep the leading unit shard axis so the global outputs are
+        # [g, rps, Wt] — the same layout the next wave feeds back in
+        return v2[None], x2[None], s2[None], active, visits
+
+    return shard_map(
+        f,
+        mesh=mesh,
+        in_specs=(
+            tuple(P(GRAPH_AXIS) for _ in nbrs),
+            tuple(P(GRAPH_AXIS) for _ in dst),
+            P(GRAPH_AXIS),
+            P(GRAPH_AXIS),
+            P(GRAPH_AXIS),
+            P(GRAPH_AXIS),
+        ),
+        out_specs=(P(GRAPH_AXIS), P(GRAPH_AXIS), P(GRAPH_AXIS), P(), P()),
+        check_rep=False,
+    )(nbrs, dst, V, X, S, cov)
+
+
+@lru_cache(maxsize=8)
+def label_sweep_kernel(mesh):
+    """Jitted ``sharded_label_sweep_step`` bound to ``mesh``."""
+    import jax
+
+    return partial(jax.jit, static_argnames=("rps", "prune_expansion"))(
+        partial(sharded_label_sweep_step, mesh)
     )

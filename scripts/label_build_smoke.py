@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""label-build-smoke: the device label build's CI gate.
+
+Over a deep chained-group graph (depth 16 — the shape whose BFS depth
+tax the 2-hop labels exist to remove), this gate asserts the
+reachability-oracle-v2 contract end to end:
+
+1. **Device build, full coverage** — the engine takes the batched-sweep
+   path (``label_device_builds`` fires, index backend "device"), streams
+   every interior landmark (no coverage cap), and the label fast path
+   serves a NONZERO hit rate at depth 16.
+2. **Correctness** — zero mismatches vs the CPU reference CheckEngine
+   over a mixed grant/deny sample, and the capped-landmark engine agrees
+   decision-for-decision (caps shrink coverage, never answers).
+3. **Overlap** — the label build runs in the background while the
+   snapshot serves checks (BFS path first, label path after install),
+   and a snapshot-cache save started mid-build still carries the label
+   segments (the ``labels_wait`` seam joins the sweeps before writing).
+4. **HBM ledger reconciles** — the build's transient ``build``
+   reservation is released after construction; the resident ``labels``
+   tag matches the index's device bytes.
+5. **Sanitizer clean** — under KETO_TPU_SANITIZE=1 (the CI job sets it)
+   the whole run executes on instrumented locks with zero inversions
+   and zero watchdog trips.
+
+Knobs: LABEL_SMOKE_CHAINS (default 120), LABEL_SMOKE_DEPTH (default 16),
+LABEL_SMOKE_CHECKS (default 400). Exit 0 on success, 1 with a problem
+list on any failure.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    n_chains = int(os.environ.get("LABEL_SMOKE_CHAINS", 120))
+    depth = int(os.environ.get("LABEL_SMOKE_DEPTH", 16))
+    n_checks = int(os.environ.get("LABEL_SMOKE_CHECKS", 400))
+    rng = random.Random(16)
+    problems: list[str] = []
+
+    from keto_tpu import namespace as namespace_pkg
+    from keto_tpu.check import CheckEngine
+    from keto_tpu.check.tpu_engine import TpuCheckEngine
+    from keto_tpu.persistence.memory import MemoryPersister
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    def T(ns, obj, rel, sub):
+        return RelationTuple(namespace=ns, object=obj, relation=rel, subject=sub)
+
+    nm = namespace_pkg.MemoryManager(
+        [namespace_pkg.Namespace(id=1, name="g"), namespace_pkg.Namespace(id=2, name="d")]
+    )
+    store = MemoryPersister(nm)
+    tuples = []
+    for c in range(n_chains):
+        for lv in range(depth - 1):
+            tuples.append(
+                T("g", f"c{c}-l{lv}", "m", SubjectSet("g", f"c{c}-l{lv+1}", "m"))
+            )
+        # back-edge keeps every level active-interior (no peel)
+        tuples.append(T("g", f"c{c}-l{depth-1}", "m", SubjectSet("g", f"c{c}-l0", "m")))
+        tuples.append(T("d", f"doc-{c}", "view", SubjectSet("g", f"c{c}-l0", "m")))
+        for u in range(3):
+            tuples.append(T("g", f"c{c}-l{depth-1}", "m", SubjectID(f"u-{c}-{u}")))
+    store.write_relation_tuples(*tuples)
+    log(f"[smoke] {len(tuples)} tuples, {n_chains} chains at depth {depth}")
+
+    queries, expected = [], []
+    for i in range(n_checks):
+        c = rng.randrange(n_chains)
+        cu = c if i % 2 == 0 else rng.randrange(n_chains)
+        queries.append(T("d", f"doc-{c}", "view", SubjectID(f"u-{cu}-{rng.randrange(3)}")))
+        expected.append(cu == c)
+
+    cache_dir = tempfile.mkdtemp(prefix="label-smoke-cache-")
+    try:
+        eng = TpuCheckEngine(
+            store, store.namespaces,
+            snapshot_cache_dir=cache_dir,
+            labels_device_min_edges=0,
+            compact_after_s=3600.0,
+        )
+        t0 = time.perf_counter()
+        eng.snapshot()  # starts the overlapped label build
+        build_thread = eng._label_build_thread
+        overlapped = build_thread is not None and build_thread.is_alive()
+        got_during = eng.batch_check(queries)  # BFS path while sweeps run
+        # a cache save kicked mid-build must still carry the labels: the
+        # labels_wait seam joins the sweeps just before the segments write
+        cache_path = eng.save_snapshot_cache()
+        log(
+            f"[smoke] snapshot+overlapped build+save: "
+            f"{time.perf_counter()-t0:.1f}s (build thread alive at first "
+            f"check: {overlapped})"
+        )
+        if not overlapped:
+            problems.append(
+                "overlap: label build finished before the first check — "
+                "grow LABEL_SMOKE_CHAINS so the smoke exercises the seam"
+            )
+        if cache_path is None:
+            problems.append("cache: save_snapshot_cache returned None")
+        elif not (Path(cache_path) / "lab_out.npy").exists():
+            problems.append("cache: saved mid-build cache is missing label segments")
+
+        settled = eng.labels_settled()
+        got_after = eng.batch_check(queries)
+        snap = eng._snapshot
+        if not settled or snap.labels is None:
+            problems.append("build: no label index installed after settle")
+        else:
+            if snap.labels.backend != "device":
+                problems.append(f"build: backend {snap.labels.backend!r} != 'device'")
+            if snap.labels.n_landmarks != snap.labels.n:
+                problems.append(
+                    f"coverage cap: {snap.labels.n_landmarks}/{snap.labels.n} "
+                    "landmarks processed — the uncapped stream truncated"
+                )
+        maint = eng.maintenance.snapshot()
+        if maint.get("label_device_builds", 0) < 1:
+            problems.append("build: label_device_builds counter never fired")
+        served = maint.get("label_checks", 0)
+        fell = maint.get("label_fallbacks", 0)
+        hit_rate = served / max(1, served + fell)
+        log(f"[smoke] depth-{depth} label hit rate {hit_rate:.1%} ({served} served)")
+        if served <= 0:
+            problems.append(f"hit rate: label path never engaged at depth {depth}")
+
+        # correctness: decisions stable across the install, and oracle-equal
+        if got_during != got_after:
+            problems.append("parity: decisions changed when the label path installed")
+        if got_after != expected:
+            problems.append("parity: decisions diverged from the analytic expectation")
+        oracle = CheckEngine(store)
+        sample = queries[: min(150, n_checks)]
+        mism = sum(
+            g != oracle.subject_is_allowed(q) for g, q in zip(got_after, sample)
+        )
+        if mism:
+            problems.append(f"parity: {mism} mismatches vs the CPU oracle")
+        log(f"[smoke] oracle mismatches: {mism} over {len(sample)} sampled checks")
+
+        # HBM ledger: transient released, resident labels accounted
+        ledger = eng.hbm.ledger()
+        if ledger.get("build", 0) != 0:
+            problems.append(
+                f"hbm: build transient still resident ({ledger['build']} bytes)"
+            )
+        if snap.labels is not None:
+            want = snap.labels.device_bytes()
+            if ledger.get("labels", 0) != want:
+                problems.append(
+                    f"hbm: labels ledger {ledger.get('labels', 0)} != "
+                    f"index device bytes {want}"
+                )
+        log(f"[smoke] hbm ledger: {ledger}")
+
+        eng.close()
+
+        from keto_tpu.x import lockwatch
+
+        if lockwatch.installed():
+            problems.extend(lockwatch.violations())
+            rep = lockwatch.report()
+            log(
+                f"[smoke] lockwatch: {rep['acquires']} acquires, "
+                f"{len(rep['inversions'])} inversions, "
+                f"{len(rep['watchdog_trips'])} watchdog trips"
+            )
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    if problems:
+        log("label-build-smoke FAILED:")
+        for p in problems:
+            log(f"  - {p}")
+        return 1
+    log("label-build-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

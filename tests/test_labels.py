@@ -81,6 +81,7 @@ def assert_three_way(p, queries, *, expect_label_use=True, **engine_kw):
     on = quiet_engine(p, **engine_kw)
     off = quiet_engine(p, labels_enabled=False)
     oracle = CheckEngine(p)
+    on.labels_settled()  # join the overlapped build: parity must be non-vacuous
     got_on = on.batch_check(queries)
     got_off = off.batch_check(queries)
     want = [oracle.subject_is_allowed(q) for q in queries]
@@ -267,6 +268,7 @@ def test_stream_parity_and_hits():
     ]
     on = quiet_engine(p)
     off = quiet_engine(p, labels_enabled=False)
+    on.labels_settled()
     got_on = np.concatenate(list(on.batch_check_stream(iter(qs))))
     got_off = np.concatenate(list(off.batch_check_stream(iter(qs))))
     np.testing.assert_array_equal(got_on, got_off)
@@ -363,7 +365,7 @@ def test_sink_burst_keeps_labels_live():
     is untouched."""
     p = deep_store(depth=6)
     on = quiet_engine(p)
-    on.snapshot()
+    on.labels_settled()
     p.write_relation_tuples(
         *[T("g", "c5", "m", SubjectID(f"burst-{i}")) for i in range(10)]
     )

@@ -125,6 +125,8 @@ def test_sharded_fuzz_overlay_tombstone_compaction(make_persister):
         p, p.namespaces, mesh=mesh, sharded=True,
         overlay_edge_budget=8, compact_after_s=3600,
     )
+    sharded.labels_settled()  # join the overlapped build: parity below
+    # must exercise the label fast path, not only the BFS fallback
     _assert_parity("base", p, _queries(rng, objs, users), sharded, single)
     c0 = sharded.maintenance.raw()[0]
     assert c0.get("label_checks", 0) > 0, "label fast path never exercised"
