@@ -29,6 +29,12 @@ class CheckEngine:
         # sizes to assert pagination behavior.
         self._page_size = page_size
 
+    def set_store(self, manager: Manager) -> None:
+        """Fleet promotion handoff: swap the backing store (same tuple
+        history at or past the old watermark; the recursive engine reads
+        live state, so nothing else needs invalidating)."""
+        self._manager = manager
+
     def subject_is_allowed(self, requested: RelationTuple) -> bool:
         """Can ``requested.subject`` be reached from
         ``requested.object#requested.relation``? Reference engine.go:93-95."""

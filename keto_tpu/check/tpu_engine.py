@@ -1894,6 +1894,18 @@ class TpuCheckEngine:
                 self._fallback_engine_obj = CheckEngine(self._store)
             return self._fallback_engine_obj
 
+    def set_store(self, store) -> None:
+        """Fleet promotion handoff: swap the backing store WITHOUT
+        rebuilding the device snapshot. Valid precisely because the
+        durable-watermark handoff guarantees the new store's watermark
+        >= the snapshot's id over the same tuple history — the resident
+        snapshot stays a correct prefix, and the very next refresh pass
+        catches up through the ordinary delta path. Also resets the CPU
+        fallback engine (it holds a store reference of its own)."""
+        self._store = store
+        with self._fallback_lock:
+            self._fallback_engine_obj = None
+
     def _fallback_check(self, tuples) -> tuple[list[bool], Optional[int]]:
         """Answer on the CPU reference engine (keto_tpu/check/engine.py)
         — the differential-testing oracle the device path is fuzz-tested

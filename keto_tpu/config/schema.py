@@ -281,6 +281,61 @@ CONFIG_SCHEMA = {
                     "default": 65536,
                     "description": "Replica mode: capacity of the Watch-invalidated check cache (positive AND negative decisions, keyed by tuple + snaptoken window, LRU). Any applied delta closes every open window — globally, because reachability is transitive across namespaces — so the cache can never serve a hit an applied delta invalidated; snaptoken-pinned reads below a closed window still hit. 0 disables.",
                 },
+                "fleet_enabled": {
+                    "type": "boolean",
+                    "default": False,
+                    "description": "Fleet control plane (keto_tpu/fleet/): run the lease-election / membership / promotion loop. A primary acquires and renews a fenced lease row (keto_fleet_lease) through the SQL store and stamps its writes with the lease epoch; replicas heartbeat membership, watch the lease, and on primary death the most-caught-up replica promotes itself — installing a direct SQL store at its applied watermark, fencing it at the won epoch, and flipping the write path — while the deposed primary's in-flight writes answer 409 ErrFencedEpoch. false (default) keeps the static primary/replica topology.",
+                },
+                "fleet_node_id": {
+                    "type": "string",
+                    "default": "",
+                    "description": "Stable identity of this node in the fleet membership table (lease holder, heartbeat row, routing-weight label). Empty derives hostname-pid — fine for ephemeral replicas, set it explicitly when the durable applied-watermark (serve.replica_dir) should survive restarts under the same identity.",
+                },
+                "fleet_advertise_url": {
+                    "type": "string",
+                    "default": "",
+                    "description": "Base URL of this node's READ API as other fleet members and SDK clients should reach it (http://host:4466). Published in the membership table; the SDK's lag-aware routing and post-failover primary re-resolution both read it. Empty publishes no URL (the node still participates in election).",
+                },
+                "fleet_lease_ttl_s": {
+                    "type": "number",
+                    "default": 2.0,
+                    "description": "Fleet lease time-to-live. The primary renews every serve.fleet_heartbeat_s; a lease unrenewed past this is up for grabs, so primary-death failover completes in roughly ttl + promotion grace + install time (the <5s budget the chaos smoke asserts). Lower is faster failover but less tolerance for store hiccups; must comfortably exceed the heartbeat period.",
+                },
+                "fleet_heartbeat_s": {
+                    "type": "number",
+                    "default": 0.5,
+                    "description": "Fleet control-loop period: lease renewal on the primary, membership heartbeat + lease watch on replicas. Membership rows older than ~3 heartbeats age out of fleet_size and the routing-weight table.",
+                },
+                "fleet_promotion_grace_s": {
+                    "type": "number",
+                    "default": 0.5,
+                    "description": "Rank-staggered election backoff: after observing the lease expire, the replica ranked k by (-applied watermark, node_id) waits k times this before contending, so the most-caught-up replica wins the CAS uncontested in the common case. The stagger bounds added failover latency for lower ranks; the guarded-update CAS stays correct (exactly one winner per epoch) even when ranks race.",
+                },
+                "fleet_autoscale_enabled": {
+                    "type": "boolean",
+                    "default": False,
+                    "description": "SLO-burn autoscale loop (keto_tpu/fleet/autoscale.py): watch the worst-window availability/latency burn rates, batcher queue-depth ratio, and HBM eviction rung, and grow/shrink the replica fleet between serve.fleet_min_replicas and serve.fleet_max_replicas with asymmetric hysteresis (grow after sustained overload, shrink only after a much longer calm, cooldown between actions, HBM pressure vetoes shrink). Advisory — snapshot/metrics only — unless the daemon is given a replica spawn template.",
+                },
+                "fleet_min_replicas": {
+                    "type": "integer",
+                    "default": 0,
+                    "description": "Autoscaler floor: never retire below this many replicas.",
+                },
+                "fleet_max_replicas": {
+                    "type": "integer",
+                    "default": 4,
+                    "description": "Autoscaler ceiling: never spawn above this many replicas (bound it by the snapshot-export fan-out the primary can serve and the devices available).",
+                },
+                "fleet_scale_sustain_s": {
+                    "type": "number",
+                    "default": 5.0,
+                    "description": "Autoscaler hysteresis: overload (any burn rate > 1, or queue depth >= 80% of capacity) must hold continuously this long before a grow action; calm must hold 4x this long before a shrink. Readings between the two thresholds (the dead band) reset both timers — a 10x diurnal swell scales up once and back down once instead of flapping.",
+                },
+                "fleet_scale_cooldown_s": {
+                    "type": "number",
+                    "default": 30.0,
+                    "description": "Autoscaler cooldown: minimum seconds between scale actions in either direction, so a freshly spawned replica's bootstrap window cannot itself trigger the next action.",
+                },
                 "watch_log_retention_s": {
                     "type": "number",
                     "default": 3600.0,

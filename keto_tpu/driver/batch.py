@@ -150,6 +150,13 @@ class CheckBatcher:
         self._idle = threading.Event()
         self._idle.set()
 
+    def set_engine(self, engine) -> None:
+        """Live-reshard handoff: point subsequent dispatch rounds at a
+        new engine. The collector reads ``self._engine`` per dispatch,
+        so in-flight rounds finish on the old engine (which keeps a
+        valid snapshot until released) and the swap needs no quiesce."""
+        self._engine = engine
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
@@ -421,6 +428,12 @@ class CheckBatcher:
         """Queued tuples per lane (the /metrics per-lane gauge)."""
         with self._cond:
             return dict(self._lane_tuples)
+
+    @property
+    def max_pending(self) -> int:
+        """Per-lane queue capacity — the denominator of the autoscaler's
+        queue_depth_ratio signal (keto_tpu/fleet/autoscale.py)."""
+        return self._max_pending
 
     def drain(self, timeout_s: float) -> bool:
         """Wait until every in-flight request has been answered (the

@@ -107,6 +107,15 @@ class Daemon:
             rep.start()
         else:
             self._warm_snapshot()
+        # fleet control plane LAST: by the time this node renews/contends
+        # for the lease (and could be asked to promote), the engine,
+        # health machine, and replica feed it hands off around all exist
+        fleet = self.registry.fleet_controller()
+        if fleet is not None:
+            fleet.start()
+        scaler = self.registry.autoscaler()
+        if scaler is not None:
+            scaler.start()
         read_host, read_port = cfg.read_api_address()
         write_host, write_port = cfg.write_api_address()
         self._roles[READ] = self._start_role(READ, read_host, read_port)
@@ -183,7 +192,21 @@ class Daemon:
                 exc_info=True,
             )
         deadline = time.monotonic() + drain_s
-        # replica feed first: stop applying new commit groups before the
+        # fleet loops first: a draining node must stop renewing the lease
+        # (so a successor can take it promptly), stop heartbeating
+        # membership, and must not promote or spawn mid-teardown
+        for key in ("autoscaler", "fleet"):
+            loop = self.registry.peek(key)
+            if loop is not None:
+                try:
+                    loop.stop()
+                except Exception:
+                    self._count_shutdown_failure(f"drain_{key}_stop_failures")
+                    self.registry.logger().warning(
+                        "%s stop failed during drain; continuing shutdown",
+                        key, exc_info=True,
+                    )
+        # replica feed next: stop applying new commit groups before the
         # read plane drains, so in-flight reads resolve against a stable
         # watermark (the durable applied-watermark already covers every
         # applied group — a later restart resumes exactly-once)

@@ -100,6 +100,19 @@ class HealthMonitor:
         # invoked OUTSIDE the monitor lock, exceptions contained
         self._listeners: list[Callable[[HealthState, str], None]] = []
 
+    def set_engine(self, engine) -> None:
+        """Live-reshard handoff: derive state from the newly installed
+        engine (keto_tpu/fleet/reshard.py) — the retiring engine's
+        health inputs stop mattering the moment it stops serving."""
+        self._engine = engine
+
+    def set_replica(self, replica) -> None:
+        """Fleet promotion handoff: detach (None) or attach the replica
+        controller this monitor derives replication state from — a
+        promoted node stops reading STARTING/DEGRADED(replication_lag)
+        off a feed it no longer runs."""
+        self._replica = replica
+
     @property
     def staleness_budget_s(self) -> float:
         return self._budget

@@ -27,8 +27,14 @@ class Registry:
     def __init__(self, config: Config, network_id: str = "default"):
         self._config = config
         self._network_id = network_id
-        self._lock = threading.RLock()  # guards: _singletons
+        self._lock = threading.RLock()  # guards: _singletons, _promoted
         self._singletons: dict[str, Any] = {}
+        # fleet promotion flag: a process booted as serve.role=replica
+        # that won the lease election serves as a primary from then on —
+        # is_replica() consults this at call time, so the write path,
+        # group-commit construction and REST refusals all flip without
+        # a rebuild (keto_tpu/fleet/controller.py)
+        self._promoted = False
         # engines see namespace hot-reloads through this indirection
         config.on_namespace_change(self._on_namespace_change)
 
@@ -76,8 +82,52 @@ class Registry:
     def is_replica(self) -> bool:
         """True when this process serves as a read replica
         (``serve.role: replica``): no SQL access, state fed by the
-        primary's Watch changefeed (keto_tpu/replica/)."""
+        primary's Watch changefeed (keto_tpu/replica/). A replica the
+        fleet controller promoted reads False from then on — every
+        write-path branch consults this at call time."""
+        if self._promoted:
+            return False
         return str(self._config.get("serve.role", "primary")) == "replica"
+
+    def _build_direct_store(self):
+        """A tuple store with direct SQL (or in-process memory) access,
+        built from the configured dsn — the primary's store, and the
+        store a promoted replica installs over the durable-watermark
+        handoff (promote_to_primary)."""
+        dsn = self._config.dsn
+        if dsn == "memory":
+            store = MemoryPersister(
+                self.namespaces_source(), network_id=self._network_id
+            )
+        elif dsn.startswith("sqlite://"):
+            from keto_tpu.persistence.sqlite import SQLitePersister
+
+            store = SQLitePersister(
+                dsn, self.namespaces_source(), network_id=self._network_id
+            )
+        elif dsn.startswith(("postgres://", "postgresql://", "cockroach://")):
+            from keto_tpu.persistence.postgres import PostgresPersister
+
+            store = PostgresPersister(
+                dsn, self.namespaces_source(), network_id=self._network_id
+            )
+        else:
+            raise ValueError(f"unsupported dsn {dsn!r}")
+        # idempotency keys dedup write retries for this long before GC
+        store.idempotency_ttl_s = float(
+            self._config.get("serve.idempotency_ttl_s", 86400.0)
+        )
+        # time-based GC of the durable change logs feeding /watch and
+        # the delta path (serve.watch_log_retention_s; 0 disables)
+        store.watch_log_retention_s = float(
+            self._config.get("serve.watch_log_retention_s", 3600.0)
+        )
+        # one piggybacked watch-GC pass prunes at most this many rows
+        # (a group commit must never stall behind an unbounded sweep)
+        store.watch_gc_max_rows = int(
+            self._config.get("serve.watch_gc_max_rows", 10000)
+        )
+        return store
 
     def relation_tuple_manager(self):
         def build():
@@ -102,40 +152,7 @@ class Registry:
                     self._config.get("serve.watch_gc_max_rows", 10000)
                 )
                 return store
-            dsn = self._config.dsn
-            if dsn == "memory":
-                store = MemoryPersister(
-                    self.namespaces_source(), network_id=self._network_id
-                )
-            elif dsn.startswith("sqlite://"):
-                from keto_tpu.persistence.sqlite import SQLitePersister
-
-                store = SQLitePersister(
-                    dsn, self.namespaces_source(), network_id=self._network_id
-                )
-            elif dsn.startswith(("postgres://", "postgresql://", "cockroach://")):
-                from keto_tpu.persistence.postgres import PostgresPersister
-
-                store = PostgresPersister(
-                    dsn, self.namespaces_source(), network_id=self._network_id
-                )
-            else:
-                raise ValueError(f"unsupported dsn {dsn!r}")
-            # idempotency keys dedup write retries for this long before GC
-            store.idempotency_ttl_s = float(
-                self._config.get("serve.idempotency_ttl_s", 86400.0)
-            )
-            # time-based GC of the durable change logs feeding /watch and
-            # the delta path (serve.watch_log_retention_s; 0 disables)
-            store.watch_log_retention_s = float(
-                self._config.get("serve.watch_log_retention_s", 3600.0)
-            )
-            # one piggybacked watch-GC pass prunes at most this many rows
-            # (a group commit must never stall behind an unbounded sweep)
-            store.watch_gc_max_rows = int(
-                self._config.get("serve.watch_gc_max_rows", 10000)
-            )
-            return store
+            return self._build_direct_store()
 
         return self._memo("manager", build)
 
@@ -263,11 +280,348 @@ class Registry:
 
         return self._memo("replica", build)
 
+    # -- fleet control plane (keto_tpu/fleet/) -------------------------------
+
+    def fleet_enabled(self) -> bool:
+        return bool(self._config.get("serve.fleet_enabled", False))
+
+    def _fleet_lease_store(self):
+        """The store the lease election runs through. Replicas hold no
+        tuple-store SQL access by design, so the lease channel is a
+        DEDICATED persister built from the dsn — the one SQL surface a
+        replica touches pre-promotion. Primaries with a memory dsn share
+        the tuple store itself (same in-process state)."""
+
+        def build():
+            if self._config.dsn == "memory" and not self.is_replica():
+                return self.relation_tuple_manager()
+            return self._build_direct_store()
+
+        return self._memo("fleet_lease_store", build)
+
+    def fleet_controller(self):
+        """The lease-election / membership / promotion loop
+        (keto_tpu/fleet/controller.py), or None without
+        ``serve.fleet_enabled``. Started by the daemon after the serving
+        components exist."""
+        if not self.fleet_enabled():
+            return None
+
+        def build():
+            import os
+            import socket
+
+            from keto_tpu.fleet.controller import FleetController
+
+            node_id = str(self._config.get("serve.fleet_node_id", "") or "")
+            if not node_id:
+                node_id = f"{socket.gethostname()}-{os.getpid()}"
+            role = "replica" if self.is_replica() else "primary"
+
+            def watermark_fn():
+                rep = self.peek("replica")
+                if rep is not None and self.is_replica():
+                    return int(rep.watermark)
+                store = self.peek("manager")
+                try:
+                    return int(store.watermark()) if store is not None else 0
+                except Exception:
+                    return 0
+
+            def lag_fn():
+                rep = self.peek("replica")
+                if rep is not None and self.is_replica():
+                    try:
+                        return float(rep.lag_s())
+                    except Exception:
+                        return 0.0
+                return 0.0
+
+            def fence_fn(epoch):
+                # primaries fence their own store on (re)acquire; a
+                # promoted replica's new store was already fenced inside
+                # promote_to_primary before this runs
+                store = self.peek("manager")
+                if store is not None and hasattr(store, "fence_epoch"):
+                    store.fence_epoch = int(epoch)
+
+            return FleetController(
+                self._fleet_lease_store(),
+                node_id,
+                advertise_url=str(
+                    self._config.get("serve.fleet_advertise_url", "") or ""
+                ),
+                role=role,
+                lease_ttl_s=float(
+                    self._config.get("serve.fleet_lease_ttl_s", 2.0)
+                ),
+                heartbeat_s=float(
+                    self._config.get("serve.fleet_heartbeat_s", 0.5)
+                ),
+                promotion_grace_s=float(
+                    self._config.get("serve.fleet_promotion_grace_s", 0.5)
+                ),
+                lag_budget_s=float(
+                    self._config.get("serve.replica_staleness_budget_s", 30.0)
+                ),
+                watermark_fn=watermark_fn,
+                lag_fn=lag_fn,
+                on_promote=self.promote_to_primary,
+                fence_fn=fence_fn,
+                stats=getattr(self.peek("permission_engine"), "maintenance", None),
+            )
+
+        return self._memo("fleet", build)
+
+    def promote_to_primary(self, epoch: int) -> None:
+        """The durable-watermark handoff: called by the fleet controller
+        when this replica wins the lease at ``epoch``. The replica's
+        applied watermark IS a store watermark over the same tuple
+        history, so the device snapshot stays valid — only the backing
+        store swaps:
+
+        1. build a direct SQL store from the dsn, fenced at the won
+           epoch BEFORE any write can route through it,
+        2. install it as the ``manager`` singleton and into the engine
+           (``set_store`` — no snapshot rebuild; the next maintenance
+           pass catches up via the delta path),
+        3. retire the replication feed (the primary it followed is
+           dead) and detach it from health derivation,
+        4. flip ``_promoted`` so is_replica() — and with it the write
+           coordinator, REST/gRPC write refusals, and the 412 gate
+           branch — reads primary from then on.
+
+        Idempotent: the controller's install-retry path (crash between
+        winning and installing) re-runs this at the same epoch."""
+        with self._lock:
+            if self._promoted:
+                store = self._singletons.get("manager")
+                if store is not None and hasattr(store, "fence_epoch"):
+                    store.fence_epoch = int(epoch)
+                return
+            new_store = self._build_direct_store()
+            new_store.fence_epoch = int(epoch)
+            old_store = self._singletons.get("manager")
+            self._singletons["manager"] = new_store
+            self._promoted = True
+        self.logger().warning(
+            "fleet promotion: serving as primary at epoch %d "
+            "(store handoff at watermark %s)",
+            int(epoch), new_store.watermark(),
+        )
+        engine = self.peek("permission_engine")
+        if engine is not None and hasattr(engine, "set_store"):
+            engine.set_store(new_store)
+        # the replication feed followed a primary that no longer owns
+        # the lease: stop it without blocking the promotion path (its
+        # threads are daemons; a hung HTTP read dies with them)
+        rep = None
+        with self._lock:
+            rep = self._singletons.pop("replica", None)
+        if rep is not None:
+            try:
+                rep.stop(timeout=0.5)
+            except Exception:
+                self.logger().warning(
+                    "replica controller stop failed during promotion",
+                    exc_info=True,
+                )
+        monitor = self.peek("health_monitor")
+        if monitor is not None:
+            monitor.set_replica(None)
+        # the watch hub polled the old replica store, which stops
+        # advancing now: close it so chained watchers reconnect and the
+        # next subscriber gets a hub over the new store
+        hub = None
+        with self._lock:
+            hub = self._singletons.pop("watch_hub", None)
+        if hub is not None:
+            try:
+                hub.close()
+            except Exception:
+                self.logger().warning(
+                    "watch hub close failed during promotion", exc_info=True
+                )
+        if old_store is not None and old_store is not new_store:
+            closer = getattr(old_store, "close", None)
+            if closer is not None:
+                try:
+                    closer()
+                except Exception:
+                    self.logger().warning(
+                        "old replica store close failed during promotion",
+                        exc_info=True,
+                    )
+
+    def reshard_coordinator(self):
+        """The live shard split/merge coordinator
+        (keto_tpu/fleet/reshard.py): builds a complete engine at the
+        target graph-mesh width while the current engine keeps serving,
+        then installs it atomically under the registry lock."""
+
+        def build():
+            from keto_tpu.fleet.reshard import ReshardCoordinator
+
+            def current():
+                # shard_count is a property on the TPU engine (0 = not
+                # sharded) and absent on the oracle fallback
+                eng = self.peek("permission_engine")
+                val = getattr(eng, "shard_count", None)
+                if callable(val):
+                    val = val()
+                try:
+                    return max(1, int(val)) if val is not None else 1
+                except (TypeError, ValueError):
+                    return 1
+
+            def build_new(target):
+                eng = self._build_permission_engine(
+                    mesh_graph_override=(None if target <= 1 else target)
+                )
+                # warm the snapshot BEFORE install so the handoff swaps
+                # one serving engine for another, not for a cold build
+                if hasattr(eng, "snapshot"):
+                    eng.snapshot()
+                return eng
+
+            return ReshardCoordinator(
+                build_new, self._install_resharded_engine, current_fn=current
+            )
+
+        return self._memo("reshard", build)
+
+    def _install_resharded_engine(self, new_engine, target: int) -> None:
+        """Swap the serving engine for the resharded one. In-flight
+        rounds finish on the old engine (the batcher reads its engine
+        attribute per dispatch); the old engine closes only after the
+        batcher drains, off this thread."""
+        with self._lock:
+            old = self._singletons.get("permission_engine")
+            self._singletons["permission_engine"] = new_engine
+            # lazily rebuilt over the new engine on next use
+            self._singletons.pop("expand_engine", None)
+            self._singletons.pop("list_engine", None)
+        batcher = self.peek("check_batcher")
+        if batcher is not None and hasattr(batcher, "set_engine"):
+            batcher.set_engine(new_engine)
+        monitor = self.peek("health_monitor")
+        if monitor is not None and hasattr(monitor, "set_engine"):
+            monitor.set_engine(new_engine)
+        if old is not None and old is not new_engine and hasattr(old, "close"):
+            def close_old():
+                try:
+                    if batcher is not None and hasattr(batcher, "drain"):
+                        batcher.drain(30.0)
+                    old.close()
+                except Exception:
+                    self.logger().warning(
+                        "old engine close failed after reshard", exc_info=True
+                    )
+
+            threading.Thread(
+                target=close_old, name="reshard-engine-close", daemon=True
+            ).start()
+
+    def autoscaler(self):
+        """The SLO-burn autoscale loop (keto_tpu/fleet/autoscale.py), or
+        None without ``serve.fleet_autoscale_enabled``. Advisory unless
+        a spawner is attached (the daemon wires one when launched with a
+        replica argv template; tests attach their own)."""
+        if not bool(self._config.get("serve.fleet_autoscale_enabled", False)):
+            return None
+
+        def build():
+            from keto_tpu.fleet.autoscale import Autoscaler
+
+            def signals():
+                # one broken component must not blind the others: each
+                # signal reads under its own guard, logging the failure
+                # (a stuck-at-default signal biases decisions, silently)
+                out = {
+                    "availability_burn_rate": 0.0,
+                    "latency_burn_rate": 0.0,
+                    "queue_depth_ratio": 0.0,
+                    "hbm_rung": 0,
+                    "replica_lag_s": 0.0,
+                }
+                slo = self.peek("slo")
+                if slo is not None:
+                    try:
+                        rep = slo.to_json()
+                        burns = [
+                            float(w.get("availability_burn_rate", 0) or 0)
+                            for w in rep.get("windows", [])
+                        ]
+                        lat = [
+                            float(w.get("latency_burn_rate", 0) or 0)
+                            for w in rep.get("windows", [])
+                        ]
+                        if burns:
+                            out["availability_burn_rate"] = max(burns)
+                        if lat:
+                            out["latency_burn_rate"] = max(lat)
+                    except Exception:
+                        self.logger().warning(
+                            "autoscale burn-rate signal read failed",
+                            exc_info=True,
+                        )
+                b = self.peek("check_batcher")
+                if b is not None:
+                    depth = float(getattr(b, "queue_depth", 0) or 0)
+                    cap = float(getattr(b, "max_pending", 0) or 0)
+                    if cap > 0:
+                        out["queue_depth_ratio"] = depth / cap
+                gov = getattr(self.peek("permission_engine"), "hbm", None)
+                if gov is not None:
+                    try:
+                        out["hbm_rung"] = int(gov.snapshot().get("rung", 0) or 0)
+                    except Exception:
+                        self.logger().warning(
+                            "autoscale hbm-rung signal read failed",
+                            exc_info=True,
+                        )
+                rep = self.peek("replica")
+                if rep is not None:
+                    try:
+                        out["replica_lag_s"] = float(rep.lag_s())
+                    except Exception:
+                        self.logger().warning(
+                            "autoscale replica-lag signal read failed",
+                            exc_info=True,
+                        )
+                return out
+
+            return Autoscaler(
+                signals,
+                min_replicas=int(
+                    self._config.get("serve.fleet_min_replicas", 0)
+                ),
+                max_replicas=int(
+                    self._config.get("serve.fleet_max_replicas", 4)
+                ),
+                sustain_s=float(
+                    self._config.get("serve.fleet_scale_sustain_s", 5.0)
+                ),
+                cooldown_s=float(
+                    self._config.get("serve.fleet_scale_cooldown_s", 30.0)
+                ),
+            )
+
+        return self._memo("autoscaler", build)
+
     # -- engines -------------------------------------------------------------
 
     def permission_engine(self):
         """The check engine: TPU snapshot engine when the store supports it
         and config allows, else the recursive oracle."""
+        return self._memo("permission_engine", self._build_permission_engine)
+
+    def _build_permission_engine(self, mesh_graph_override: Optional[int] = None):
+        """Construct a check engine from config. ``mesh_graph_override``
+        replaces ``serve.mesh_graph`` — the live-reshard seam
+        (keto_tpu/fleet/reshard.py): the coordinator builds a complete
+        engine at the target shard count while the current one keeps
+        serving, then installs it via _install_resharded_engine."""
 
         def build():
             backend = self._config.get("engine.backend", "auto")
@@ -301,6 +655,8 @@ class Registry:
                 mesh = None
                 mesh_sharded = False
                 mesh_graph = int(self._config.get("serve.mesh_graph", 1))
+                if mesh_graph_override is not None:
+                    mesh_graph = int(mesh_graph_override)
                 mesh_data = int(self._config.get("serve.mesh_data", 0))
                 if mesh_graph > 1 or mesh_data > 1:
                     from keto_tpu.parallel import make_mesh
@@ -407,7 +763,7 @@ class Registry:
                 return engine
             return CheckEngine(store)
 
-        return self._memo("permission_engine", build)
+        return build()
 
     def expand_depth(self, requested: int) -> int:
         """Clamp a request's max-depth to the configured global cap
@@ -1628,6 +1984,82 @@ class Registry:
             fold_duration,
         )
 
+        # fleet control plane (keto_tpu/fleet/): lease epoch, promotion
+        # and membership state, live-reshard state machine, and the
+        # lag-aware routing weights — peek-only like every other bridge
+        def fleet_snapshot():
+            f = self.peek("fleet")
+            return f.snapshot() if f is not None else {}
+
+        def fleet_epoch():
+            yield (), float(fleet_snapshot().get("epoch", 0) or 0)
+
+        m.register_callback(
+            "keto_fleet_epoch", "gauge",
+            "The fence epoch this node last observed on the fleet lease "
+            "(monotone across promotions; a primary's writes carry it, "
+            "a deposed primary's writes 409 against a newer one).",
+            fleet_epoch,
+        )
+
+        def fleet_promotions():
+            by = fleet_snapshot().get("promotions_by_reason", {})
+            return [
+                ((r,), float(v)) for r, v in sorted(by.items())
+            ] or [(("none",), 0.0)]
+
+        m.register_callback(
+            "keto_fleet_promotions_total", "counter",
+            "Times this node installed itself as primary, by reason "
+            "(lease-expired: won the election after primary death; "
+            "install-retry: re-ran a promotion that crashed between "
+            "winning the lease and finishing the install).",
+            fleet_promotions, ("reason",),
+        )
+
+        def fleet_replicas():
+            states: dict[str, int] = {}
+            for mem in fleet_snapshot().get("members", []):
+                role = str(mem.get("role", "unknown") or "unknown")
+                states[role] = states.get(role, 0) + 1
+            return [
+                ((s,), float(v)) for s, v in sorted(states.items())
+            ] or [(("none",), 0.0)]
+
+        m.register_callback(
+            "keto_fleet_replicas", "gauge",
+            "Live fleet members by advertised role (primary / replica / "
+            "deposed), from the heartbeat membership table — stale "
+            "members age out of the count.",
+            fleet_replicas, ("state",),
+        )
+
+        def reshard_state():
+            r = self.peek("reshard")
+            yield (), float(r.state_code() if r is not None else 0)
+
+        m.register_callback(
+            "keto_reshard_state", "gauge",
+            "Live-reshard state machine: 0 idle, 1 preparing (target "
+            "engine building while the current one serves), 2 handoff "
+            "(atomic install), 3 failed (old geometry kept serving).",
+            reshard_state,
+        )
+
+        def fleet_route_weights():
+            w = fleet_snapshot().get("route_weights", {})
+            return [
+                ((str(nid),), float(v)) for nid, v in sorted(w.items())
+            ] or [(("none",), 0.0)]
+
+        m.register_callback(
+            "keto_route_weight", "gauge",
+            "Lag-aware routing weight per fleet replica (0 = drained: "
+            "lag at/over the staleness budget; otherwise lag headroom "
+            "over the latency EWMA) — what SDK read routing steers by.",
+            fleet_route_weights, ("replica",),
+        )
+
     def tracer(self):
         from keto_tpu.x.tracing import DEFAULT_OTLP_ENDPOINT, Tracer
 
@@ -1656,6 +2088,15 @@ class Registry:
         return VERSION
 
     def close(self) -> None:
+        # the fleet loops go first: they must not renew (or contend for)
+        # the lease, heartbeat membership, spawn replicas, or trigger a
+        # promotion while the components under them tear down
+        scaler = self._singletons.get("autoscaler")
+        if scaler is not None:
+            scaler.stop()
+        fleet = self._singletons.get("fleet")
+        if fleet is not None:
+            fleet.stop()
         rep = self._singletons.get("replica")
         if rep is not None:
             rep.stop()
@@ -1680,4 +2121,11 @@ class Registry:
         store = self._singletons.get("manager")
         if store is not None and hasattr(store, "close"):
             store.close()
+        lease_store = self._singletons.get("fleet_lease_store")
+        if (
+            lease_store is not None
+            and lease_store is not store
+            and hasattr(lease_store, "close")
+        ):
+            lease_store.close()
         self._config.close()

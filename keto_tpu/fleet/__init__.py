@@ -1,0 +1,51 @@
+"""Self-driving serving fleet: failover, routing, autoscale, reshard.
+
+The replica tier (keto_tpu/replica/) and the SLO engine (keto_tpu/x/slo.py)
+observe the fleet; this package ACTS on what they observe:
+
+- ``controller`` — lease-based primary election through the SQL store's
+  fenced ``keto_fleet_lease`` epoch row: the primary renews, replicas
+  watch, and on primary death the most-caught-up replica promotes itself
+  with a durable-watermark handoff (no acked write lost, no split brain —
+  a deposed primary's in-flight transacts abort with ErrFencedEpoch).
+- ``lease`` — the pure election/routing math: promotion rank and the
+  lag + latency route weights the SDK and the ``/fleet`` endpoint share.
+- ``autoscale`` — the SLO-burn control loop: burn rate, replica lag,
+  queue depth and HBM pressure in; grow/shrink decisions with hysteresis
+  out, executed by the spawner or surfaced advisory-only.
+- ``spawner`` — replica subprocess lifecycle (spawn, port discovery,
+  retire), the productionized form of tests/chaos_runner.py's plumbing.
+- ``reshard`` — live shard split/merge on the graph mesh axis: build the
+  new-geometry engine while the old serves, then an atomic install; the
+  412 read gate pins correctness across the swap.
+"""
+
+# Lazy re-exports (PEP 562): the SDK imports keto_tpu.fleet.lease at
+# module load, and an eager package __init__ would drag the whole
+# control plane (controller → supervise, spawner → subprocess) into
+# every client process — and can deadlock when two threads import
+# different submodules concurrently. Submodules import each other
+# directly; the package root only resolves names on demand.
+_EXPORTS = {
+    "Autoscaler": ("keto_tpu.fleet.autoscale", "Autoscaler"),
+    "FleetController": ("keto_tpu.fleet.controller", "FleetController"),
+    "ReplicaSpawner": ("keto_tpu.fleet.spawner", "ReplicaSpawner"),
+    "ReshardCoordinator": ("keto_tpu.fleet.reshard", "ReshardCoordinator"),
+    "SpawnedReplica": ("keto_tpu.fleet.spawner", "SpawnedReplica"),
+    "promotion_rank": ("keto_tpu.fleet.lease", "promotion_rank"),
+    "route_weight": ("keto_tpu.fleet.lease", "route_weight"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module 'keto_tpu.fleet' has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(module), attr)

@@ -1,0 +1,226 @@
+"""SLO-burn autoscaler: the control loop that closes PR 14's observations.
+
+Signals in (a dict the registry assembles from live components — no
+component learns about the autoscaler):
+
+- ``availability_burn_rate`` / ``latency_burn_rate`` — the SLO engine's
+  fast-window burn rates; sustained > 1 means the error budget is being
+  spent faster than the objective allows.
+- ``lag_s``        — replica replication lag (a saturated primary starves
+  its own changefeed before it starts failing requests).
+- ``queue_depth``  — the check batcher's unpacked backlog, normalized by
+  its shed ceiling (sustained near 1.0 = sheds are imminent).
+- ``hbm_rung``     — the HBM governor's eviction-ladder depth (capacity
+  pressure of a different kind: more replicas spread read load, they do
+  not shrink a snapshot — rung pressure only VETOES shrinking).
+
+Decisions out, with hysteresis in both directions:
+
+- **grow** when overload (any burn > 1, or queue saturation) has held
+  CONTINUOUSLY for ``sustain_s`` — a one-scrape spike never spawns.
+- **shrink** when everything has been calm for ``quiet_s`` AND the last
+  action is at least ``cooldown_s`` old — a 10× diurnal swell ramps up
+  without oscillating on the way down.
+- after ANY action, ``cooldown_s`` must pass before the next — the loop
+  never outruns a replica's bootstrap.
+
+The pure decision core (``decide``) takes an explicit clock so the
+hysteresis regression tests replay synthetic timelines without
+sleeping. Wired to a ``ReplicaSpawner`` it acts; with ``spawner=None``
+it runs advisory-only (decisions surface on /fleet and the
+``keto_fleet_replicas`` metric, nothing spawns — the safe default for
+a daemon whose operator did not hand it a replica argv)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+_log = logging.getLogger("keto_tpu.fleet")
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        signals_fn: Callable[[], dict],
+        *,
+        spawner=None,
+        min_replicas: int = 0,
+        max_replicas: int = 4,
+        sustain_s: float = 5.0,
+        cooldown_s: float = 30.0,
+        quiet_s: Optional[float] = None,
+        burn_threshold: float = 1.0,
+        queue_threshold: float = 0.8,
+    ):
+        self._signals_fn = signals_fn
+        self.spawner = spawner
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.sustain_s = float(sustain_s)
+        self.cooldown_s = float(cooldown_s)
+        # calm must hold notably longer than overload before shrinking:
+        # asymmetric hysteresis is what stops spawn/retire oscillation
+        self.quiet_s = float(quiet_s) if quiet_s is not None else 4.0 * float(sustain_s)
+        self.burn_threshold = float(burn_threshold)
+        self.queue_threshold = float(queue_threshold)
+        self._lock = threading.Lock()
+        self._overload_since: Optional[float] = None
+        self._calm_since: Optional[float] = None
+        self._last_action_at: Optional[float] = None
+        #: advisory-mode replica count (acts as the virtual fleet size
+        #: when no spawner is attached; tests drive it directly)
+        self.advised = int(min_replicas)
+        self.grow_actions = 0
+        self.shrink_actions = 0
+        self.last_decision = "hold"
+        self.last_signals: dict = {}
+        self._task = None
+        self._stop_evt = threading.Event()
+
+    # -- the pure decision core ----------------------------------------------
+
+    def _overloaded(self, s: dict) -> bool:
+        burn = max(
+            float(s.get("availability_burn_rate", 0.0) or 0.0),
+            float(s.get("latency_burn_rate", 0.0) or 0.0),
+        )
+        queue = float(s.get("queue_depth_ratio", 0.0) or 0.0)
+        return burn > self.burn_threshold or queue >= self.queue_threshold
+
+    def _calm(self, s: dict) -> bool:
+        burn = max(
+            float(s.get("availability_burn_rate", 0.0) or 0.0),
+            float(s.get("latency_burn_rate", 0.0) or 0.0),
+        )
+        queue = float(s.get("queue_depth_ratio", 0.0) or 0.0)
+        # stricter than "not overloaded": shrink only well inside budget
+        return burn <= 0.5 * self.burn_threshold and queue < 0.5 * self.queue_threshold
+
+    def decide(self, signals: dict, now: float, current: int) -> str:
+        """'grow' / 'shrink' / 'hold' for one step — pure over
+        (signals, clock, fleet size); all hysteresis state lives on
+        self and advances deterministically with the supplied clock."""
+        with self._lock:
+            overloaded = self._overloaded(signals)
+            calm = self._calm(signals)
+            if overloaded:
+                if self._overload_since is None:
+                    self._overload_since = now
+                self._calm_since = None
+            elif calm:
+                if self._calm_since is None:
+                    self._calm_since = now
+                self._overload_since = None
+            else:
+                # the dead band between grow and shrink pressure: reset
+                # BOTH timers — neither action may accumulate toward
+                # firing while the signal is ambiguous
+                self._overload_since = None
+                self._calm_since = None
+            cooling = (
+                self._last_action_at is not None
+                and now - self._last_action_at < self.cooldown_s
+            )
+            if (
+                overloaded
+                and not cooling
+                and current < self.max_replicas
+                and now - self._overload_since >= self.sustain_s
+            ):
+                self._last_action_at = now
+                self._overload_since = None
+                return "grow"
+            if (
+                calm
+                and not cooling
+                and current > self.min_replicas
+                # HBM pressure vetoes shrink: fewer replicas concentrate
+                # read load onto processes already shedding residency
+                and int(signals.get("hbm_rung", 0) or 0) == 0
+                and now - self._calm_since >= self.quiet_s
+            ):
+                self._last_action_at = now
+                self._calm_since = None
+                return "shrink"
+            return "hold"
+
+    # -- the acting step -----------------------------------------------------
+
+    def current(self) -> int:
+        if self.spawner is not None:
+            return self.spawner.count()
+        return self.advised
+
+    def step(self, now: Optional[float] = None) -> str:
+        """One control-loop pass: read signals, decide, act. Returns
+        the decision (the supervised fleet loop calls this; the smoke
+        harness and tests call it directly)."""
+        t = time.time() if now is None else now
+        signals = self._signals_fn()
+        self.last_signals = signals
+        decision = self.decide(signals, t, self.current())
+        self.last_decision = decision
+        if decision == "grow":
+            self.grow_actions += 1
+            if self.spawner is not None:
+                self.spawner.spawn()
+            else:
+                self.advised += 1
+            _log.warning(
+                "autoscale grow -> %d replicas (burn=%.2f/%.2f queue=%.2f)",
+                self.current(),
+                float(signals.get("availability_burn_rate", 0) or 0),
+                float(signals.get("latency_burn_rate", 0) or 0),
+                float(signals.get("queue_depth_ratio", 0) or 0),
+            )
+        elif decision == "shrink":
+            self.shrink_actions += 1
+            if self.spawner is not None:
+                self.spawner.retire_one()
+            else:
+                self.advised = max(self.min_replicas, self.advised - 1)
+            _log.info("autoscale shrink -> %d replicas", self.current())
+        return decision
+
+    def start(self, period_s: float = 1.0) -> None:
+        """Run the control loop supervised (crashes restart with
+        backoff, like every other background loop in the daemon)."""
+        from keto_tpu.x.supervise import SupervisedTask
+
+        if self._task is not None:
+            return
+        self._stop_evt.clear()
+
+        def run():
+            while not self._stop_evt.is_set():
+                self.step()
+                self._stop_evt.wait(timeout=period_s)
+
+        self._task = SupervisedTask("fleet-autoscale", run)
+        self._task.kick()
+
+    def stop(self, timeout: float = 2.0) -> None:
+        if self._task is None:
+            return
+        self._stop_evt.set()
+        self._task.stop(timeout)
+        self._task = None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "replicas": self.current(),
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "grow_actions": self.grow_actions,
+                "shrink_actions": self.shrink_actions,
+                "last_decision": self.last_decision,
+                "advisory": self.spawner is None,
+                "signals": dict(self.last_signals),
+            }
+
+
+__all__ = ["Autoscaler"]

@@ -1,0 +1,271 @@
+"""FleetController: lease-based primary election with epoch fencing.
+
+One supervised heartbeat loop per process drives the whole election
+protocol through the shared SQL store (the fleet's only coordination
+medium — no extra quorum service):
+
+- **Primary**: heartbeat membership, then renew the lease
+  (``lease-renew`` kill point sits before the renewing UPDATE — a kill
+  there is a primary dying between heartbeats). A failed renewal means
+  the epoch moved: try one re-acquire (clock hiccup, nobody took over),
+  else mark this process DEPOSED — the fence stays at the old epoch, so
+  every in-flight and future transact aborts with ErrFencedEpoch (409).
+  No split brain: the fence check runs inside the write transaction,
+  serialized against the usurper's epoch bump by the watermark row lock.
+- **Replica**: heartbeat membership (applied watermark + lag feed the
+  promotion rank and the /fleet routing weights), then watch the lease.
+  When it expires, wait ``promotion_grace_s × rank`` (most-caught-up
+  replica moves first), then race the CAS — exactly one contender wins
+  the new epoch. The winner passes ``promote-install`` (kill point:
+  epoch durably taken, promoted store not yet installed — recovery must
+  be exactly-once) and runs ``on_promote(epoch)``: the registry swaps
+  the replica's store for a direct SQL store at the SAME durable
+  watermark the replica already applied (the device snapshot stays
+  valid — that is the durable-watermark handoff) and arms the fence.
+  Writes resume in under a lease TTL + grace, no acked write lost.
+
+The controller never blocks the serving path: reads/writes consult only
+its cheap in-memory flags (``is_primary``, ``deposed``, ``epoch``)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from keto_tpu.fleet.lease import lease_standing, promotion_rank, route_weights
+from keto_tpu.x import faults
+from keto_tpu.x.supervise import SupervisedTask
+
+_log = logging.getLogger("keto_tpu.fleet")
+
+
+class FleetController:
+    def __init__(
+        self,
+        lease_store,
+        node_id: str,
+        *,
+        advertise_url: str = "",
+        role: str = "primary",
+        lease_ttl_s: float = 2.0,
+        heartbeat_s: float = 0.5,
+        promotion_grace_s: float = 0.5,
+        lag_budget_s: float = 30.0,
+        watermark_fn: Optional[Callable[[], int]] = None,
+        lag_fn: Optional[Callable[[], float]] = None,
+        on_promote: Optional[Callable[[int], None]] = None,
+        on_deposed: Optional[Callable[[], None]] = None,
+        fence_fn: Optional[Callable[[Optional[int]], None]] = None,
+        stats=None,
+    ):
+        """``lease_store`` is anything with the fleet_* persister API
+        (a dedicated SQL connection — replicas keep NO tuple-store SQL
+        access; this is their one lease-only channel). ``fence_fn``
+        installs the fencing epoch on the TUPLE store (primary only);
+        ``on_promote`` performs the store handoff when this node wins."""
+        self._store = lease_store
+        self.node_id = node_id
+        self.advertise_url = advertise_url.rstrip("/")
+        self.role = role
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.heartbeat_s = max(0.05, float(heartbeat_s))
+        self.promotion_grace_s = max(0.0, float(promotion_grace_s))
+        self.lag_budget_s = float(lag_budget_s)
+        self._watermark_fn = watermark_fn or (lambda: 0)
+        self._lag_fn = lag_fn or (lambda: 0.0)
+        self._on_promote = on_promote
+        self._on_deposed = on_deposed
+        self._fence_fn = fence_fn
+        self._lock = threading.Lock()  # guards: epoch, role, deposed, _members
+        self.epoch = 0
+        self.deposed = False
+        self.promotions = 0
+        self.promotions_by_reason: dict[str, int] = {}
+        self.renew_failures = 0
+        self._members: list[dict] = []
+        self._lease_lost_at: Optional[float] = None
+        self._stop = threading.Event()
+        self._task = SupervisedTask(
+            "fleet-heartbeat", self._run, stats=stats,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._task.kick()
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        self._task.stop(timeout=timeout)
+
+    def alive(self) -> bool:
+        return self._task.alive()
+
+    # -- serving-path read surface (cheap flags, no SQL) ---------------------
+
+    @property
+    def is_primary(self) -> bool:
+        with self._lock:
+            return self.role == "primary" and not self.deposed
+
+    def members(self) -> list[dict]:
+        with self._lock:
+            return list(self._members)
+
+    def fleet_size(self) -> int:
+        with self._lock:
+            return len(self._members)
+
+    def snapshot(self) -> dict:
+        """Operator/metrics/SDK view — the /fleet body's fleet section."""
+        with self._lock:
+            members = list(self._members)
+            return {
+                "node_id": self.node_id,
+                "role": "deposed" if self.deposed else self.role,
+                "epoch": self.epoch,
+                "is_primary": self.role == "primary" and not self.deposed,
+                "deposed": self.deposed,
+                "fleet_size": len(members),
+                "members": members,
+                "promotions": self.promotions,
+                "promotions_by_reason": dict(self.promotions_by_reason),
+                "renew_failures": self.renew_failures,
+                "route_weights": route_weights(members, self.lag_budget_s),
+                "lease_ttl_s": self.lease_ttl_s,
+            }
+
+    # -- the heartbeat loop --------------------------------------------------
+
+    def _run(self) -> None:
+        """One supervised-loop lifetime: tick until stop; exceptions
+        raise into the supervisor's jittered-backoff retry."""
+        while not self._stop.is_set():
+            self.tick()
+            if self._stop.wait(timeout=self.heartbeat_s):
+                return
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """One protocol step (public so tests drive the state machine
+        with a synthetic clock)."""
+        t = time.time() if now is None else now
+        with self._lock:
+            role, deposed, epoch = self.role, self.deposed, self.epoch
+        self._store.fleet_heartbeat(
+            self.node_id,
+            self.advertise_url,
+            "deposed" if deposed else role,
+            self._watermark_fn(),
+            self._lag_fn(),
+            now=t,
+        )
+        # members age out at 3 heartbeats + slack: a SIGKILL'd node
+        # drops from fleet_size (and the promotion rank) within ~2 s
+        members = self._store.fleet_members(
+            max_age_s=3 * self.heartbeat_s + 1.0, now=t
+        )
+        with self._lock:
+            self._members = members
+        if deposed:
+            return  # fenced: heartbeat only, never contend again
+        if role == "primary":
+            self._primary_tick(t, epoch)
+        else:
+            self._replica_tick(t)
+
+    def _primary_tick(self, now: float, epoch: int) -> None:
+        faults.check("lease-renew")
+        if self._store.fleet_lease_renew(
+            self.node_id, epoch, self.lease_ttl_s, now=now
+        ):
+            return
+        # epoch moved under us (or first tick, epoch still 0): one
+        # re-acquire attempt — succeeds on boot and after a clock
+        # hiccup nobody exploited, fails when a replica took over
+        got = self._store.fleet_lease_acquire(
+            self.node_id, self.lease_ttl_s, now=now
+        )
+        if got is not None:
+            self._install_epoch(got)
+            if epoch:
+                self.renew_failures += 1
+            return
+        self.renew_failures += 1
+        self._depose()
+
+    def _replica_tick(self, now: float) -> None:
+        lease = self._store.fleet_lease()
+        if lease is not None:
+            with self._lock:
+                self.epoch = int(lease["epoch"])
+        if lease is not None and lease.get("holder") == self.node_id:
+            # we already hold the lease but never finished installing
+            # (crash-retry after a failed on_promote): finish it now —
+            # exactly-once per epoch, because the epoch is already ours
+            self._promote(int(lease["epoch"]), reason="install-retry")
+            return
+        if lease_standing(lease, now):
+            self._lease_lost_at = None
+            return
+        if self._lease_lost_at is None:
+            self._lease_lost_at = now
+        # rank-staggered contention: the most-caught-up replica moves
+        # first; ties and stale ranks are harmless (the CAS picks one)
+        rank = promotion_rank(self.members(), self.node_id)
+        if now - self._lease_lost_at < self.promotion_grace_s * rank:
+            return
+        got = self._store.fleet_lease_acquire(
+            self.node_id, self.lease_ttl_s, now=now
+        )
+        if got is None:
+            return  # lost the race; the winner's lease shows next tick
+        self._promote(got, reason="lease-expired")
+
+    def _promote(self, epoch: int, reason: str) -> None:
+        # epoch durably taken; a kill here (promote-install) must leave
+        # recovery exactly-once — it is: the epoch stays ours, the next
+        # tick's holder==me branch retries the install, and no other
+        # contender can win THIS epoch
+        faults.check("promote-install")
+        if self._on_promote is not None:
+            self._on_promote(int(epoch))
+        with self._lock:
+            self.role = "primary"
+            self._lease_lost_at = None
+            self.promotions += 1
+            self.promotions_by_reason[reason] = (
+                self.promotions_by_reason.get(reason, 0) + 1
+            )
+        self._install_epoch(epoch)
+        _log.warning(
+            "promoted to primary at epoch %d (%s)", epoch, reason
+        )
+
+    def _install_epoch(self, epoch: int) -> None:
+        with self._lock:
+            self.epoch = int(epoch)
+        if self._fence_fn is not None:
+            self._fence_fn(int(epoch))
+
+    def _depose(self) -> None:
+        with self._lock:
+            if self.deposed:
+                return
+            self.deposed = True
+        # the fence is NOT advanced: it stays at the old epoch, so every
+        # in-flight and future transact on this process aborts with
+        # ErrFencedEpoch — the usurper's history is the only history
+        _log.error(
+            "deposed: fleet lease epoch moved past ours (%d); writes are "
+            "fenced, reads keep serving stale", self.epoch,
+        )
+        if self._on_deposed is not None:
+            try:
+                self._on_deposed()
+            except Exception:
+                _log.warning("on_deposed callback failed", exc_info=True)
+
+
+__all__ = ["FleetController"]

@@ -1,0 +1,92 @@
+"""Pure election and routing math, shared by server and SDK.
+
+Everything here is a plain function over member dicts (the rows
+``fleet_members`` returns / the ``/fleet`` body carries) so the
+controller, the REST surface, the httpclient, and the unit tests all
+compute the same ranks and weights from the same inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+#: latency floor for route weights: keeps 1/latency finite and stops a
+#: replica with one lucky fast sample from absorbing all traffic
+LATENCY_FLOOR_S = 0.001
+
+
+def promotion_rank(members: Sequence[dict], node_id: str) -> int:
+    """This node's position in the promotion order: replicas ranked
+    most-caught-up first (highest applied watermark; node_id breaks
+    ties deterministically so every observer agrees). Rank 0 promotes
+    immediately on lease expiry; rank k waits k grace periods — the
+    stagger that keeps N contenders from storming the CAS at once
+    (exactly one would win anyway; the stagger just makes the winner
+    the most-caught-up one in the common case). Nodes not present (or
+    not replicas) rank after everyone."""
+    replicas = sorted(
+        (m for m in members if m.get("role") == "replica"),
+        key=lambda m: (-int(m.get("watermark", 0)), str(m.get("node_id", ""))),
+    )
+    for i, m in enumerate(replicas):
+        if m.get("node_id") == node_id:
+            return i
+    return len(replicas)
+
+
+def lease_standing(lease: Optional[dict], now: float) -> bool:
+    """Whether a live (unexpired, held) lease stands."""
+    return (
+        lease is not None
+        and bool(lease.get("holder"))
+        and float(lease.get("expires_at", 0.0)) > now
+    )
+
+
+def route_weight(
+    lag_s: float,
+    lag_budget_s: float,
+    latency_s: float = 0.0,
+    latency_floor_s: float = LATENCY_FLOOR_S,
+) -> float:
+    """Read-routing weight for one replica: 0 once its replication lag
+    reaches the budget (drain it BEFORE the 412 gate starts firing),
+    otherwise a lag-discounted inverse of its latency EWMA — fresher
+    and faster replicas absorb proportionally more reads than blind
+    round-robin would give them."""
+    lag_s = max(0.0, float(lag_s))
+    if lag_budget_s > 0 and lag_s >= lag_budget_s:
+        return 0.0
+    lag_factor = 1.0 - (lag_s / lag_budget_s if lag_budget_s > 0 else 0.0)
+    return max(0.0, lag_factor) / (max(0.0, float(latency_s)) + latency_floor_s)
+
+
+def route_weights(
+    members: Sequence[dict],
+    lag_budget_s: float,
+    latency_ewma_s: Optional[dict] = None,
+) -> dict[str, float]:
+    """Per-replica weights over a membership listing. ``latency_ewma_s``
+    maps node_id (or url) to the caller's observed latency EWMA; absent
+    entries weigh by lag alone (the server's /fleet view has no client
+    latencies)."""
+    ewma = latency_ewma_s or {}
+    out: dict[str, float] = {}
+    for m in members:
+        if m.get("role") != "replica":
+            continue
+        nid = str(m.get("node_id", ""))
+        lat = ewma.get(nid, ewma.get(str(m.get("url", "")), 0.0))
+        out[nid] = route_weight(
+            float(m.get("lag_s", 0.0)), lag_budget_s, float(lat or 0.0)
+        )
+    return out
+
+
+__all__ = [
+    "LATENCY_FLOOR_S",
+    "lease_standing",
+    "promotion_rank",
+    "route_weight",
+    "route_weights",
+]

@@ -1,0 +1,140 @@
+"""Live resharding: shard split/merge on the graph mesh axis under traffic.
+
+The mechanism is **engine substitution**, not in-place mutation: the
+coordinator builds a COMPLETE second check engine at the target mesh
+geometry (its own device snapshot over the same store, sharded on the
+new axis, reusing snapcache v6's per-shard stripes where the geometry
+matches) while the old engine keeps serving every request. Only when
+the new engine has a live snapshot does the atomic install swap it into
+the registry singleton and the check batcher — one reference assignment
+each, no request ever observes a half-resharded engine.
+
+Correctness across the swap comes from the store, not the geometry:
+both engines answer bit-identically at any snaptoken because both
+derive from the same watermark-ordered tuple history, and the 412 read
+gate pins a caller's snaptoken exactly as before. A kill between build
+and install (the ``reshard-handoff`` point) leaves the old geometry
+serving — zero wrong answers by construction, proven by the chaos suite
+and the fleet smoke's 3-way parity sweep.
+
+States (the ``keto_reshard_state`` metric's code space):
+
+    idle(0) → preparing(1) → handoff(2) → idle(0)
+                    └──────────→ failed(3) → idle on the next attempt
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from keto_tpu.x import faults
+
+_log = logging.getLogger("keto_tpu.fleet")
+
+#: reshard state machine → the keto_reshard_state gauge's code space
+STATE_CODES = {"idle": 0, "preparing": 1, "handoff": 2, "failed": 3}
+
+
+class ReshardCoordinator:
+    def __init__(
+        self,
+        build_fn: Callable[[int], object],
+        install_fn: Callable[[object, int], None],
+        *,
+        current_fn: Optional[Callable[[], int]] = None,
+    ):
+        """``build_fn(target)`` constructs a fully warmed engine at the
+        target graph-shard count (expensive, runs while the old engine
+        serves); ``install_fn(engine, target)`` performs the atomic
+        swap; ``current_fn`` reports the serving geometry."""
+        self._build_fn = build_fn
+        self._install_fn = install_fn
+        self._current_fn = current_fn or (lambda: 1)
+        self._lock = threading.Lock()  # guards: state, _busy
+        self.state = "idle"
+        self._busy = False
+        self.reshards_total = 0
+        self.failures = 0
+        self.last_error: Optional[str] = None
+        self.last_target: Optional[int] = None
+        self.last_duration_s: Optional[float] = None
+
+    def state_code(self) -> int:
+        with self._lock:
+            return STATE_CODES.get(self.state, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "state_code": STATE_CODES.get(self.state, 0),
+                "current_shards": int(self._current_fn()),
+                "reshards_total": self.reshards_total,
+                "failures": self.failures,
+                "last_target": self.last_target,
+                "last_error": self.last_error,
+                "last_duration_s": self.last_duration_s,
+            }
+
+    def reshard(self, target: int) -> dict:
+        """Split/merge to ``target`` graph shards under traffic. Blocks
+        the CALLING thread for the build (callers run it off the serving
+        path — the daemon would use a maintenance thread); the serving
+        path is never blocked, only briefly contended at the install.
+        Raises on overlap (one reshard at a time) and build failure; the
+        old geometry keeps serving in every failure mode."""
+        target = int(target)
+        if target < 1:
+            raise ValueError(f"reshard target must be >= 1, got {target}")
+        with self._lock:
+            if self._busy:
+                raise RuntimeError(
+                    f"reshard already in flight (state={self.state})"
+                )
+            self._busy = True
+            self.state = "preparing"
+            self.last_target = target
+            self.last_error = None
+        t0 = time.monotonic()
+        try:
+            if target == int(self._current_fn()):
+                # no-op split: report success without churning devices
+                with self._lock:
+                    self.state = "idle"
+                    self._busy = False
+                return self.snapshot()
+            new_engine = self._build_fn(target)
+            # the handoff kill point: the new geometry exists, the old
+            # one still serves — a kill here must leave zero wrong
+            # answers (it does: nothing was installed)
+            faults.check("reshard-handoff")
+            with self._lock:
+                self.state = "handoff"
+            self._install_fn(new_engine, target)
+            with self._lock:
+                self.state = "idle"
+                self.reshards_total += 1
+                self.last_duration_s = time.monotonic() - t0
+                self._busy = False
+            _log.warning(
+                "resharded to %d graph shards in %.2fs (live, zero "
+                "downtime)", target, time.monotonic() - t0,
+            )
+            return self.snapshot()
+        except Exception as e:
+            with self._lock:
+                self.state = "failed"
+                self.failures += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+                self._busy = False
+            _log.error(
+                "reshard to %d shards failed; old geometry keeps serving",
+                target, exc_info=True,
+            )
+            raise
+
+
+__all__ = ["ReshardCoordinator", "STATE_CODES"]

@@ -1,0 +1,199 @@
+"""Replica subprocess lifecycle: the autoscaler's actuator.
+
+The chaos harness (tests/chaos_runner.py + tests/test_chaos.py) grew
+battle-tested process plumbing — spawn a serving daemon, discover its
+ports through an atomically renamed port file, retire it with a drain
+grace before escalating to SIGKILL. This module is that plumbing
+productionized: the autoscale loop calls ``spawn()``/``retire()`` and
+never touches Popen directly, and the fleet smoke harness drives the
+same code the control plane runs.
+
+The spawner is deliberately argv-agnostic: the caller provides
+``argv_fn(index, port_file) -> list[str]`` building the replica's
+command line (pointing ``--port-file`` at the handed path), plus the
+env. Nothing here knows about config schemas or roles — that keeps the
+same spawner usable by the daemon's autoscaler, the smoke script, and
+the tests without three forks of Popen handling."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+_log = logging.getLogger("keto_tpu.fleet")
+
+
+class SpawnedReplica:
+    """One spawned serving subprocess plus its published ports."""
+
+    def __init__(self, index: int, proc, port_file: Path, log_file=None):
+        self.index = index
+        self.proc = proc
+        self.port_file = port_file
+        self._log_file = log_file
+        self.ports: Optional[dict] = None
+        self.spawned_at = time.monotonic()
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def wait_ports(self, timeout: float = 90.0) -> Optional[dict]:
+        """Ports once the daemon published them (atomic rename → a
+        half-written file only ever loses the race once), or None when
+        the process died first."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.port_file.is_file():
+                try:
+                    self.ports = json.loads(self.port_file.read_text())
+                except json.JSONDecodeError:
+                    pass  # mid-rename race; retry
+                else:
+                    return self.ports
+            if self.proc.poll() is not None:
+                return None
+            time.sleep(0.05)
+        return None
+
+    def url(self) -> str:
+        if not self.ports:
+            return ""
+        return f"http://127.0.0.1:{self.ports['read']}"
+
+    def terminate(self, grace_s: float = 10.0) -> int:
+        """Drain-retire: SIGTERM (the daemon's drain path finishes
+        in-flight work and exits 0), escalating to SIGKILL past the
+        grace. Returns the exit status."""
+        if self.proc.poll() is None:
+            try:
+                self.proc.send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            try:
+                self.proc.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                _log.warning(
+                    "replica %d did not drain within %.1fs; SIGKILL",
+                    self.index, grace_s,
+                )
+                self.kill()
+        status = self._wait_reaped()
+        self._close()
+        return status
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            try:
+                self.proc.kill()
+            except ProcessLookupError:
+                pass
+        self._wait_reaped()
+        self._close()
+
+    def _wait_reaped(self) -> int:
+        """Reap the (already signalled) child. Bounded + looped rather
+        than a bare wait(): a SIGKILL'd process can only linger as an
+        unreaped zombie, and a missed wakeup must not park the control
+        loop forever."""
+        while True:
+            try:
+                return self.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                _log.warning(
+                    "replica %d (pid %d) not reaped yet; waiting",
+                    self.index, self.proc.pid,
+                )
+
+    def _close(self) -> None:
+        if self._log_file is not None:
+            try:
+                self._log_file.close()
+            except Exception:
+                _log.warning(
+                    "replica %d log file close failed", self.index,
+                    exc_info=True,
+                )
+            self._log_file = None
+
+
+class ReplicaSpawner:
+    def __init__(
+        self,
+        argv_fn: Callable[[int, Path], list],
+        workdir: str,
+        *,
+        env: Optional[dict] = None,
+        drain_grace_s: float = 10.0,
+    ):
+        """``argv_fn(index, port_file)`` builds one replica's command
+        line; ``workdir`` holds port files and per-process logs."""
+        self._argv_fn = argv_fn
+        self._workdir = Path(workdir)
+        self._env = env
+        self.drain_grace_s = float(drain_grace_s)
+        self._next_index = 0
+        self.children: list[SpawnedReplica] = []
+        self.spawned_total = 0
+        self.retired_total = 0
+
+    def alive_children(self) -> list[SpawnedReplica]:
+        self.children = [c for c in self.children if c.alive()]
+        return self.children
+
+    def count(self) -> int:
+        return len(self.alive_children())
+
+    def spawn(self) -> SpawnedReplica:
+        self._workdir.mkdir(parents=True, exist_ok=True)
+        idx = self._next_index
+        self._next_index += 1
+        port_file = self._workdir / f"replica-{idx}-ports.json"
+        port_file.unlink(missing_ok=True)
+        log_path = self._workdir / f"replica-{idx}.log"
+        log_file = open(log_path, "ab")
+        env = dict(self._env if self._env is not None else os.environ)
+        proc = subprocess.Popen(
+            [str(a) for a in self._argv_fn(idx, port_file)],
+            env=env,
+            stdout=log_file,
+            stderr=log_file,
+        )
+        child = SpawnedReplica(idx, proc, port_file, log_file)
+        self.children.append(child)
+        self.spawned_total += 1
+        _log.info("spawned replica %d (pid %d)", idx, proc.pid)
+        return child
+
+    def retire_one(self) -> Optional[SpawnedReplica]:
+        """Drain-retire the youngest replica (spawn order is retire
+        order reversed: the longest-lived replica has the warmest
+        caches and the most applied history — keep it)."""
+        live = self.alive_children()
+        if not live:
+            return None
+        child = live[-1]
+        child.terminate(grace_s=self.drain_grace_s)
+        self.children.remove(child)
+        self.retired_total += 1
+        _log.info("retired replica %d (pid %d)", child.index, child.pid)
+        return child
+
+    def stop_all(self, grace_s: Optional[float] = None) -> None:
+        for child in list(self.children):
+            child.terminate(
+                grace_s=self.drain_grace_s if grace_s is None else grace_s
+            )
+        self.children.clear()
+
+
+__all__ = ["ReplicaSpawner", "SpawnedReplica"]

@@ -204,6 +204,12 @@ class _SharedState:
         # in-memory analog of the SQL keto_idempotency table (same replay
         # semantics; durability obviously ends with the process)
         self.idempotency: dict[str, dict[str, tuple[int, float]]] = {}
+        # fleet control plane: nid → lease dict / nid → node_id → member
+        # dict — the in-memory analog of keto_fleet_lease/_members (same
+        # CAS and fencing semantics, for the contract suite and fleet
+        # unit tests; a real fleet shares a SQL store)
+        self.fleet_lease: dict[str, dict] = {}
+        self.fleet_members: dict[str, dict[str, dict]] = {}
 
 
 class MemoryPersister(Manager):
@@ -237,6 +243,12 @@ class MemoryPersister(Manager):
         #: group-transact introspection (matching sql_base)
         self.group_commits = 0
         self.group_commit_writers = 0
+        #: fleet-lease fencing token (matching sql_base.fence_epoch):
+        #: when set, writes re-check the lease epoch before mutating and
+        #: abort with ErrFencedEpoch once a newer primary has taken over
+        self.fence_epoch: Optional[int] = None
+        #: writes aborted by the fence (the /metrics bridge reads this)
+        self.fenced_writes = 0
 
     @property
     def namespaces(self):
@@ -524,6 +536,9 @@ class MemoryPersister(Manager):
         if not writes:
             return []
         with self._shared.lock:
+            # fence once for the whole group (all-or-nothing, matching
+            # the SQL group path): no writer applies once deposed
+            self._check_fence_locked()
             faults.check("transact-commit")
             faults.check("group-commit")
             results = [
@@ -553,6 +568,10 @@ class MemoryPersister(Manager):
                 if got is not None:
                     self.idempotent_replays += 1
                     return TransactResult(snaptoken=got[0], replayed=True)
+            # fencing before any mutation (the in-memory store has no
+            # transaction to roll back): a deposed primary's write must
+            # leave the store untouched
+            self._check_fence_locked()
             if fire_faults:
                 faults.check("transact-commit")
             new_sorted: Optional[list[InternalRow]] = None
@@ -717,6 +736,107 @@ class MemoryPersister(Manager):
     def watermark(self) -> int:
         with self._shared.lock:
             return self._shared.watermark
+
+    # -- fleet control plane (lease, fencing, membership) --------------------
+    # The in-memory analog of sql_base's keto_fleet_lease/_members: same
+    # CAS, fencing and ordering semantics under the shared lock, so the
+    # fleet unit tests and the contract suite exercise one behavior.
+
+    def _check_fence_locked(self) -> None:
+        if self.fence_epoch is None:
+            return
+        lease = self._shared.fleet_lease.get(self.network_id)
+        if lease is not None and int(lease["epoch"]) > int(self.fence_epoch):
+            from keto_tpu.x.errors import ErrFencedEpoch
+
+            self.fenced_writes += 1
+            raise ErrFencedEpoch(
+                details={
+                    "fence_epoch": int(self.fence_epoch),
+                    "lease_epoch": int(lease["epoch"]),
+                }
+            )
+
+    def fleet_lease(self) -> Optional[dict]:
+        with self._shared.lock:
+            lease = self._shared.fleet_lease.get(self.network_id)
+            return dict(lease) if lease is not None else None
+
+    def fleet_lease_acquire(
+        self, holder: str, ttl_s: float, now: Optional[float] = None
+    ) -> Optional[int]:
+        t = time.time() if now is None else now
+        with self._shared.lock:
+            lease = self._shared.fleet_lease.setdefault(
+                self.network_id, {"epoch": 0, "holder": "", "expires_at": 0.0}
+            )
+            if (
+                lease["holder"] not in ("", holder)
+                and lease["expires_at"] > t
+            ):
+                return None
+            lease["epoch"] = int(lease["epoch"]) + 1
+            lease["holder"] = holder
+            lease["expires_at"] = t + ttl_s
+            return lease["epoch"]
+
+    def fleet_lease_renew(
+        self, holder: str, epoch: int, ttl_s: float,
+        now: Optional[float] = None,
+    ) -> bool:
+        t = time.time() if now is None else now
+        with self._shared.lock:
+            lease = self._shared.fleet_lease.get(self.network_id)
+            if (
+                lease is None
+                or int(lease["epoch"]) != int(epoch)
+                or lease["holder"] != holder
+            ):
+                return False
+            lease["expires_at"] = t + ttl_s
+            return True
+
+    def fleet_heartbeat(
+        self,
+        node_id: str,
+        url: str,
+        role: str,
+        watermark: int,
+        lag_s: float,
+        now: Optional[float] = None,
+    ) -> None:
+        t = time.time() if now is None else now
+        with self._shared.lock:
+            members = self._shared.fleet_members.setdefault(self.network_id, {})
+            members[node_id] = {
+                "node_id": node_id,
+                "url": url,
+                "role": role,
+                "watermark": int(watermark),
+                "lag_s": float(lag_s),
+                "updated_at": t,
+            }
+
+    def fleet_member_remove(self, node_id: str) -> None:
+        with self._shared.lock:
+            self._shared.fleet_members.get(self.network_id, {}).pop(
+                node_id, None
+            )
+
+    def fleet_members(
+        self, max_age_s: Optional[float] = None, now: Optional[float] = None
+    ) -> list[dict]:
+        t = time.time() if now is None else now
+        with self._shared.lock:
+            rows = [
+                dict(m)
+                for m in self._shared.fleet_members.get(
+                    self.network_id, {}
+                ).values()
+                if max_age_s is None or t - m["updated_at"] <= max_age_s
+            ]
+        rows.sort(key=lambda m: (-m["watermark"], m["node_id"]))
+        return rows
 
     # -- watch-log horizon hygiene -------------------------------------------
 

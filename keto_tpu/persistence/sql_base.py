@@ -34,7 +34,7 @@ from keto_tpu.persistence.memory import InternalRow
 from keto_tpu.relationtuple.manager import Manager, TransactResult, TransactWrite
 from keto_tpu.relationtuple.model import RelationQuery, RelationTuple, SubjectID, SubjectSet
 from keto_tpu.x import faults
-from keto_tpu.x.errors import ErrMalformedPageToken, ErrNilSubject
+from keto_tpu.x.errors import ErrFencedEpoch, ErrMalformedPageToken, ErrNilSubject
 from keto_tpu.x.pagination import (
     DEFAULT_PAGE_SIZE,
     PaginationOptionSetter,
@@ -235,6 +235,47 @@ MIGRATIONS: list[tuple[str, str, str]] = [
             """,
         ),
     ),
+    (
+        # fleet lease: one row per network holding the primary election
+        # state. ``epoch`` is the fencing token — every acquisition bumps
+        # it via a compare-and-swap UPDATE guarded on the epoch the
+        # contender read, so exactly one contender wins a given epoch.
+        # Writers re-read this row INSIDE their write transaction (after
+        # the watermark upsert's row lock serializes them against the
+        # promotion) and abort with ErrFencedEpoch when a newer primary
+        # has taken over — no split brain.
+        "20260807000000_fleet_lease",
+        """
+        CREATE TABLE keto_fleet_lease (
+            nid TEXT PRIMARY KEY,
+            epoch BIGINT NOT NULL DEFAULT 0,
+            holder TEXT NOT NULL DEFAULT '',
+            expires_at DOUBLE PRECISION NOT NULL DEFAULT 0
+        )
+        """,
+        "DROP TABLE keto_fleet_lease",
+    ),
+    (
+        # fleet membership: heartbeat rows (one per node) carrying role,
+        # advertised URL, applied watermark and observed lag. The
+        # promotion rank (most-caught-up replica wins) and the /fleet
+        # routing endpoint both read this table; stale rows age out by
+        # ``updated_at``.
+        "20260807000001_fleet_members",
+        """
+        CREATE TABLE keto_fleet_members (
+            nid TEXT NOT NULL,
+            node_id TEXT NOT NULL,
+            url TEXT NOT NULL DEFAULT '',
+            role TEXT NOT NULL DEFAULT 'replica',
+            watermark BIGINT NOT NULL DEFAULT 0,
+            lag_s DOUBLE PRECISION NOT NULL DEFAULT 0,
+            updated_at DOUBLE PRECISION NOT NULL DEFAULT 0,
+            PRIMARY KEY (nid, node_id)
+        )
+        """,
+        "DROP TABLE keto_fleet_members",
+    ),
 ]
 
 #: delete-log retention window in watermark units; older entries prune and
@@ -351,6 +392,15 @@ class SQLPersisterBase(Manager):
         self._dsn = dsn
         #: how long idempotency keys dedup retries before GC forgets them
         self.idempotency_ttl_s = DEFAULT_IDEMPOTENCY_TTL_S
+        #: fleet-lease fencing token: when set (by the fleet controller on
+        #: a primary), every write transaction re-reads the lease row AFTER
+        #: allocating its commit_time — the watermark upsert's row lock
+        #: serializes the check against a concurrent promotion's epoch
+        #: bump — and aborts with ErrFencedEpoch when a newer primary has
+        #: taken over. None = fencing off (single-node deployments).
+        self.fence_epoch: Optional[int] = None
+        #: writes aborted by the fence (the /metrics bridge reads this)
+        self.fenced_writes = 0
         #: time-based watch-log retention (serve.watch_log_retention_s);
         #: 0 disables — only the count-based _DELETE_LOG_KEEP cap applies
         self.watch_log_retention_s = 0.0
@@ -738,6 +788,11 @@ class SQLPersisterBase(Manager):
                     self.idempotent_replays += 1
                     return TransactResult(snaptoken=int(row[0]), replayed=True)
             commit_time = self._alloc_commit_time()
+            # fencing AFTER the watermark upsert: its row lock serialized
+            # us against any concurrent promotion, so either this commit
+            # lands entirely before the epoch bump (covered by the
+            # durable-watermark handoff) or the fence aborts it here
+            self._check_fence_locked()
             changed = bool(ins_rows)
             if ins_rows:
                 shard_ids = uuid.uuid4().hex
@@ -905,6 +960,7 @@ class SQLPersisterBase(Manager):
             pending_idem: list[tuple] = []
             last_del_ct = 0
             any_changed = False
+            fence_checked = False
 
             def flush_ins():
                 if not pending_ins:
@@ -946,6 +1002,12 @@ class SQLPersisterBase(Manager):
                         results[idx] = TransactResult(snaptoken=tok, replayed=True)
                         continue
                 commit_time = self._alloc_commit_time()
+                if not fence_checked:
+                    # once per group: the first writer's watermark upsert
+                    # took the row lock that serializes the whole group
+                    # against a concurrent promotion's epoch bump
+                    self._check_fence_locked()
+                    fence_checked = True
                 changed = bool(ins_rows)
                 if ins_rows:
                     shard_ids = uuid.uuid4().hex
@@ -1068,6 +1130,195 @@ class SQLPersisterBase(Manager):
                     (self.network_id,),
                 ).fetchone()
                 return row[0] if row else 0
+
+        return self._with_reconnect(run, retry=True)
+
+    # -- fleet control plane (lease, fencing, membership) --------------------
+    #
+    # The lease row is the fleet's election state: ``epoch`` is the fencing
+    # token, bumped by exactly one winner per acquisition via a guarded
+    # single-statement UPDATE (the connection is autocommit, so the CAS is
+    # atomic at the database without an explicit transaction — two
+    # contenders reading the same epoch serialize at the UPDATE and only
+    # one matches its WHERE). Membership rows are plain heartbeats; the
+    # promotion rank and the /fleet routing endpoint read them.
+
+    def _check_fence_locked(self) -> None:
+        """Abort the open write transaction when this process's lease
+        epoch has been superseded. Called with the lock held, inside the
+        transaction, after ``_alloc_commit_time``."""
+        if self.fence_epoch is None:
+            return
+        row = self._exec(
+            "SELECT epoch FROM keto_fleet_lease WHERE nid = ?",
+            (self.network_id,),
+        ).fetchone()
+        if row is not None and int(row[0]) > int(self.fence_epoch):
+            self.fenced_writes += 1
+            raise ErrFencedEpoch(
+                details={
+                    "fence_epoch": int(self.fence_epoch),
+                    "lease_epoch": int(row[0]),
+                }
+            )
+
+    def fleet_lease(self) -> Optional[dict]:
+        """Current lease row, or None before the first acquisition."""
+
+        def run():
+            with self._lock:
+                row = self._exec(
+                    "SELECT epoch, holder, expires_at "
+                    "FROM keto_fleet_lease WHERE nid = ?",
+                    (self.network_id,),
+                ).fetchone()
+                if row is None:
+                    return None
+                return {
+                    "epoch": int(row[0]),
+                    "holder": row[1],
+                    "expires_at": float(row[2]),
+                }
+
+        return self._with_reconnect(run, retry=True)
+
+    def fleet_lease_acquire(
+        self, holder: str, ttl_s: float, now: Optional[float] = None
+    ) -> Optional[int]:
+        """Try to take (or re-take) the primary lease: returns the newly
+        minted epoch on success, None when another holder's unexpired
+        lease stands. Every successful acquisition bumps the epoch — even
+        a self-re-acquire — so a fence set from the returned value is
+        always current."""
+        t = time.time() if now is None else now
+
+        def run():
+            with self._lock:
+                row = self._exec(
+                    "SELECT epoch, holder, expires_at "
+                    "FROM keto_fleet_lease WHERE nid = ?",
+                    (self.network_id,),
+                ).fetchone()
+                if row is None:
+                    # seed the row unexpired-by-nobody; losers of the
+                    # insert race fall through to the CAS below
+                    self._exec(
+                        "INSERT INTO keto_fleet_lease "
+                        "(nid, epoch, holder, expires_at) "
+                        "VALUES (?, 0, '', 0) ON CONFLICT(nid) DO NOTHING",
+                        (self.network_id,),
+                    )
+                    row = self._exec(
+                        "SELECT epoch, holder, expires_at "
+                        "FROM keto_fleet_lease WHERE nid = ?",
+                        (self.network_id,),
+                    ).fetchone()
+                epoch, cur_holder, expires = int(row[0]), row[1], float(row[2])
+                if cur_holder not in ("", holder) and expires > t:
+                    return None  # someone else's live lease
+                # the CAS: one statement, guarded on the epoch we read AND
+                # the takeover precondition re-checked server-side
+                cur = self._exec(
+                    "UPDATE keto_fleet_lease "
+                    "SET epoch = ?, holder = ?, expires_at = ? "
+                    "WHERE nid = ? AND epoch = ? "
+                    "AND (holder = ? OR holder = '' OR expires_at <= ?)",
+                    (
+                        epoch + 1, holder, t + ttl_s,
+                        self.network_id, epoch, holder, t,
+                    ),
+                )
+                return epoch + 1 if cur.rowcount == 1 else None
+
+        return self._with_reconnect(run, retry=False)
+
+    def fleet_lease_renew(
+        self, holder: str, epoch: int, ttl_s: float,
+        now: Optional[float] = None,
+    ) -> bool:
+        """Extend the lease WITHOUT bumping the epoch. False means the
+        lease moved on (deposed): the caller must stop writing."""
+        t = time.time() if now is None else now
+
+        def run():
+            with self._lock:
+                cur = self._exec(
+                    "UPDATE keto_fleet_lease SET expires_at = ? "
+                    "WHERE nid = ? AND epoch = ? AND holder = ?",
+                    (t + ttl_s, self.network_id, int(epoch), holder),
+                )
+                return cur.rowcount == 1
+
+        return self._with_reconnect(run, retry=False)
+
+    def fleet_heartbeat(
+        self,
+        node_id: str,
+        url: str,
+        role: str,
+        watermark: int,
+        lag_s: float,
+        now: Optional[float] = None,
+    ) -> None:
+        t = time.time() if now is None else now
+
+        def run():
+            with self._lock:
+                self._exec(
+                    "INSERT INTO keto_fleet_members "
+                    "(nid, node_id, url, role, watermark, lag_s, updated_at) "
+                    "VALUES (?, ?, ?, ?, ?, ?, ?) "
+                    "ON CONFLICT(nid, node_id) DO UPDATE SET "
+                    "url = excluded.url, role = excluded.role, "
+                    "watermark = excluded.watermark, lag_s = excluded.lag_s, "
+                    "updated_at = excluded.updated_at",
+                    (
+                        self.network_id, node_id, url, role,
+                        int(watermark), float(lag_s), t,
+                    ),
+                )
+
+        self._with_reconnect(run, retry=True)
+
+    def fleet_member_remove(self, node_id: str) -> None:
+        def run():
+            with self._lock:
+                self._exec(
+                    "DELETE FROM keto_fleet_members "
+                    "WHERE nid = ? AND node_id = ?",
+                    (self.network_id, node_id),
+                )
+
+        self._with_reconnect(run, retry=True)
+
+    def fleet_members(
+        self, max_age_s: Optional[float] = None, now: Optional[float] = None
+    ) -> list[dict]:
+        """Membership rows, most-caught-up first (the promotion rank).
+        ``max_age_s`` filters out nodes whose heartbeat went stale."""
+        t = time.time() if now is None else now
+
+        def run():
+            with self._lock:
+                rows = self._exec(
+                    "SELECT node_id, url, role, watermark, lag_s, updated_at "
+                    "FROM keto_fleet_members WHERE nid = ? "
+                    "ORDER BY watermark DESC, node_id",
+                    (self.network_id,),
+                ).fetchall()
+            out = []
+            for r in rows:
+                if max_age_s is not None and t - float(r[5]) > max_age_s:
+                    continue
+                out.append({
+                    "node_id": r[0],
+                    "url": r[1],
+                    "role": r[2],
+                    "watermark": int(r[3]),
+                    "lag_s": float(r[4]),
+                    "updated_at": float(r[5]),
+                })
+            return out
 
         return self._with_reconnect(run, retry=True)
 

@@ -258,6 +258,8 @@ class RestApp:
                 return self._get_debug_requests(query)
             if route == ("GET", "/slo"):
                 return self._get_slo()
+            if route == ("GET", "/fleet"):
+                return self._get_fleet()
 
             if self.role == READ:
                 if route == ("GET", "/check"):
@@ -355,8 +357,59 @@ class RestApp:
     def _get_slo(self):
         """``GET /slo`` — the SLO engine's multi-window availability and
         latency burn-rate report (keto_tpu/x/slo.py); the same numbers
-        the ``keto_slo_*`` families expose at scrape time."""
-        return 200, self.registry.slo_engine().to_json(), {}
+        the ``keto_slo_*`` families expose at scrape time. The body also
+        carries the fleet coordinates (epoch, primaryship, size, reshard
+        state) so one poll answers both "how are we burning" and "who is
+        serving"."""
+        body = self.registry.slo_engine().to_json()
+        self._add_fleet_health(body)
+        return 200, body, {}
+
+    def _get_fleet(self):
+        """``GET /fleet`` — the fleet control plane's view of this node:
+        lease epoch, role, membership with per-replica lag/watermark,
+        the lag-aware routing weights the SDK steers reads by, plus the
+        autoscaler and live-reshard snapshots. Answers on both ports
+        (the SDK re-resolves the primary through ANY reachable member
+        after a failover). 404 without ``serve.fleet_enabled``."""
+        fleet = self.registry.fleet_controller()
+        if fleet is None:
+            err = KetoError("fleet control plane disabled by configuration")
+            err.status_code = 404
+            return 404, err.to_json(), {}
+        body = fleet.snapshot()
+        scaler = self.registry.peek("autoscaler")
+        if scaler is not None:
+            body["autoscaler"] = scaler.snapshot()
+        # instantiating the coordinator is closure wiring, not an engine
+        # build — peek() would hide the state machine until the first
+        # reshard call
+        reshard = self.registry.reshard_coordinator()
+        if reshard is not None:
+            body["reshard"] = reshard.snapshot()
+        return 200, body, {}
+
+    def _add_fleet_health(self, body: dict) -> None:
+        """Fleet coordinates every readiness/SLO answer carries when the
+        control plane runs: the fence epoch this node last observed,
+        whether it is the serving primary, live membership size, and the
+        reshard state machine's position. Probes and the SDK both read
+        these without a second round trip."""
+        fleet = self.registry.peek("fleet")
+        if fleet is None:
+            return
+        snap = fleet.snapshot()
+        reshard = self.registry.peek("reshard")
+        body.update(
+            {
+                "epoch": int(snap.get("epoch", 0)),
+                "is_primary": bool(snap.get("is_primary", False)),
+                "fleet_size": int(snap.get("fleet_size", 0)),
+                "reshard_state": (
+                    reshard.snapshot()["state"] if reshard is not None else "idle"
+                ),
+            }
+        )
 
     # -- snapshot export (replica bootstrap source) ---------------------------
 
@@ -451,6 +504,7 @@ class RestApp:
         if state not in READY_STATES:
             body = {"status": "unavailable", "reason": reason or state.value}
             self._add_replica_health(body)
+            self._add_fleet_health(body)
             # backoff advice rides the 503: probes already poll on their
             # own period, but ad-hoc clients should not hammer a server
             # that just told them its snapshot is stale
@@ -458,6 +512,7 @@ class RestApp:
         if state is HealthState.SERVING:
             body = {"status": "ok"}
             self._add_replica_health(body)
+            self._add_fleet_health(body)
             return 200, body, {}
         body = {"status": state.value}
         if reason:
@@ -468,6 +523,7 @@ class RestApp:
             # instead of leaving probes staring at a bare state
             body.update(monitor.starting_detail())
         self._add_replica_health(body)
+        self._add_fleet_health(body)
         return 200, body, {}
 
     def _add_replica_health(self, body: dict) -> None:

@@ -208,6 +208,30 @@ class ErrReplicaReadOnly(KetoError):
         super().__init__(message, **kw)
 
 
+class ErrFencedEpoch(KetoError):
+    """A write carried a fleet-lease epoch that has been superseded: the
+    serving process was deposed as primary (its ``keto_fleet_lease``
+    epoch is older than the row's) and its in-flight transactions must
+    not commit — REST 409 Conflict / gRPC ABORTED. No split brain: the
+    fence check runs inside the write transaction, so a deposed
+    primary's commit either landed entirely before the new primary's
+    epoch bump (and is covered by the durable-watermark handoff) or is
+    rejected here. Clients re-resolve the current primary from the
+    ``/fleet`` endpoint and retry there (the SDK does this
+    automatically, budget-gated)."""
+
+    status_code = 409
+    grpc_code = 10  # ABORTED
+
+    def __init__(
+        self,
+        message: str = "write fenced: this server's fleet-lease epoch has "
+        "been superseded by a newer primary",
+        **kw,
+    ):
+        super().__init__(message, **kw)
+
+
 class ErrWatchExpired(KetoError):
     """A Watch resume snaptoken predates the store's retained change-log
     horizon — REST 410 Gone / gRPC OUT_OF_RANGE. The subscriber re-lists

@@ -46,6 +46,22 @@ exactly that line. The durable write/maintenance sites are instrumented:
 - ``overlay-apply``   — mid delta-overlay application
 - ``compaction``      — mid overlay compaction
 - ``cache-save``      — mid snapshot-cache serialization
+
+Fleet control-plane sites (keto_tpu/fleet/): the lease/failover/reshard
+seams the fleet chaos suite kills at —
+
+- ``lease-renew``     — the primary's periodic lease renewal, before the
+  renewing UPDATE: a kill here is a primary dying between heartbeats —
+  the lease expires, a replica promotes, and the dead primary's epoch is
+  fenced
+- ``promote-install`` — inside a winning replica's promotion, after the
+  lease CAS acquired the new epoch but before the promoted store is
+  installed: recovery must be exactly-once (the epoch was durably taken;
+  a second contender must NOT also promote at that epoch)
+- ``reshard-handoff`` — between building the new-geometry engine and the
+  atomic install during a live reshard: a kill here must leave the old
+  geometry serving (or a clean restart rebuilding it) with zero wrong
+  answers
 """
 
 from __future__ import annotations
@@ -70,6 +86,9 @@ POINTS = (
     "group-commit",
     "group-ack",
     "overlay-apply",
+    "lease-renew",
+    "promote-install",
+    "reshard-handoff",
 )
 
 #: process-exit hook for kill faults — a module seam so tests can observe

@@ -70,6 +70,7 @@ KNOWN_ROUTES = frozenset(
         "/metrics",
         "/debug/requests",
         "/slo",
+        "/fleet",
         "/health/alive",
         "/health/ready",
     }

@@ -1,0 +1,591 @@
+"""fleet-chaos-smoke: the CI gate on the self-driving serving fleet.
+
+Two fleet-enabled daemons over one sqlite store (real subprocesses via
+tests/chaos_runner.py), then the full self-driving matrix:
+
+1. **Kill/failover cycles** — ``SMOKE_FLEET_KILL_CYCLES`` times (CI runs
+   25): a background writer races the primary's SIGKILL; the survivor
+   must observe the lease expire and PROMOTE within 5 s (``/fleet``
+   ``is_primary``), keyed writes must resume on the promoted node, the
+   fence epoch must strictly increase every cycle (no split brain), and
+   the dead node must reboot as a replica of the NEW primary and catch
+   up. A stale SDK client pointed at the dead address must re-resolve
+   the primary through the fleet endpoint and land its write.
+2. **Acked-write parity** — after all cycles, EVERY write the racing
+   writer got an ack for must be visible both in the CPU reference
+   oracle over the shared sqlite file and over HTTP at its snaptoken.
+   Acked-then-lost is the failure failover is not allowed to have.
+3. **Autoscale grow/shrink** — the real ``Autoscaler`` wired to the real
+   ``ReplicaSpawner``: sustained synthetic burn spawns an actual replica
+   subprocess that catches up and answers correctly; sustained calm
+   drain-retires it (exit 0). One grow, one shrink, no oscillation.
+4. **Live reshard 2→4→2** — a mesh-sharded daemon (8 virtual CPU
+   devices) resplits the graph axis under continuous read traffic;
+   every answer during both transitions must match the oracle: zero
+   mismatches, zero request errors, reshard state machine back to idle.
+5. **Sanitizer** — with ``KETO_TPU_SANITIZE=1`` every cleanly-drained
+   daemon must report zero lock-order inversions / watchdog trips.
+
+Exit 0 when all hold; 1 with the violations listed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+KILL_CYCLES = int(os.environ.get("SMOKE_FLEET_KILL_CYCLES", 3))
+SEED_DOCS = int(os.environ.get("SMOKE_FLEET_DOCS", 8))
+PROMOTE_BUDGET_S = float(os.environ.get("SMOKE_FLEET_PROMOTE_BUDGET_S", 5.0))
+
+
+def log(*a):
+    print("[fleet-smoke]", *a, flush=True)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Proc:
+    """One chaos_runner daemon subprocess."""
+
+    def __init__(self, workdir: Path, args: list, keep_xla: bool = False):
+        self.port_file = workdir / f"ports-{os.urandom(4).hex()}.json"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("KETO_TPU_FAULTS", None)
+        if keep_xla:
+            # the mesh-sharded daemon needs >1 XLA device; everything
+            # else boots single-device for speed
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+        else:
+            env.pop("XLA_FLAGS", None)
+        self.sanitize_report = None
+        if env.get("KETO_TPU_SANITIZE") == "1":
+            self.sanitize_report = workdir / f"lockwatch-{os.urandom(4).hex()}.json"
+            env["KETO_TPU_SANITIZE_REPORT"] = str(self.sanitize_report)
+        self.log_path = workdir / f"daemon-{os.urandom(4).hex()}.log"
+        self._log = open(self.log_path, "wb")
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, str(ROOT / "tests" / "chaos_runner.py"),
+                "--port-file", str(self.port_file),
+                *args,
+            ],
+            cwd=ROOT,
+            env=env,
+            stdout=self._log,
+            stderr=self._log,
+        )
+        self.ports = None
+
+    def wait_ports(self, timeout=180.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.port_file.is_file():
+                try:
+                    self.ports = json.loads(self.port_file.read_text())
+                    return self.ports
+                except json.JSONDecodeError:
+                    pass
+            if self.proc.poll() is not None:
+                raise AssertionError(
+                    f"daemon died at boot: {self.log_path.read_bytes()[-2000:]!r}"
+                )
+            time.sleep(0.05)
+        raise AssertionError("daemon never published ports")
+
+    def sigkill(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=15)
+
+    def sigterm(self, timeout=30.0) -> int:
+        self.proc.send_signal(signal.SIGTERM)
+        return self.proc.wait(timeout=timeout)
+
+    def sanitize_violations(self):
+        if self.sanitize_report is None or not self.sanitize_report.is_file():
+            return []
+        report = json.loads(self.sanitize_report.read_text())
+        return list(report.get("inversions", [])) + list(
+            report.get("watchdog_trips", [])
+        )
+
+
+def http_json(url, timeout=20):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+def check(port, obj, sub, token=None, timeout=20):
+    q = (
+        f"http://127.0.0.1:{port}/check?namespace=docs&object={obj}"
+        f"&relation=view&subject_id={sub}"
+    )
+    if token is not None:
+        q += f"&snaptoken={token}"
+    try:
+        body, headers = http_json(q, timeout=timeout)
+        return bool(body["allowed"]), headers
+    except urllib.error.HTTPError as e:
+        if e.code == 403:
+            return False, dict(e.headers)
+        raise
+
+
+def fleet_view(port, timeout=10):
+    body, _ = http_json(f"http://127.0.0.1:{port}/fleet", timeout=timeout)
+    return body
+
+
+def wait_caught_up(port, wm, timeout=120.0, what="replica catch-up"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            body, _ = http_json(f"http://127.0.0.1:{port}/health/ready")
+            if int(body.get("watermark", -1)) >= wm:
+                return
+        except Exception:  # keto-analyze: ignore[KTA401] readiness poll: a booting daemon refuses connections until it doesn't; the deadline turns persistent failure into the assertion below
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what} (wm {wm})")
+
+
+def wait_promoted(port, deadline_s=60.0):
+    """Seconds until the node at ``port`` reports itself primary."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            body = fleet_view(port, timeout=5)
+            if body.get("is_primary"):
+                return time.monotonic() - t0, body
+        except Exception:  # keto-analyze: ignore[KTA401] promotion poll: the survivor keeps serving but a single scrape may race its own tick; the deadline converts persistent failure into the assertion below
+            pass
+        time.sleep(0.05)
+    raise AssertionError("survivor never promoted")
+
+
+def main() -> int:
+    problems: list[str] = []
+    tmp = Path(tempfile.mkdtemp(prefix="keto-fleet-smoke-"))
+    dbfile = tmp / "fleet.db"
+
+    from keto_tpu.httpclient import KetoClient
+    from keto_tpu.relationtuple.model import RelationTuple, SubjectID, SubjectSet
+
+    def T(obj, sub, ns="docs", rel="view"):
+        subject = sub if not isinstance(sub, str) else SubjectID(sub)
+        return RelationTuple(namespace=ns, object=obj, relation=rel, subject=subject)
+
+    # two node slots with pinned ports: a restarted node comes back at
+    # the SAME address, so fleet membership and SDK targets stay stable
+    nodes = []
+    for i in range(2):
+        cache = tmp / f"n{i}-cache"
+        cache.mkdir()
+        nodes.append(
+            {
+                "id": f"n{i}",
+                "read": free_port(),
+                "write": free_port(),
+                "cache": cache,
+                "replica_dir": tmp / f"n{i}-replica",
+            }
+        )
+
+    def node_args(i: int, role: str, primary_idx: int) -> list:
+        n = nodes[i]
+        args = [
+            "--dsn", f"sqlite://{dbfile}",
+            "--cache-dir", str(n["cache"]),
+            "--read-port", str(n["read"]),
+            "--write-port", str(n["write"]),
+            "--fleet-enabled",
+            "--node-id", n["id"],
+            "--advertise-url", f"http://127.0.0.1:{n['write']}",
+            "--fleet-lease-ttl-s", "1.0",
+            "--fleet-heartbeat-s", "0.2",
+            "--fleet-promotion-grace-s", "0.3",
+        ]
+        if role == "replica":
+            args += [
+                "--role", "replica",
+                "--primary-url", f"http://127.0.0.1:{nodes[primary_idx]['read']}",
+                "--replica-dir", str(n["replica_dir"]),
+            ]
+        return args
+
+    procs: list[Proc] = []
+    acked: list = []  # (obj, sub, snaptoken) for every write the SDK acked
+
+    try:
+        # ---- phase 1: kill/failover cycles --------------------------------
+        log(f"booting fleet: n0 primary + n1 replica ({KILL_CYCLES} kill cycles)")
+        live = [Proc(tmp, node_args(0, "primary", 0)), None]
+        procs.append(live[0])
+        live[0].wait_ports()
+        primary_idx = 0
+
+        seed_client = KetoClient(
+            f"http://127.0.0.1:{nodes[0]['read']}",
+            f"http://127.0.0.1:{nodes[0]['write']}",
+            timeout=30.0, retry_max_wait_s=4.0,
+        )
+        seed_client.patch_relation_tuples(
+            insert=[T("g0", "ann", ns="groups", rel="member")]
+        )
+        seed = [T(f"o{i}", SubjectSet("groups", "g0", "member")) for i in range(SEED_DOCS)]
+        seed += [T(f"o{i}", f"u{i}") for i in range(SEED_DOCS)]
+        res = seed_client.patch_relation_tuples(insert=seed)
+        for i in range(SEED_DOCS):
+            acked.append((f"o{i}", "ann", res.snaptoken))
+            acked.append((f"o{i}", f"u{i}", res.snaptoken))
+
+        live[1] = Proc(tmp, node_args(1, "replica", 0))
+        procs.append(live[1])
+        live[1].wait_ports()
+        wait_caught_up(nodes[1]["read"], res.snaptoken, what="initial replica catch-up")
+
+        last_epoch = int(fleet_view(nodes[0]["read"])["epoch"])
+        promote_times: list[float] = []
+
+        for cycle in range(KILL_CYCLES):
+            p, s = primary_idx, 1 - primary_idx
+            # a writer races the kill: only ACKED writes join the parity set
+            stop = threading.Event()
+
+            def writer(cyc=cycle, pi=p):
+                c = KetoClient(
+                    f"http://127.0.0.1:{nodes[pi]['read']}",
+                    f"http://127.0.0.1:{nodes[pi]['write']}",
+                    timeout=10.0, retry_max_wait_s=0.0,
+                )
+                i = 0
+                while not stop.is_set() and i < 200:
+                    obj, sub = f"c{cyc}w{i}", f"cu{cyc}-{i}"
+                    try:
+                        r = c.patch_relation_tuples(
+                            insert=[T(obj, sub)],
+                            idempotency_key=f"fleet-{cyc}-{i}",
+                        )
+                        acked.append((obj, sub, r.snaptoken))
+                    except Exception:  # keto-analyze: ignore[KTA401] the writer races the primary's SIGKILL by design; unacked writes are the scenario, not a finding
+                        pass
+                    i += 1
+                    time.sleep(0.005)
+
+            wt = threading.Thread(target=writer, daemon=True)
+            wt.start()
+            time.sleep(0.3)
+            live[p].sigkill()
+            t_kill = time.monotonic()
+            stop.set()
+            wt.join(timeout=20)
+
+            took, view = wait_promoted(nodes[s]["read"])
+            promote_times.append(took)
+            if took > PROMOTE_BUDGET_S:
+                problems.append(
+                    f"cycle {cycle}: promotion took {took:.2f}s "
+                    f"(budget {PROMOTE_BUDGET_S}s)"
+                )
+            epoch = int(view["epoch"])
+            if epoch <= last_epoch:
+                problems.append(
+                    f"cycle {cycle}: fence epoch did not advance "
+                    f"({last_epoch} -> {epoch})"
+                )
+            last_epoch = epoch
+
+            # keyed writes must resume on the promoted node
+            nc = KetoClient(
+                f"http://127.0.0.1:{nodes[s]['read']}",
+                f"http://127.0.0.1:{nodes[s]['write']}",
+                timeout=10.0, retry_max_wait_s=0.0,
+            )
+            resumed = None
+            for attempt in range(100):
+                try:
+                    r = nc.patch_relation_tuples(
+                        insert=[T(f"resume{cycle}", f"ru{cycle}")],
+                        idempotency_key=f"resume-{cycle}",
+                    )
+                    acked.append((f"resume{cycle}", f"ru{cycle}", r.snaptoken))
+                    resumed = time.monotonic() - t_kill
+                    break
+                except Exception:  # keto-analyze: ignore[KTA401] resume probe: refusals while the handoff installs are the thing being timed; the post-loop assertion is the gate
+                    time.sleep(0.1)
+            if resumed is None:
+                problems.append(f"cycle {cycle}: writes never resumed after failover")
+                return 1
+            if resumed > 10.0:
+                problems.append(
+                    f"cycle {cycle}: writes resumed only after {resumed:.2f}s"
+                )
+
+            if cycle == 0:
+                # a stale SDK still pointed at the dead address must
+                # re-resolve the primary through the fleet endpoint
+                stale = KetoClient(
+                    f"http://127.0.0.1:{nodes[p]['read']}",
+                    f"http://127.0.0.1:{nodes[p]['write']}",
+                    timeout=10.0, retry_max_wait_s=4.0,
+                    fleet_url=f"http://127.0.0.1:{nodes[s]['read']}",
+                )
+                r = stale.patch_relation_tuples(
+                    insert=[T("stale0", "su0")], idempotency_key="stale-0"
+                )
+                acked.append(("stale0", "su0", r.snaptoken))
+                if stale.primary_reresolves != 1:
+                    problems.append(
+                        "stale client did not re-resolve the promoted primary "
+                        f"(reresolves={stale.primary_reresolves})"
+                    )
+                log(f"stale client re-resolved to {stale.write_url}")
+
+            # the dead node reboots as a replica of the NEW primary and
+            # must catch up through its snapshot/watch surfaces
+            live[p] = Proc(tmp, node_args(p, "replica", s))
+            procs.append(live[p])
+            live[p].wait_ports()
+            wait_caught_up(
+                nodes[p]["read"], max(t for _, _, t in acked),
+                what=f"cycle {cycle} reboot catch-up",
+            )
+            primary_idx = s
+            log(
+                f"cycle {cycle}: promoted in {took:.2f}s (epoch {epoch}), "
+                f"writes resumed in {resumed:.2f}s, dead node rejoined"
+            )
+
+        # ---- phase 2: acked-write parity vs the CPU oracle ----------------
+        from keto_tpu import namespace as namespace_pkg
+        from keto_tpu.check.engine import CheckEngine
+        from keto_tpu.persistence.sqlite import SQLitePersister
+        from tests.chaos_runner import NAMESPACES
+
+        nm = namespace_pkg.MemoryManager(
+            [namespace_pkg.Namespace(id=n["id"], name=n["name"]) for n in NAMESPACES]
+        )
+        oracle = CheckEngine(SQLitePersister(f"sqlite://{dbfile}", nm))
+        lost = 0
+        for obj, sub, _ in acked:
+            if not oracle.subject_is_allowed(T(obj, sub)):
+                lost += 1
+                problems.append(f"ACKED WRITE LOST: {obj}@{sub} absent from the store")
+        p_read = nodes[primary_idx]["read"]
+        final_token = max(t for _, _, t in acked)
+        for obj, sub, tok in acked[:: max(1, len(acked) // 50)]:
+            got, _ = check(p_read, obj, sub, tok)
+            if not got:
+                problems.append(f"acked write {obj}@{sub} not visible over HTTP @ {tok}")
+        got, _ = check(p_read, "o0", "ann", final_token)
+        if not got:
+            problems.append("transitive group grant lost across failovers")
+        log(
+            f"parity: {len(acked)} acked writes checked, {lost} lost; "
+            f"promotions took {', '.join(f'{t:.2f}s' for t in promote_times)}"
+        )
+
+        # ---- phase 3: autoscale grow/shrink with the real spawner ---------
+        from keto_tpu.fleet.autoscale import Autoscaler
+        from keto_tpu.fleet.spawner import ReplicaSpawner
+
+        scale_dir = tmp / "autoscale"
+        scale_dir.mkdir()
+
+        def replica_argv(idx: int, port_file: Path) -> list:
+            rcache = scale_dir / f"cache-{idx}"
+            rcache.mkdir(exist_ok=True)
+            return [
+                sys.executable, str(ROOT / "tests" / "chaos_runner.py"),
+                "--port-file", str(port_file),
+                "--dsn", "memory",  # ignored: replicas hold no store
+                "--cache-dir", str(rcache),
+                "--role", "replica",
+                "--primary-url", f"http://127.0.0.1:{p_read}",
+                "--replica-dir", str(scale_dir / f"replica-{idx}"),
+            ]
+
+        spawn_env = dict(os.environ)
+        spawn_env["JAX_PLATFORMS"] = "cpu"
+        spawn_env.pop("XLA_FLAGS", None)
+        spawn_env.pop("KETO_TPU_FAULTS", None)
+        spawner = ReplicaSpawner(replica_argv, str(scale_dir), env=spawn_env)
+        signals = {"availability_burn_rate": 3.0}
+        scaler = Autoscaler(
+            lambda: signals, spawner=spawner,
+            min_replicas=0, max_replicas=1,
+            sustain_s=0.3, cooldown_s=0.3, quiet_s=0.6,
+        )
+        # synthetic clock: burn sustained past sustain_s -> grow
+        decisions = [scaler.step(now=0.0), scaler.step(now=0.4)]
+        if decisions != ["hold", "grow"] or spawner.count() != 1:
+            problems.append(f"autoscale grow did not fire: {decisions}")
+        child = spawner.children[0]
+        if child.wait_ports() is None:
+            problems.append("autoscaled replica died at boot")
+        else:
+            wait_caught_up(
+                child.ports["read"], final_token, what="autoscaled replica catch-up"
+            )
+            got, _ = check(child.ports["read"], "o0", "ann", final_token)
+            if not got:
+                problems.append("autoscaled replica answered wrong")
+            log(f"autoscale grew a live replica (pid {child.pid}); shrinking")
+        # calm sustained past quiet_s -> shrink (drain-retire, exit 0)
+        signals = {"availability_burn_rate": 0.0}
+        scaler.step(now=0.8)
+        if scaler.step(now=1.5) != "shrink" or spawner.count() != 0:
+            problems.append("autoscale shrink did not retire the replica")
+        if child.alive():
+            problems.append("retired replica still running after drain grace")
+        if (spawner.spawned_total, spawner.retired_total) != (1, 1):
+            problems.append(
+                f"autoscale oscillated: spawned={spawner.spawned_total} "
+                f"retired={spawner.retired_total}"
+            )
+
+        # fleet-cycle daemons are done: drain the survivors cleanly
+        for idx in (primary_idx, 1 - primary_idx):
+            if live[idx].sigterm() != 0:
+                problems.append(f"node n{idx} SIGTERM drain exited nonzero")
+
+        # ---- phase 4: live reshard 2 -> 4 -> 2 under traffic --------------
+        log("booting mesh-sharded daemon (2 graph shards) for live reshard")
+        rs_tmp = tmp / "reshard"
+        rs_cache = rs_tmp / "cache"
+        rs_cache.mkdir(parents=True)
+        rs_db = rs_tmp / "reshard.db"
+        rs_read, rs_write = free_port(), free_port()
+        rs = Proc(
+            rs_tmp,
+            [
+                "--dsn", f"sqlite://{rs_db}",
+                "--cache-dir", str(rs_cache),
+                "--read-port", str(rs_read),
+                "--write-port", str(rs_write),
+                "--fleet-enabled",
+                "--node-id", "rs0",
+                "--advertise-url", f"http://127.0.0.1:{rs_write}",
+                "--mesh-graph", "2",
+                "--reshard-to", "4,2",
+                "--reshard-delay-s", "2.0",
+            ],
+            keep_xla=True,
+        )
+        procs.append(rs)
+        rs.wait_ports()
+        rs_client = KetoClient(
+            f"http://127.0.0.1:{rs_read}", f"http://127.0.0.1:{rs_write}",
+            timeout=60.0, retry_max_wait_s=4.0,
+        )
+        rs_client.patch_relation_tuples(
+            insert=[T("g0", "ann", ns="groups", rel="member")]
+        )
+        rs_seed = [T(f"o{i}", SubjectSet("groups", "g0", "member")) for i in range(SEED_DOCS)]
+        rs_seed += [T(f"o{i}", f"u{i}") for i in range(SEED_DOCS)]
+        rs_client.patch_relation_tuples(insert=rs_seed)
+        probes = [(f"o{i}", "ann", True) for i in range(SEED_DOCS)]
+        probes += [(f"o{i}", f"u{i}", True) for i in range(SEED_DOCS)]
+        probes += [("o0", "nobody", False), ("missing", "ann", False)]
+
+        mismatches = 0
+        sweeps = 0
+        deadline = time.monotonic() + 420.0
+        while time.monotonic() < deadline:
+            for obj, sub, want in probes:
+                try:
+                    got, _ = check(rs_read, obj, sub, timeout=60)
+                except Exception as e:
+                    mismatches += 1
+                    if mismatches <= 5:
+                        problems.append(f"reshard traffic error on {obj}@{sub}: {e}")
+                    continue
+                if got != want:
+                    mismatches += 1
+                    if mismatches <= 5:
+                        problems.append(
+                            f"WRONG ANSWER during reshard: {obj}@{sub} "
+                            f"got={got} want={want}"
+                        )
+            sweeps += 1
+            snap = fleet_view(rs_read).get("reshard", {})
+            if int(snap.get("reshards_total", 0)) >= 2 and snap.get("state") == "idle":
+                break
+            time.sleep(0.05)
+        snap = fleet_view(rs_read).get("reshard", {})
+        if int(snap.get("reshards_total", 0)) != 2:
+            problems.append(f"expected 2 reshards, saw {snap.get('reshards_total')}")
+        if int(snap.get("current_shards", 0)) != 2:
+            problems.append(
+                f"geometry did not return to 2 shards: {snap.get('current_shards')}"
+            )
+        if int(snap.get("failures", 0)) != 0:
+            problems.append(f"reshard failures: {snap.get('failures')}")
+        ready, _ = http_json(f"http://127.0.0.1:{rs_read}/health/ready")
+        if ready.get("reshard_state") != "idle":
+            problems.append(f"reshard state stuck at {ready.get('reshard_state')}")
+        if mismatches:
+            problems.append(
+                f"{mismatches} wrong/failed answers across {sweeps} reshard sweeps"
+            )
+        log(
+            f"reshard 2->4->2 done: {sweeps} traffic sweeps "
+            f"({len(probes)} probes each), {mismatches} mismatches"
+        )
+        if rs.sigterm() != 0:
+            problems.append("reshard daemon SIGTERM drain exited nonzero")
+
+        # ---- phase 5: sanitizer audit -------------------------------------
+        for p in procs:
+            v = p.sanitize_violations()
+            if v:
+                problems.append(f"sanitizer violations: {v}")
+    finally:
+        for p in procs:
+            try:
+                p.sigkill()
+            except Exception:  # keto-analyze: ignore[KTA401] teardown best-effort: a daemon that already exited (the point of the smoke) makes kill a no-op race
+                pass
+
+    if problems:
+        log("FAILED:")
+        for p in problems:
+            log("  -", p)
+        return 1
+    log(
+        f"OK: {KILL_CYCLES} kill/failover cycles (promotion < {PROMOTE_BUDGET_S}s, "
+        "epochs monotone, acked-write parity), SDK re-resolution, autoscale "
+        "grow/shrink, live reshard 2->4->2 with zero mismatches, clean drains"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,23 @@ def main() -> int:
     # address its replicas were configured with (0 = ephemeral)
     ap.add_argument("--read-port", type=int, default=0)
     ap.add_argument("--write-port", type=int, default=0)
+    # fleet control plane (keto_tpu/fleet/): lease-based election
+    # through the shared SQL store — a replica with --fleet-enabled
+    # contends for the primary lease when it expires and PROMOTES
+    # in-process (tests/test_fleet.py, scripts/fleet_smoke.py)
+    ap.add_argument("--fleet-enabled", action="store_true")
+    ap.add_argument("--node-id", default="")
+    ap.add_argument("--advertise-url", default="")
+    ap.add_argument("--fleet-lease-ttl-s", type=float, default=2.0)
+    ap.add_argument("--fleet-heartbeat-s", type=float, default=0.5)
+    ap.add_argument("--fleet-promotion-grace-s", type=float, default=0.5)
+    # live reshard: --reshard-delay-s after boot (and between steps),
+    # rebuild the permission engine at each comma-separated --reshard-to
+    # target in turn and install it under traffic; --mesh-graph pins the
+    # STARTING geometry (0 = single device)
+    ap.add_argument("--reshard-to", default="")
+    ap.add_argument("--reshard-delay-s", type=float, default=2.0)
+    ap.add_argument("--mesh-graph", type=int, default=0)
     # flight recorder (keto_tpu/x/flightrec.py): with a bundle dir the
     # daemon dumps anomaly bundles (scripts/flightrec_smoke.py drives it)
     ap.add_argument("--debug-bundle-dir", default="")
@@ -62,6 +79,11 @@ def main() -> int:
     # the faults are live so the parent can sequence its traffic
     ap.add_argument("--arm-after-ready", default="")
     ap.add_argument("--armed-file", default="")
+    # parent-sequenced arming: the fault spec loads only once the parent
+    # creates --arm-on-file (the fleet failover test boots a primary,
+    # waits for its replica to catch up, THEN pulls the trigger)
+    ap.add_argument("--arm-on-file", default="")
+    ap.add_argument("--arm-on-file-spec", default="")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -92,6 +114,19 @@ def main() -> int:
                 "serve.watch_poll_ms": 20,
             }
         )
+    if args.fleet_enabled:
+        overrides.update(
+            {
+                "serve.fleet_enabled": True,
+                "serve.fleet_node_id": args.node_id,
+                "serve.fleet_advertise_url": args.advertise_url,
+                "serve.fleet_lease_ttl_s": args.fleet_lease_ttl_s,
+                "serve.fleet_heartbeat_s": args.fleet_heartbeat_s,
+                "serve.fleet_promotion_grace_s": args.fleet_promotion_grace_s,
+            }
+        )
+    if args.mesh_graph > 0:
+        overrides["serve.mesh_graph"] = args.mesh_graph
     if args.debug_bundle_dir:
         overrides.update(
             {
@@ -127,6 +162,39 @@ def main() -> int:
                 Path(args.armed_file).touch()
 
         threading.Thread(target=arm, name="chaos-arm", daemon=True).start()
+
+    if args.arm_on_file and args.arm_on_file_spec:
+        import threading
+        import time as _time
+
+        def arm_on_file():
+            from keto_tpu.x import faults
+
+            trigger = Path(args.arm_on_file)
+            while not trigger.is_file():
+                _time.sleep(0.05)
+            faults.load_env(args.arm_on_file_spec)
+
+        threading.Thread(
+            target=arm_on_file, name="chaos-arm-on-file", daemon=True
+        ).start()
+
+    reshard_targets = [int(t) for t in args.reshard_to.split(",") if t.strip()]
+    if reshard_targets:
+        import threading
+        import time as _time
+
+        def reshard():
+            for target in reshard_targets:
+                _time.sleep(args.reshard_delay_s)
+                try:
+                    daemon.registry.reshard_coordinator().reshard(target)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+
+        threading.Thread(target=reshard, name="chaos-reshard", daemon=True).start()
 
     ports = {"read": daemon.read_port, "write": daemon.write_port, "pid": os.getpid()}
     # atomic publish: the parent polls this file and must never read a

@@ -57,6 +57,17 @@ CRASH_POINTS = [
     "device-alloc",      # mid device upload (the HBM governor's OOM seam)
 ]
 
+#: fleet control-plane kill points (keto_tpu/fleet/). ``lease-renew``
+#: gets a real os._exit death in test_fleet_failover_chaos below;
+#: ``promote-install`` and ``reshard-handoff`` are crash-windowed
+#: in-process in tests/test_fleet.py and real-death at scale in
+#: scripts/fleet_smoke.py (the fleet-chaos-smoke CI job)
+FLEET_CRASH_POINTS = [
+    "lease-renew",       # primary dies between heartbeats → failover
+    "promote-install",   # epoch taken, store not installed → exactly-once
+    "reshard-handoff",   # new geometry built, not installed → old serves
+]
+
 CYCLES = int(os.environ.get("KETO_CHAOS_CYCLES", len(CRASH_POINTS)))
 SEED = int(os.environ.get("KETO_CHAOS_SEED", "0"))
 WRITES_PER_CYCLE = 24
@@ -387,3 +398,174 @@ def test_chaos_kill_and_recover(tmp_path):
     # at least the transact-ack cycles must have produced real replays
     if CYCLES >= len(CRASH_POINTS):
         assert replays_seen >= 1, "no ambiguous retry ever replayed — dedup untested"
+
+
+# -- fleet failover: a real primary death, a real promotion -------------------
+
+
+def test_fleet_failover_chaos(tmp_path):
+    """One full lease-based failover with a REAL death: a fleet-enabled
+    primary dies via the ``lease-renew`` kill point (os._exit at the
+    renewal site — SIGKILL landing between heartbeats), and its caught-up
+    replica promotes itself through the shared sqlite lease:
+
+    - the replica's epoch advances past the dead primary's, EXACTLY one
+      promotion happens, and writes resume on the promoted node fast;
+    - every write the dead primary acknowledged is visible at its
+      snaptoken on the promoted node (durable-watermark handoff);
+    - the SDK follows the failover: a client still pointed at the dead
+      primary's write url re-resolves the new primary from ``/fleet``;
+    - the promoted daemon drains cleanly (exit 0)."""
+    import socket
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    dbfile = tmp_path / "fleet.db"
+    pcache, rcache = tmp_path / "p-cache", tmp_path / "r-cache"
+    rdir = tmp_path / "replica-durable"
+    for d in (pcache, rcache, rdir):
+        d.mkdir()
+    p_read, p_write = free_port(), free_port()
+    r_read, r_write = free_port(), free_port()
+    trigger = tmp_path / "kill-trigger"
+    fleet_args = (
+        "--fleet-enabled",
+        "--fleet-lease-ttl-s", "1.0",
+        "--fleet-heartbeat-s", "0.2",
+        "--fleet-promotion-grace-s", "0.3",
+    )
+
+    primary = DaemonProc(
+        dbfile, pcache, tmp_path,
+        extra_args=(
+            "--read-port", str(p_read), "--write-port", str(p_write),
+            "--node-id", "p0",
+            "--advertise-url", f"http://127.0.0.1:{p_write}",
+            *fleet_args,
+            # armed only when the parent pulls the trigger: a real
+            # os._exit at the lease-renewal site, no drain, no flush
+            "--arm-on-file", str(trigger),
+            "--arm-on-file-spec", "lease-renew:kill:1",
+        ),
+    )
+    procs = [primary]
+    try:
+        assert primary.wait_ports() and primary.wait_alive()
+        pclient = primary.client(retry_max_wait_s=4.0)
+        seed = pclient.patch_relation_tuples(
+            insert=[T(f"seed{i}", f"u{i}") for i in range(6)],
+            idempotency_key="fleet-seed",
+        )
+
+        replica = DaemonProc(
+            dbfile, rcache, tmp_path,
+            extra_args=(
+                "--read-port", str(r_read), "--write-port", str(r_write),
+                "--role", "replica",
+                "--primary-url", f"http://127.0.0.1:{p_read}",
+                "--replica-dir", str(rdir),
+                "--node-id", "r0",
+                "--advertise-url", f"http://127.0.0.1:{r_write}",
+                *fleet_args,
+            ),
+        )
+        procs.append(replica)
+        assert replica.wait_ports() and replica.wait_alive()
+
+        def get_json(port, path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/{path.lstrip('/')}", timeout=5
+            ) as resp:
+                return json.loads(resp.read())
+
+        # acked writes the promoted node must still serve afterwards
+        acked = []
+        for i in range(8):
+            t = T(f"pre{i}", f"u{i}")
+            resp = pclient.patch_relation_tuples(
+                [t], idempotency_key=f"fleet-pre{i}"
+            )
+            acked.append((t, resp.snaptoken))
+        final_token = max(tok for _, tok in acked)
+
+        # replica fully caught up (its 412 gate passes at the newest ack)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            body = get_json(r_read, "/health/ready")
+            if int(body.get("watermark", -1)) >= final_token:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("replica never caught up")
+        assert seed.snaptoken is not None
+
+        # pull the trigger: the primary's next renewal pass is a death
+        trigger.touch()
+        primary.proc.wait(timeout=30)
+        assert primary.proc.returncode == 137, primary.log_tail()
+        died_at = time.monotonic()
+
+        # the replica promotes and WRITES RESUME on its write port
+        promoted_client = KetoClient(
+            f"http://127.0.0.1:{r_read}", f"http://127.0.0.1:{r_write}",
+            timeout=20.0, retry_max_wait_s=0.0,
+        )
+        resumed = None
+        post = T("post0", "u0")
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                resp = promoted_client.patch_relation_tuples(
+                    [post], idempotency_key="fleet-post0"
+                )
+                resumed = time.monotonic() - died_at
+                break
+            except Exception:
+                time.sleep(0.1)
+        assert resumed is not None, replica.log_tail()
+        # lease TTL (1 s) + grace + a couple heartbeats; generous slack
+        # for CI — the 25-cycle smoke holds the < 5 s line
+        assert resumed < 10.0, f"writes took {resumed:.1f}s to resume"
+        assert resp.snaptoken is not None
+
+        # exactly-once promotion, epoch advanced past the dead primary's
+        fleet = get_json(r_read, "/fleet")
+        assert fleet["is_primary"] and fleet["epoch"] >= 2
+        assert fleet["promotions"] == 1, fleet
+        assert sum(fleet["promotions_by_reason"].values()) == 1
+        ready = get_json(r_read, "/health/ready")
+        assert ready["is_primary"] and ready["epoch"] == fleet["epoch"]
+
+        # durable-watermark handoff: every acked write is visible at its
+        # snaptoken on the promoted node
+        for t, tok in acked:
+            assert promoted_client.check(t, snaptoken=tok), (t, tok)
+
+        # watermark monotone across the failover
+        assert read_watermark(dbfile) >= final_token
+
+        # the SDK follows the failover: still pointed at the DEAD
+        # primary, it re-resolves the promoted node from /fleet
+        stale = KetoClient(
+            f"http://127.0.0.1:{r_read}",       # reads already moved
+            f"http://127.0.0.1:{p_write}",      # writes still at the corpse
+            timeout=20.0, retry_max_wait_s=0.0,
+        )
+        resp = stale.patch_relation_tuples(
+            [T("post1", "u1")], idempotency_key="fleet-post1"
+        )
+        assert resp.snaptoken is not None
+        assert stale.write_url == f"http://127.0.0.1:{r_write}"
+        assert stale.primary_reresolves == 1
+
+        # the promoted daemon still drains cleanly
+        code = replica.terminate_gracefully()
+        assert code == 0, replica.log_tail()
+    finally:
+        for p in procs:
+            p.kill()

@@ -1,0 +1,790 @@
+"""Fleet control plane (keto_tpu/fleet/): election, fencing, autoscale,
+reshard, and the SDK's fleet awareness.
+
+Covers the failure matrix the fleet design document promises:
+
+- **lease CAS** — N threads race ``fleet_lease_acquire`` over one sqlite
+  file and exactly one wins each epoch; renewal extends without bumping;
+  expiry hands the next epoch to exactly one new holder;
+- **fencing** — a deposed primary's store (fence epoch behind the lease
+  epoch) aborts every write with ErrFencedEpoch and bumps nothing — no
+  split brain, on both the sqlite and memory persisters;
+- **controller** — the election state machine on a synthetic clock:
+  boot acquisition, renewal, replica promotion on expiry, exactly-once
+  promotion under contention (the most-caught-up replica wins), the
+  ``promote-install`` crash window recovering via install-retry at the
+  SAME epoch, and a deposed primary never contending again;
+- **autoscaler** — the hysteresis core replayed on synthetic timelines:
+  a spike shorter than ``sustain_s`` never grows, the dead band resets
+  both directions, cooldown spaces actions, calm must hold ``quiet_s``
+  before shrinking, HBM pressure vetoes shrink, and a 10× swell ramps
+  up and back down without oscillation;
+- **reshard** — the state machine over stubbed build/install: success,
+  build failure (old geometry keeps serving), the ``reshard-handoff``
+  crash window, overlap rejection, and no-op targets;
+- **SDK** — 409 → ErrFencedEpoch, lag-aware weighted replica routing
+  (an over-budget replica drains), ``refresh_fleet``, and the
+  promoted-mid-write regression: a write bounced by a 403/409/refused
+  connection re-resolves the new primary from ``/fleet`` and lands.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from keto_tpu import namespace as namespace_pkg
+from keto_tpu.fleet.autoscale import Autoscaler
+from keto_tpu.fleet.controller import FleetController
+from keto_tpu.fleet.lease import promotion_rank, route_weight, route_weights
+from keto_tpu.fleet.reshard import ReshardCoordinator
+from keto_tpu.httpclient import KetoClient
+from keto_tpu.persistence.memory import MemoryPersister
+from keto_tpu.relationtuple.model import RelationTuple, SubjectID
+from keto_tpu.x import faults
+from keto_tpu.x.errors import ErrFencedEpoch
+
+NAMESPACES = [
+    namespace_pkg.Namespace(id=0, name="docs"),
+    namespace_pkg.Namespace(id=1, name="groups"),
+]
+
+
+def nm():
+    return namespace_pkg.MemoryManager(NAMESPACES)
+
+
+def T(obj, sub):
+    return RelationTuple(
+        namespace="docs", object=obj, relation="view", subject=SubjectID(sub)
+    )
+
+
+def sqlite_store(tmp_path, name="fleet.db"):
+    from keto_tpu.persistence.sqlite import SQLitePersister
+
+    return SQLitePersister(f"sqlite://{tmp_path / name}", nm())
+
+
+# -- lease CAS ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["sqlite", "memory"])
+def test_lease_acquire_exactly_one_winner(tmp_path, kind):
+    store = sqlite_store(tmp_path) if kind == "sqlite" else MemoryPersister(nm())
+    try:
+        results: dict[str, int] = {}
+        barrier = threading.Barrier(8)
+
+        def contend(node):
+            barrier.wait()
+            got = store.fleet_lease_acquire(node, ttl_s=30.0)
+            if got is not None:
+                results[node] = got
+
+        threads = [
+            threading.Thread(target=contend, args=(f"n{i}",)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # exactly one contender won, at epoch 1
+        assert list(results.values()) == [1], results
+        lease = store.fleet_lease()
+        assert lease["holder"] in results and lease["epoch"] == 1
+    finally:
+        if hasattr(store, "close"):
+            store.close()
+
+
+def test_lease_renew_extends_and_expiry_moves_epoch(tmp_path):
+    store = sqlite_store(tmp_path)
+    try:
+        t0 = 1000.0
+        assert store.fleet_lease_acquire("a", ttl_s=2.0, now=t0) == 1
+        # a standing lease refuses other holders
+        assert store.fleet_lease_acquire("b", ttl_s=2.0, now=t0 + 1.0) is None
+        # renewal extends WITHOUT bumping the epoch
+        assert store.fleet_lease_renew("a", 1, ttl_s=2.0, now=t0 + 1.5)
+        assert store.fleet_lease()["epoch"] == 1
+        # wrong holder / wrong epoch renewals fail
+        assert not store.fleet_lease_renew("b", 1, ttl_s=2.0, now=t0 + 1.5)
+        assert not store.fleet_lease_renew("a", 2, ttl_s=2.0, now=t0 + 1.5)
+        # past expiry the next acquire mints epoch 2 for the usurper
+        assert store.fleet_lease_acquire("b", ttl_s=2.0, now=t0 + 10.0) == 2
+        # ... and the deposed holder's renewal at its old epoch fails
+        assert not store.fleet_lease_renew("a", 1, ttl_s=2.0, now=t0 + 10.5)
+    finally:
+        if hasattr(store, "close"):
+            store.close()
+
+
+# -- fencing ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["sqlite", "memory"])
+def test_fenced_epoch_aborts_deposed_writes(tmp_path, kind):
+    store = sqlite_store(tmp_path) if kind == "sqlite" else MemoryPersister(nm())
+    try:
+        assert store.fleet_lease_acquire("old", ttl_s=0.0, now=0.0) == 1
+        store.fence_epoch = 1
+        res = store.transact_relation_tuples([T("a", "u1")], [])
+        wm = store.watermark()
+        assert wm == res.snaptoken
+        # a replica takes epoch 2; the old primary's fence stays at 1
+        assert store.fleet_lease_acquire("new", ttl_s=30.0, now=1.0) == 2
+        with pytest.raises(ErrFencedEpoch) as ei:
+            store.transact_relation_tuples([T("b", "u2")], [])
+        assert ei.value.status_code == 409
+        assert store.fenced_writes == 1
+        # nothing moved: no half-applied write, watermark untouched
+        assert store.watermark() == wm
+        # the promoted holder's own store (fence at the NEW epoch) writes
+        store.fence_epoch = 2
+        store.transact_relation_tuples([T("b", "u2")], [])
+    finally:
+        if hasattr(store, "close"):
+            store.close()
+
+
+# -- controller state machine (synthetic clock) -------------------------------
+
+
+def _controller(store, node, role, **kw):
+    kw.setdefault("lease_ttl_s", 2.0)
+    kw.setdefault("heartbeat_s", 0.5)
+    kw.setdefault("promotion_grace_s", 0.5)
+    return FleetController(store, node, role=role, **kw)
+
+
+def test_primary_acquires_on_boot_and_renews():
+    store = MemoryPersister(nm())
+    fences = []
+    c = _controller(store, "p0", "primary", fence_fn=fences.append)
+    c.tick(now=100.0)
+    assert c.epoch == 1 and c.is_primary and fences == [1]
+    c.tick(now=100.5)  # renewal path: no epoch bump, lease extended
+    assert c.epoch == 1 and store.fleet_lease()["expires_at"] == 102.5
+    assert c.fleet_size() == 1
+
+
+def test_replica_promotes_on_lease_expiry_with_handoff():
+    store = MemoryPersister(nm())
+    p = _controller(store, "p0", "primary")
+    promoted = []
+    r = _controller(
+        store, "r0", "replica",
+        watermark_fn=lambda: 7, lag_fn=lambda: 0.1,
+        on_promote=promoted.append,
+    )
+    p.tick(now=100.0)
+    r.tick(now=100.0)
+    assert not r.is_primary and r.epoch == 1
+    # the primary stops renewing (SIGKILL analog); past TTL + grace the
+    # rank-0 replica races the CAS and wins epoch 2
+    r.tick(now=103.0)
+    assert r.is_primary and r.epoch == 2
+    assert promoted == [2]
+    assert r.promotions_by_reason == {"lease-expired": 1}
+    # the dead primary's next renewal at epoch 1 is refused → deposed
+    p.tick(now=103.5)
+    assert p.deposed and not p.is_primary
+    # deposed means deposed: it heartbeats but never contends again,
+    # even with the new lease long expired
+    r_epoch = store.fleet_lease()["epoch"]
+    p.tick(now=1000.0)
+    assert p.deposed and store.fleet_lease()["epoch"] == r_epoch
+
+
+def test_promotion_exactly_once_most_caught_up_wins():
+    store = MemoryPersister(nm())
+    p = _controller(store, "p0", "primary")
+    promoted: list[tuple[str, int]] = []
+    behind = _controller(
+        store, "r-behind", "replica", watermark_fn=lambda: 10,
+        on_promote=lambda e: promoted.append(("r-behind", e)),
+    )
+    ahead = _controller(
+        store, "r-ahead", "replica", watermark_fn=lambda: 20,
+        on_promote=lambda e: promoted.append(("r-ahead", e)),
+    )
+    for now in (100.0, 100.5):
+        p.tick(now=now)
+        behind.tick(now=now)
+        ahead.tick(now=now)
+    # primary dies; both replicas observe expiry at the same instant.
+    # rank stagger: the caught-up replica contends immediately, the
+    # lagging one waits a grace period — and by then the CAS is taken
+    for now in (103.0, 103.1, 103.6, 104.0):
+        ahead.tick(now=now)
+        behind.tick(now=now)
+    assert promoted == [("r-ahead", 2)], promoted
+    assert ahead.is_primary and not behind.is_primary
+    assert behind.promotions == 0
+
+
+def test_promote_install_crash_recovers_exactly_once():
+    """A kill between the lease CAS and the store install (the
+    ``promote-install`` point) must recover exactly-once: the epoch is
+    durably ours, so the next tick finishes the install at the SAME
+    epoch — and no second contender can win it."""
+    store = MemoryPersister(nm())
+    p = _controller(store, "p0", "primary")
+    promoted = []
+    r = _controller(store, "r0", "replica", on_promote=promoted.append)
+    other = _controller(store, "r1", "replica", watermark_fn=lambda: -1)
+    p.tick(now=100.0)
+    r.tick(now=100.0)
+    with faults.injected("promote-install", count=1):
+        with pytest.raises(faults.FaultInjected):
+            r.tick(now=103.0)  # epoch 2 taken, install died
+    assert promoted == [] and not r.is_primary
+    assert store.fleet_lease()["holder"] == "r0"  # durably ours
+    # another contender cannot steal epoch 2 while the lease stands
+    other.tick(now=103.2)
+    assert not other.is_primary
+    # the crashed winner's next tick finds holder==me and finishes
+    r.tick(now=103.4)
+    assert r.is_primary and r.epoch == 2
+    assert promoted == [2]
+    assert r.promotions_by_reason == {"install-retry": 1}
+
+
+def test_controller_snapshot_shape():
+    store = MemoryPersister(nm())
+    c = _controller(store, "p0", "primary", lag_budget_s=10.0)
+    c.tick(now=100.0)
+    snap = c.snapshot()
+    for key in (
+        "node_id", "role", "epoch", "is_primary", "fleet_size", "members",
+        "promotions_by_reason", "route_weights", "lease_ttl_s",
+    ):
+        assert key in snap, key
+    assert snap["is_primary"] and snap["fleet_size"] == 1
+
+
+# -- election/routing math ----------------------------------------------------
+
+
+def test_promotion_rank_orders_by_watermark_then_node_id():
+    members = [
+        {"node_id": "a", "role": "replica", "watermark": 10},
+        {"node_id": "b", "role": "replica", "watermark": 30},
+        {"node_id": "c", "role": "replica", "watermark": 10},
+        {"node_id": "p", "role": "primary", "watermark": 99},
+    ]
+    assert promotion_rank(members, "b") == 0
+    assert promotion_rank(members, "a") == 1  # node_id breaks the tie
+    assert promotion_rank(members, "c") == 2
+    assert promotion_rank(members, "p") == 3  # primaries rank last
+    assert promotion_rank(members, "ghost") == 3
+
+
+def test_route_weight_drains_at_budget_and_discounts_lag():
+    assert route_weight(5.0, 5.0) == 0.0  # at budget: drained
+    assert route_weight(99.0, 5.0, 0.01) == 0.0
+    fresh = route_weight(0.0, 5.0, 0.01)
+    lagging = route_weight(2.5, 5.0, 0.01)
+    assert fresh > lagging > 0.0
+    # latency EWMA discounts too: slower replica weighs less
+    assert route_weight(0.0, 5.0, 0.100) < route_weight(0.0, 5.0, 0.010)
+    # no budget configured: weight by latency alone
+    assert route_weight(100.0, 0.0, 0.01) > 0.0
+
+
+def test_route_weights_only_replicas():
+    members = [
+        {"node_id": "p", "url": "http://p", "role": "primary", "lag_s": 0.0},
+        {"node_id": "r1", "url": "http://r1", "role": "replica", "lag_s": 0.0},
+        {"node_id": "r2", "url": "http://r2", "role": "replica", "lag_s": 9.0},
+    ]
+    w = route_weights(members, lag_budget_s=5.0, latency_ewma_s={"r1": 0.01})
+    assert set(w) == {"r1", "r2"}
+    assert w["r2"] == 0.0 and w["r1"] > 0.0
+
+
+# -- autoscaler hysteresis ----------------------------------------------------
+
+CALM = {"availability_burn_rate": 0.1, "queue_depth_ratio": 0.0}
+HOT = {"availability_burn_rate": 3.0, "queue_depth_ratio": 0.9}
+
+
+def test_autoscale_spike_shorter_than_sustain_never_grows():
+    a = Autoscaler(dict, min_replicas=0, max_replicas=4,
+                   sustain_s=5.0, cooldown_s=10.0)
+    assert a.decide(HOT, now=0.0, current=0) == "hold"
+    assert a.decide(HOT, now=4.9, current=0) == "hold"
+    assert a.decide(CALM, now=5.0, current=0) == "hold"  # spike broke
+    # the overload timer reset: a fresh spike starts from zero again
+    assert a.decide(HOT, now=6.0, current=0) == "hold"
+    assert a.decide(HOT, now=10.9, current=0) == "hold"
+    assert a.decide(HOT, now=11.0, current=0) == "grow"
+
+
+def test_autoscale_dead_band_resets_both_directions():
+    a = Autoscaler(dict, min_replicas=0, max_replicas=4,
+                   sustain_s=5.0, cooldown_s=0.0, quiet_s=5.0)
+    ambiguous = {"availability_burn_rate": 0.8, "queue_depth_ratio": 0.5}
+    assert a.decide(HOT, now=0.0, current=0) == "hold"
+    assert a.decide(ambiguous, now=4.0, current=0) == "hold"  # resets grow
+    assert a.decide(HOT, now=5.0, current=0) == "hold"  # must re-sustain
+    assert a.decide(CALM, now=6.0, current=2) == "hold"
+    assert a.decide(ambiguous, now=10.0, current=2) == "hold"  # resets shrink
+    assert a.decide(CALM, now=11.0, current=2) == "hold"
+    assert a.decide(CALM, now=16.0, current=2) == "shrink"
+
+
+def test_autoscale_cooldown_and_hbm_veto():
+    a = Autoscaler(dict, min_replicas=0, max_replicas=4,
+                   sustain_s=1.0, cooldown_s=30.0, quiet_s=2.0)
+    a.decide(HOT, now=0.0, current=0)
+    assert a.decide(HOT, now=1.0, current=0) == "grow"
+    # cooldown: sustained overload cannot fire again for 30 s
+    assert a.decide(HOT, now=10.0, current=1) == "hold"
+    assert a.decide(HOT, now=32.0, current=1) == "grow"
+    # calm long enough to shrink — but HBM pressure vetoes it
+    hot_hbm = dict(CALM, hbm_rung=2)
+    a.decide(hot_hbm, now=70.0, current=2)
+    assert a.decide(hot_hbm, now=80.0, current=2) == "hold"
+    assert a.decide(dict(CALM, hbm_rung=0), now=85.0, current=2) == "shrink"
+
+
+def test_autoscale_ten_x_swell_ramps_without_oscillation():
+    """A 10× diurnal swell: sustained overload ramps to max_replicas,
+    the plateau holds, and the calm evening shrinks back to min — with
+    exactly the expected number of actions (no thrash)."""
+    a = Autoscaler(dict, min_replicas=0, max_replicas=4,
+                   sustain_s=5.0, cooldown_s=10.0, quiet_s=20.0)
+    a.advised = 0
+    a._signals_fn = lambda: dict(SIGNAL[0])
+    SIGNAL = [HOT]
+    decisions = []
+    now = 0.0
+    # morning swell: 2 minutes of overload
+    while now < 120.0:
+        decisions.append(a.step(now=now))
+        now += 1.0
+    assert a.advised == 4  # clamped at max
+    grows_morning = a.grow_actions
+    assert grows_morning == 4  # one per cooldown window, no extras
+    # evening: sustained calm drains back down
+    SIGNAL[0] = CALM
+    while now < 400.0:
+        decisions.append(a.step(now=now))
+        now += 1.0
+    assert a.advised == 0
+    assert a.grow_actions == grows_morning  # calm never grew
+    assert a.shrink_actions == 4
+    # no interleaving: all grows strictly before all shrinks
+    acted = [d for d in decisions if d != "hold"]
+    assert acted == ["grow"] * 4 + ["shrink"] * 4
+
+
+# -- reshard state machine ----------------------------------------------------
+
+
+class _Geometry:
+    def __init__(self):
+        self.shards = 2
+        self.installed: list = []
+
+    def build(self, target):
+        return f"engine@{target}"
+
+    def install(self, engine, target):
+        self.installed.append((engine, target))
+        self.shards = target
+
+
+def test_reshard_success_path():
+    g = _Geometry()
+    c = ReshardCoordinator(g.build, g.install, current_fn=lambda: g.shards)
+    snap = c.reshard(4)
+    assert snap["state"] == "idle" and snap["current_shards"] == 4
+    assert g.installed == [("engine@4", 4)]
+    assert c.reshards_total == 1
+    # merge back
+    c.reshard(2)
+    assert g.shards == 2 and c.reshards_total == 2
+
+
+def test_reshard_build_failure_keeps_old_geometry():
+    g = _Geometry()
+
+    def bad_build(target):
+        raise RuntimeError("snapshot build died")
+
+    c = ReshardCoordinator(bad_build, g.install, current_fn=lambda: g.shards)
+    with pytest.raises(RuntimeError):
+        c.reshard(4)
+    assert c.state == "failed" and g.installed == []
+    assert g.shards == 2  # old geometry serves
+    # the failure is not sticky: the next attempt (fixed build) succeeds
+    c._build_fn = g.build
+    assert c.reshard(4)["state"] == "idle"
+    assert g.shards == 4
+
+
+def test_reshard_handoff_crash_leaves_old_geometry_serving():
+    g = _Geometry()
+    c = ReshardCoordinator(g.build, g.install, current_fn=lambda: g.shards)
+    with faults.injected("reshard-handoff", count=1):
+        with pytest.raises(faults.FaultInjected):
+            c.reshard(4)
+    # nothing installed: zero wrong answers by construction
+    assert g.installed == [] and g.shards == 2
+    assert c.state == "failed" and c.failures == 1
+    # recovery: the next reshard completes
+    assert c.reshard(4)["state"] == "idle"
+    assert g.shards == 4
+
+
+def test_reshard_rejects_overlap_and_bad_targets():
+    g = _Geometry()
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_build(target):
+        started.set()
+        release.wait(timeout=10)
+        return g.build(target)
+
+    c = ReshardCoordinator(slow_build, g.install, current_fn=lambda: g.shards)
+    t = threading.Thread(target=lambda: c.reshard(4))
+    t.start()
+    assert started.wait(timeout=10)
+    with pytest.raises(RuntimeError):
+        c.reshard(8)  # one reshard at a time
+    release.set()
+    t.join(timeout=10)
+    assert g.shards == 4
+    with pytest.raises(ValueError):
+        c.reshard(0)
+    # no-op: resharding to the current geometry churns nothing
+    before = list(g.installed)
+    assert c.reshard(4)["state"] == "idle"
+    assert g.installed == before
+
+
+# -- SDK fleet awareness ------------------------------------------------------
+
+
+class _StubNode:
+    """One scriptable HTTP endpoint: answers /fleet with a canned body,
+    PATCH /relation-tuples per the configured behavior."""
+
+    def __init__(self, write_status=204, fleet_body=None):
+        self.write_status = write_status
+        self.fleet_body = fleet_body
+        self.writes = 0
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/fleet" and outer.fleet_body is not None:
+                    body = json.dumps(outer.fleet_body).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_response(404)
+                self.end_headers()
+                self.wfile.write(b'{"error": {"message": "nope"}}')
+
+            def do_PATCH(self):
+                outer.writes += 1
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                status = outer.write_status
+                if status == 204:
+                    self.send_response(204)
+                    self.send_header("X-Keto-Snaptoken", "41")
+                    self.end_headers()
+                else:
+                    self.send_response(status)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": {"message": "refused"}}')
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _fleet_body(primary_url, replicas=()):
+    members = [
+        {"node_id": "p1", "url": primary_url, "role": "primary",
+         "watermark": 50, "lag_s": 0.0}
+    ]
+    members += [
+        {"node_id": f"r{i}", "url": u, "role": "replica",
+         "watermark": 40, "lag_s": lag}
+        for i, (u, lag) in enumerate(replicas)
+    ]
+    return {"node_id": "p1", "role": "primary", "epoch": 2,
+            "is_primary": True, "fleet_size": len(members),
+            "members": members}
+
+
+def test_client_maps_409_to_fenced_epoch():
+    fenced = _StubNode(write_status=409)
+    try:
+        c = KetoClient(fenced.url, fenced.url, retry_max_wait_s=0.0)
+        # no fleet endpoint behind it either (404) → the fenced error
+        # surfaces raw after the budget-gated re-resolve found nobody
+        with pytest.raises(ErrFencedEpoch):
+            c.patch_relation_tuples(insert=[T("a", "u")])
+    finally:
+        fenced.close()
+
+
+def test_client_write_follows_promotion_mid_write():
+    """The promoted-mid-write regression: the configured write url now
+    answers 403 (it was deposed / is a replica again); the fleet body
+    names the new primary; the SDK re-resolves and the write lands."""
+    new_primary = _StubNode(write_status=204)
+    old = _StubNode(
+        write_status=403, fleet_body=_fleet_body(new_primary.url)
+    )
+    try:
+        c = KetoClient(old.url, old.url, retry_max_wait_s=0.0)
+        resp = c.patch_relation_tuples(insert=[T("a", "u")])
+        assert resp.snaptoken == 41
+        assert c.write_url == new_primary.url
+        assert c.primary_reresolves == 1
+        assert new_primary.writes == 1
+        # follow-up writes go straight to the new primary
+        c.patch_relation_tuples(insert=[T("b", "u")])
+        assert new_primary.writes == 2 and c.primary_reresolves == 1
+    finally:
+        old.close()
+        new_primary.close()
+
+
+def test_client_write_follows_connection_refused():
+    """A SIGKILL'd primary refuses connections — unambiguously safe to
+    re-resolve even for an unkeyed write; the fleet endpoint is found
+    on a surviving replica."""
+    new_primary = _StubNode(write_status=204)
+    replica = _StubNode(fleet_body=_fleet_body(new_primary.url))
+    try:
+        dead = "http://127.0.0.1:1"  # nothing listens
+        c = KetoClient(
+            dead, dead, retry_max_wait_s=0.0,
+            replica_read_urls=[replica.url],
+        )
+        resp = c.patch_relation_tuples(insert=[T("a", "u")])
+        assert resp.snaptoken == 41
+        assert c.write_url == new_primary.url
+    finally:
+        replica.close()
+        new_primary.close()
+
+
+def test_client_ambiguous_unkeyed_write_never_rereoutes():
+    """An unkeyed write that died ambiguously (connection reset, NOT
+    refused) must surface raw — a blind resend at a new primary could
+    double-apply."""
+    import urllib.error
+
+    c = KetoClient("http://x", "http://y", retry_max_wait_s=0.0)
+    calls = []
+
+    def boom(*a, **kw):
+        calls.append(a)
+        raise urllib.error.URLError(ConnectionResetError("mid-response"))
+
+    c._do = boom
+    with pytest.raises(urllib.error.URLError):
+        c._do_write("PATCH", "/relation-tuples", [], (204,), None, False)
+    assert len(calls) == 1  # no second attempt anywhere
+
+
+def test_client_refresh_fleet_updates_routing_view():
+    lagged = _StubNode()
+    fresh = _StubNode()
+    fleet = _fleet_body(
+        "http://127.0.0.1:2",
+        replicas=[(fresh.url, 0.0), (lagged.url, 99.0)],
+    )
+    hub = _StubNode(fleet_body=fleet)
+    try:
+        c = KetoClient(
+            hub.url, hub.url,
+            replica_read_urls=[fresh.url, lagged.url],
+            replica_lag_budget_s=5.0,
+        )
+        body = c.refresh_fleet()
+        assert body["epoch"] == 2
+        assert c.last_fleet["fleet_size"] == 3
+        # the over-budget replica weighs 0 → every pick drains to fresh
+        picks = {c._pick_replica() for _ in range(50)}
+        assert picks == {fresh.url}
+        assert c._fleet_primary_url() == "http://127.0.0.1:2"
+    finally:
+        hub.close()
+        lagged.close()
+        fresh.close()
+
+
+def test_client_refresh_fleet_disabled_returns_empty():
+    plain = _StubNode()  # /fleet answers 404
+    try:
+        c = KetoClient(plain.url, plain.url)
+        assert c.refresh_fleet() == {}
+        assert c.last_fleet == {}
+    finally:
+        plain.close()
+
+
+# -- daemon surfaces (in-process) ---------------------------------------------
+
+
+@pytest.fixture
+def fleet_daemon(tmp_path):
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "docs"},
+                           {"id": 1, "name": "groups"}],
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "serve.snapshot_cache_dir": str(tmp_path / "cache"),
+            "serve.fleet_enabled": True,
+            "serve.fleet_node_id": "test-p0",
+            "serve.fleet_lease_ttl_s": 2.0,
+            "serve.fleet_heartbeat_s": 0.1,
+        }
+    )
+    daemon = Daemon(Registry(cfg))
+    daemon.serve_all(block=False)
+    yield daemon
+    daemon.shutdown()
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def test_daemon_fleet_surfaces(fleet_daemon):
+    daemon = fleet_daemon
+    deadline = time.monotonic() + 15.0
+    body = {}
+    while time.monotonic() < deadline:
+        body = _get_json(daemon.read_port, "/fleet")
+        if body.get("epoch", 0) >= 1:
+            break
+        time.sleep(0.05)
+    assert body["node_id"] == "test-p0"
+    assert body["is_primary"] and body["epoch"] >= 1
+    assert body["fleet_size"] >= 1
+    assert any(m["node_id"] == "test-p0" for m in body["members"])
+    # the same body serves on the write port
+    wbody = _get_json(daemon.write_port, "/fleet")
+    assert wbody["node_id"] == "test-p0"
+    # /health/ready and /slo carry the fleet keys
+    ready = _get_json(daemon.read_port, "/health/ready")
+    assert ready["is_primary"] and ready["epoch"] >= 1
+    assert ready["fleet_size"] >= 1 and ready["reshard_state"] == "idle"
+    slo = _get_json(daemon.read_port, "/slo")
+    assert slo["epoch"] >= 1 and slo["reshard_state"] == "idle"
+    # fleet metrics exported
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{daemon.read_port}/metrics", timeout=5
+    ) as resp:
+        text = resp.read().decode()
+    for fam in ("keto_fleet_epoch", "keto_fleet_replicas",
+                "keto_reshard_state", "keto_fleet_promotions_total"):
+        assert fam in text, fam
+
+
+def test_daemon_fleet_disabled_404(tmp_path):
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "docs"}],
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            "serve.snapshot_cache_dir": str(tmp_path / "cache"),
+        }
+    )
+    daemon = Daemon(Registry(cfg))
+    daemon.serve_all(block=False)
+    try:
+        try:
+            _get_json(daemon.read_port, "/fleet")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # no fleet keys on /health/ready when the control plane is off
+        ready = _get_json(daemon.read_port, "/health/ready")
+        assert "epoch" not in ready
+    finally:
+        daemon.shutdown()
+
+
+def test_registry_in_process_live_reshard(tmp_path):
+    """The tentpole's reshard path end to end in one process: write,
+    reshard 1→2 under a live engine, answers identical before/after,
+    then merge back."""
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "docs"},
+                           {"id": 1, "name": "groups"}],
+            "dsn": "memory",
+            "serve.snapshot_cache_dir": str(tmp_path / "cache"),
+        }
+    )
+    reg = Registry(cfg)
+    try:
+        store = reg.relation_tuple_manager()
+        res = store.transact_relation_tuples(
+            [T(f"o{i}", f"u{i}") for i in range(6)], []
+        )
+        eng = reg.permission_engine()
+        battery = [T(f"o{i}", f"u{i}") for i in range(6)]
+        battery += [T(f"o{i}", "ghost") for i in range(3)]
+        want = [eng.subject_is_allowed(t) for t in battery]
+        assert want[:6] == [True] * 6 and res.snaptoken
+        coord = reg.reshard_coordinator()
+        snap = coord.reshard(2)
+        assert snap["state"] == "idle"
+        eng2 = reg.permission_engine()
+        assert eng2 is not eng
+        got = [eng2.subject_is_allowed(t) for t in battery]
+        assert got == want  # zero wrong answers across the split
+        # merge back down
+        assert coord.reshard(1)["state"] == "idle"
+        eng3 = reg.permission_engine()
+        got3 = [eng3.subject_is_allowed(t) for t in battery]
+        assert got3 == want
+        assert coord.reshards_total == 2
+    finally:
+        reg.close()
