@@ -391,6 +391,37 @@ CONFIG_SCHEMA = {
                     "default": 5.0,
                     "description": "Graceful shutdown: after SIGTERM/SIGINT the daemon pins readiness to NOT_SERVING (new traffic routes away) and waits up to this many seconds for in-flight checks to resolve before tearing the servers down — the zero-dropped-requests half of a rolling restart.",
                 },
+                "tenant_enabled": {
+                    "type": "boolean",
+                    "default": True,
+                    "description": "Multi-tenant serving (keto_tpu/driver/tenants.py): an X-Keto-Tenant header (gRPC: x-keto-tenant metadata) scopes the request to that tenant's own engine, check batcher + admission window, store view, and watch hub, pooled in the TenantPool. Absent header = the default tenant, which is the pre-tenancy registry — every existing contract is untouched either way. false rejects non-default tenant headers with 400; tenant-scoped requests are always primary-only.",
+                },
+                "tenant_backend": {
+                    "type": "string",
+                    "enum": ["oracle", "device", "auto"],
+                    "default": "oracle",
+                    "description": "Engine kind built per non-default tenant: 'oracle' (CPU reference engine — bit-identical decisions by construction, no device residency, scales to thousands of mostly-idle tenants), 'device' (a full TpuCheckEngine per tenant with its own snapshot/overlay/label lifecycle and segmented snapshot cache under <snapshot_cache_dir>/tenants/<id>), or 'auto' (device when the default engine is device-backed, oracle otherwise). The default tenant always keeps the engine.backend selection.",
+                },
+                "tenant_max_resident": {
+                    "type": "integer",
+                    "default": 8,
+                    "description": "How many non-default tenants may hold device-resident engine state at once. Admitting tenant N+1 evicts the least-recently-dispatching resident tenant WHOLE (engine closed, bytes returned to the HBM ledger) — never a tenant mid-dispatch — and the evicted tenant faults back in through its snapshot cache on first touch. The governor's tenant-lru eviction rung sheds the coldest tenant under machine-wide memory pressure the same way.",
+                },
+                "tenant_quota_share": {
+                    "type": "number",
+                    "default": 0.25,
+                    "description": "Per-tenant admission quota as a fraction of the machine's batch capacity (engine.batch_size-derived, clamped to [0.01, 1.0]): each tenant's batcher caps its pending queue and AIMD admission window at this share, so one tenant's 10x storm sheds 429 for THAT tenant while every other tenant's lanes stay within budget. Retry-After on a tenant's 429s reflects that tenant's consecutive overloaded ticks, not the machine's.",
+                },
+                "tenant_shed_spike": {
+                    "type": "integer",
+                    "default": 50,
+                    "description": "Per-tenant shed-rate anomaly trigger: this many sheds from one tenant inside the sliding 10-second window fires the flight recorder (reason tenant-shed-spike, bundle carries the per-tenant ledger and shed totals), once per window crossing. 0 disables the trigger.",
+                },
+                "tenant_hbm_budget_bytes": {
+                    "type": "integer",
+                    "default": 0,
+                    "description": "Per-tenant HBM budget (bytes) handed to each device-backed tenant engine's own governor ledger; 0 = auto (same derivation as serve.hbm_budget_bytes). Cross-tenant residency is arbitrated above this by serve.tenant_max_resident and the tenant-lru rung.",
+                },
             },
         },
         "namespaces": {

@@ -105,6 +105,7 @@ class CheckBatcher:
         batch_sub_slice: Optional[int] = None,
         batch_reserve_share: float = 0.125,
         admission: Optional["AdmissionController"] = None,
+        tenant: Optional[str] = None,
     ):
         """``engine`` needs ``batch_check(list[RelationTuple]) -> list[bool]``.
 
@@ -118,8 +119,21 @@ class CheckBatcher:
         gRPC RESOURCE_EXHAUSTED) — the client learns it should back off
         *now*, seconds ahead of the future timeout it would otherwise
         burn. ``admission`` (an AdmissionController) additionally sheds
-        batch-lane arrivals beyond its adaptive window."""
+        batch-lane arrivals beyond its adaptive window.
+
+        ``tenant`` names the tenant this batcher serves (multi-tenant
+        mode, keto_tpu/driver/tenants.py): every shed error then carries
+        it in ``details`` so the serving layers answer with an
+        ``X-Keto-Tenant`` header, and ``retry_after_s`` comes from THIS
+        batcher's admission controller — one tenant's consecutive
+        overloaded ticks never inflate another tenant's backoff."""
         self._engine = engine
+        #: tenant identity stamped onto shed errors (None = untagged)
+        self.tenant = tenant
+        #: optional ``fn(tenant, lane)`` invoked on every shed — the
+        #: TenantPool's shed-rate spike tracker. Called under ``_cond``;
+        #: the callback must not re-enter this batcher.
+        self.on_shed = None
         self._batch_size = batch_size
         self._window_s = window_ms / 1e3
         self._max_pending = max_pending or 8 * batch_size
@@ -333,7 +347,17 @@ class CheckBatcher:
         retry_after = (
             self.admission.retry_after_s() if self.admission is not None else 1.0
         )
-        return ErrTooManyRequests(message, retry_after_s=retry_after)
+        cb, tenant = self.on_shed, self.tenant
+        if cb is not None:
+            try:
+                cb(tenant or "", lane)
+            except Exception:
+                _log.warning("on_shed callback failed", exc_info=True)
+        return ErrTooManyRequests(
+            message,
+            retry_after_s=retry_after,
+            details={"tenant": tenant} if tenant else None,
+        )
 
     def _enqueue(self, item: _Item) -> None:
         lane, n = item.lane, item.n

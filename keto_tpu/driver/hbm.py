@@ -85,8 +85,13 @@ TAGS = ("snapshot", "overlay", "labels", "reverse", "warmup", "build",
 #: "staging" goes first: dropping the entry-staging pool reverts to
 #: per-slice allocation + device_put — pure churn cost, never coverage.
 #: "reverse" drops the list layouts' device arrays — reverse queries
-#: fall back to the CPU-reference lister bit-identically
-RUNGS = ("staging", "labels", "reverse", "warm-ladder", "overlay-budget")
+#: fall back to the CPU-reference lister bit-identically.
+#: "tenant-lru" (appended by the registry in multi-tenant mode, AFTER
+#: the engine's own rungs) evicts the coldest idle tenant's whole engine
+#: — never the tenant currently dispatching — and its state faults back
+#: in through the segmented snapcache on next touch
+RUNGS = ("staging", "labels", "reverse", "warm-ladder", "overlay-budget",
+         "tenant-lru")
 
 
 def device_budget_bytes(
@@ -308,6 +313,20 @@ class HbmGovernor:
         with self._lock:
             self._rungs = [_Rung(n, e, r) for n, e, r in rungs]
             self._depth = 0
+
+    def append_rung(
+        self, name: str, evict: Callable[[], int], restore: Callable[[], None]
+    ) -> None:
+        """Append one rung BELOW the engine's ladder (``attach_rungs``
+        replaces the whole ladder, and the engine attaches its rungs at
+        construction — this is the seam for rungs owned by someone else,
+        e.g. the registry's cross-tenant ``tenant-lru`` rung). Appended
+        rungs run under the same lock discipline and are accounted in
+        ``evictions_by_rung`` like any other. Idempotent per name."""
+        with self._lock:
+            if any(r.name == name for r in self._rungs):
+                return
+            self._rungs.append(_Rung(name, evict, restore))
 
     @property
     def rung_depth(self) -> int:

@@ -866,11 +866,171 @@ class Registry:
                 ),
                 batch_sub_slice=int(self._config.get("serve.batch_sub_slice", 1024)),
                 admission=admission,
+                # every shed response names its tenant (X-Keto-Tenant) —
+                # requests without the header belong to the default tenant
+                tenant="default",
             )
+            pool = self.peek("tenants")
+            if pool is not None:
+                b.on_shed = pool.note_shed
             b.start()
             return b
 
         return self._memo("check_batcher", build)
+
+    # -- multi-tenant serving (keto_tpu/driver/tenants.py) --------------------
+
+    def tenant_pool(self):
+        """The keyed TenantPool behind the ``X-Keto-Tenant`` header:
+        per-tenant engine/batcher/admission/watch contexts, the
+        cross-tenant residency ledger with its tenant-LRU, and the
+        per-tenant shed-spike anomaly tracker. Built lazily on the first
+        non-default tenant request — a process that never sees the header
+        never constructs it."""
+
+        def build():
+            from keto_tpu.driver.tenants import TenantPool
+
+            pool = TenantPool(
+                self,
+                max_resident=int(
+                    self._config.get("serve.tenant_max_resident", 8)
+                ),
+                quota_share=float(
+                    self._config.get("serve.tenant_quota_share", 0.25)
+                ),
+                backend=str(
+                    self._config.get("serve.tenant_backend", "oracle")
+                ),
+                shed_spike=int(
+                    self._config.get("serve.tenant_shed_spike", 50)
+                ),
+            )
+            # cross-tenant residency arbitration: the default engine's
+            # governor gets a tenant-LRU rung BELOW its own ladder, so
+            # real device pressure reclaims cold tenants' engines whole
+            # (they fault back in via the segmented snapcache)
+            gov = getattr(self.permission_engine(), "hbm", None)
+            if gov is not None:
+                gov.append_rung("tenant-lru", pool.evict_coldest, lambda: None)
+            fr = self.flight_recorder()
+            if fr is not None:
+                # a per-tenant shed-rate spike is an anomaly in its own
+                # right: capture the bundle while the storm is visible
+                pool.set_shed_trigger(
+                    lambda tenant, detail: fr.trigger(
+                        "tenant-shed-spike", detail, defer_s=0.2
+                    )
+                )
+            batcher = self.peek("check_batcher")
+            if batcher is not None:
+                # the default tenant's sheds feed the same spike tracker
+                batcher.on_shed = pool.note_shed
+            return pool
+
+        return self._memo("tenants", build)
+
+    def build_tenant_engine(self, store, tenant: str):
+        """Engine factory for TenantPool fault-ins. ``serve.tenant_backend``:
+        ``oracle`` (default) serves the tenant from the recursive CPU
+        reference engine — zero device footprint, bit-identical answers
+        by construction, the right shape for thousands of mostly-cold
+        tenants; ``device`` builds a full TpuCheckEngine over the
+        tenant's store view with a per-tenant snapcache directory (the
+        sub-500ms cold fault-in path); ``auto`` follows the default
+        engine's kind."""
+        backend = str(self._config.get("serve.tenant_backend", "oracle"))
+        if backend == "auto":
+            backend = (
+                "device"
+                if hasattr(self.peek("permission_engine"), "snapshot")
+                else "oracle"
+            )
+        if backend == "device" and hasattr(store, "snapshot_rows"):
+            import os
+
+            from keto_tpu.check.tpu_engine import TpuCheckEngine
+
+            cache_root = str(
+                self._config.get("serve.snapshot_cache_dir", "") or ""
+            )
+            return TpuCheckEngine(
+                store,
+                self.namespaces_source(),
+                sync_rebuild_budget_s=float(
+                    self._config.get("engine.sync_rebuild_budget_s", 0.25)
+                ),
+                overlay_edge_budget=int(
+                    self._config.get("serve.overlay_edge_budget", 4096)
+                ),
+                # each tenant caches its snapshots under its own subdir:
+                # eviction closes the engine, the on-disk segments stay,
+                # and the next touch faults in from cache, not a rebuild
+                snapshot_cache_dir=(
+                    os.path.join(cache_root, "tenants", tenant)
+                    if cache_root
+                    else None
+                ),
+                labels_enabled=bool(
+                    self._config.get("serve.labels_enabled", True)
+                ),
+                # per-tenant governor budget (0 = auto). The POOL bounds
+                # how many such engines exist at once; this bounds each.
+                hbm_budget_bytes=int(
+                    self._config.get("serve.tenant_hbm_budget_bytes", 0)
+                ),
+                audit_sample_rate=float(
+                    self._config.get("serve.audit_sample_rate", 0.0)
+                ),
+            )
+        return CheckEngine(store)
+
+    def build_tenant_batcher(self, engine, tenant: str) -> CheckBatcher:
+        """Per-tenant CheckBatcher + AIMD admission — the quota/fairness
+        half of noisy-neighbor isolation. Each tenant's queue bound is
+        ``serve.tenant_quota_share`` of the global bound, and its
+        admission controller tracks ITS consecutive overloaded ticks, so
+        Retry-After scales per tenant (no cross-tenant backoff bleed)."""
+        batch_size = int(self._config.get("engine.batch_size", 4096))
+        share = min(
+            1.0,
+            max(0.01, float(self._config.get("serve.tenant_quota_share", 0.25))),
+        )
+        max_pending = max(64, int(8 * batch_size * share))
+        admission = None
+        if bool(self._config.get("serve.admission_enabled", True)):
+            from keto_tpu.driver.admission import AdmissionController
+
+            budget = float(
+                self._config.get("serve.admission_latency_budget_ms", 0.0)
+            )
+            admission = AdmissionController(
+                stats=None,  # tenant rounds are timed by observe_round
+                target_ms=float(
+                    self._config.get("serve.stream_slice_target_ms", 40.0)
+                ),
+                budget_ms=budget or None,
+                min_window=int(
+                    self._config.get("serve.admission_min_window", 64)
+                ),
+                max_window=max_pending,
+            )
+        b = CheckBatcher(
+            engine,
+            batch_size=batch_size,
+            window_ms=float(self._config.get("engine.batch_window_ms", 1.0)),
+            max_pending=max_pending,
+            shed_on_full=bool(self._config.get("serve.shed_on_full", True)),
+            interactive_max_tuples=int(
+                self._config.get("serve.interactive_max_tuples", 16)
+            ),
+            batch_sub_slice=int(self._config.get("serve.batch_sub_slice", 1024)),
+            admission=admission,
+            tenant=tenant,
+        )
+        b.on_shed = self.tenant_pool().note_shed
+        b.start()
+        return b
 
     def health_monitor(self):
         """The serving health state machine (keto_tpu/driver/health.py):
@@ -1058,6 +1218,12 @@ class Registry:
         slo = self.peek("slo")
         if slo is not None:
             sec("slo", slo.to_json)
+        pool = self.peek("tenants")
+        if pool is not None:
+            # noisy-neighbor forensics: per-tenant residency, shed
+            # totals, spike counts, and degradation reasons — who was
+            # storming and who paid, at the moment of anomaly
+            sec("tenants", pool.snapshot)
         sections["config"] = {
             "role": str(self._config.get("serve.role", "primary")),
             "version": VERSION,
@@ -1571,6 +1737,121 @@ class Registry:
             "replicated state spreads evenly) — the hottest shard is "
             "the binding constraint of every mesh-wide plan.",
             shard_hbm, ("shard",),
+        )
+
+        # multi-tenant serving (keto_tpu/driver/tenants.py): pool-level
+        # residency/ledger plus per-tenant traffic and degradation —
+        # peek-only like every bridge; the labeled families always emit
+        # a default-tenant row so the exposed family set (and its
+        # observability.md contract) is stable before the first tenant
+        def tenant_pool_peek():
+            return self.peek("tenants")
+
+        def tenant_pool_count(method):
+            def read():
+                p = tenant_pool_peek()
+                yield (), float(getattr(p, method)() if p is not None else 0)
+
+            return read
+
+        m.register_callback(
+            "keto_tenant_known", "gauge",
+            "Tenants this process has served since boot (resident or "
+            "evicted); the default tenant is not counted.",
+            tenant_pool_count("known_count"),
+        )
+        m.register_callback(
+            "keto_tenant_resident", "gauge",
+            "Tenants whose engines are currently materialized (bounded "
+            "by serve.tenant_max_resident via the tenant-LRU).",
+            tenant_pool_count("resident_count"),
+        )
+
+        def tenant_pool_attr(attr):
+            def read():
+                p = tenant_pool_peek()
+                yield (), float(getattr(p, attr, 0) if p is not None else 0)
+
+            return read
+
+        m.register_callback(
+            "keto_tenant_evictions_total", "counter",
+            "Whole-tenant engine evictions (tenant-LRU capacity + the "
+            "governor's tenant-lru HBM rung); state faults back in via "
+            "the per-tenant snapcache on next touch.",
+            tenant_pool_attr("evictions"),
+        )
+        m.register_callback(
+            "keto_tenant_faultins_total", "counter",
+            "Tenant engine fault-ins (first touch + every re-build after "
+            "an eviction).",
+            tenant_pool_attr("faultins"),
+        )
+        m.register_callback(
+            "keto_tenant_shed_spikes_total", "counter",
+            "Per-tenant shed-rate spikes detected (>= "
+            "serve.tenant_shed_spike sheds inside the tracking window) — "
+            "each one also triggers a flight-recorder bundle.",
+            tenant_pool_attr("spike_triggers"),
+        )
+
+        def tenant_rows(per_ctx):
+            def read():
+                p = tenant_pool_peek()
+                rows = (
+                    [((c.name,), per_ctx(c)) for c in p.tenants()]
+                    if p is not None
+                    else []
+                )
+                return rows or [(("default",), 0.0)]
+
+            return read
+
+        m.register_callback(
+            "keto_tenant_checks_total", "counter",
+            "Check tuples dispatched per tenant (the default tenant's "
+            "traffic rides the global keto_check_* families).",
+            tenant_rows(lambda c: float(c.checks_total)), ("tenant",),
+        )
+
+        def tenant_shed():
+            p = tenant_pool_peek()
+            totals = dict(p.shed_totals) if p is not None else {}
+            rows = [((t,), float(v)) for t, v in sorted(totals.items())]
+            return rows or [(("default",), 0.0)]
+
+        m.register_callback(
+            "keto_tenant_shed_total", "counter",
+            "Requests shed per tenant (429 + Retry-After + "
+            "X-Keto-Tenant): one tenant's storm sheds under ITS quota "
+            "while every other tenant's lanes stay open.",
+            tenant_shed, ("tenant",),
+        )
+        m.register_callback(
+            "keto_tenant_resident_bytes", "gauge",
+            "Device-ledger bytes per resident tenant engine (0 while "
+            "cold/oracle-backed); sums with keto_hbm_resident_bytes to "
+            "the whole process's residency account.",
+            tenant_rows(lambda c: float(c.resident_bytes())), ("tenant",),
+        )
+
+        def tenant_degraded():
+            p = tenant_pool_peek()
+            if p is None:
+                return [(("default",), 0.0)]
+            bad = p.degraded()
+            rows = [
+                ((c.name,), 1.0 if c.name in bad else 0.0)
+                for c in p.tenants()
+            ]
+            return rows or [(("default",), 0.0)]
+
+        m.register_callback(
+            "keto_tenant_degraded", "gauge",
+            "1 for tenants currently carrying a DEGRADED(tenant=...) "
+            "reason (device fallback, memory pressure, audit mismatch) — "
+            "per-tenant only, never the global health machine.",
+            tenant_degraded, ("tenant",),
         )
 
         def maint_counter(key):
@@ -2103,6 +2384,12 @@ class Registry:
         hub = self._singletons.get("watch_hub")
         if hub is not None:
             hub.close()
+        # tenant contexts own batchers/engines/hubs of their own: stop
+        # them before the default batcher so no tenant dispatch lands on
+        # components mid-teardown
+        pool = self._singletons.get("tenants")
+        if pool is not None:
+            pool.close()
         batcher = self._singletons.get("check_batcher")
         if batcher:
             batcher.stop()

@@ -58,17 +58,47 @@ _CODE_BY_NUM = {c.value[0]: c for c in grpc.StatusCode}
 def _abort(context, err: KetoError):
     # overload errors (RESOURCE_EXHAUSTED / UNAVAILABLE) carry the
     # server's backoff advice as trailing metadata — the gRPC face of
-    # the REST Retry-After header
+    # the REST Retry-After header; tenant-scoped sheds additionally name
+    # the tenant (the REST X-Keto-Tenant response header)
+    trailing = []
     retry_after = getattr(err, "retry_after_s", None)
     if retry_after:
+        trailing.append(("retry-after", str(max(1, math.ceil(retry_after)))))
+    tn = (getattr(err, "details", None) or {}).get("tenant")
+    if tn:
+        trailing.append(("x-keto-tenant", str(tn)))
+    if trailing:
         try:
-            context.set_trailing_metadata(
-                (("retry-after", str(max(1, math.ceil(retry_after)))),)
-            )
+            context.set_trailing_metadata(tuple(trailing))
         except Exception:
             # stream torn down; the status still reaches the client
             _log.debug("trailing metadata raced stream teardown", exc_info=True)
     context.abort(_CODE_BY_NUM.get(err.grpc_code, grpc.StatusCode.INTERNAL), err.message)
+
+
+def _scope_from(registry, context):
+    """The registry-shaped scope serving this call: the registry itself
+    for the default tenant (absent/blank ``x-keto-tenant`` metadata —
+    every pre-tenancy contract intact), or the tenant's pool context
+    otherwise. The gRPC face of the REST ``X-Keto-Tenant`` header, with
+    identical gating (``serve.tenant_enabled``, primary-only)."""
+    from keto_tpu.driver.tenants import DEFAULT_TENANT, validate_tenant_id
+
+    raw = ""
+    for k, v in context.invocation_metadata() or ():
+        if k.lower() == "x-keto-tenant" and v:
+            raw = v
+            break
+    tenant = validate_tenant_id(raw)
+    if tenant == DEFAULT_TENANT:
+        return registry
+    if not bool(registry.config().get("serve.tenant_enabled", True)):
+        raise ErrBadRequest(
+            "multi-tenant serving is disabled (serve.tenant_enabled)"
+        )
+    if registry.is_replica():
+        raise ErrBadRequest("tenant-scoped requests are served by the primary only")
+    return registry.tenant_pool().get(tenant)
 
 
 def _request_metrics(m):
@@ -148,7 +178,8 @@ def _wrap(fn, registry=None, name: str = ""):
                 if span is not None:
                     trace_id = span.trace_id
                 tl = recorder.begin(
-                    name, trace_id=trace_id, request_id=req_id, surface="grpc"
+                    name, trace_id=trace_id, request_id=req_id, surface="grpc",
+                    tenant=(md.get("x-keto-tenant") or "").strip() or "default",
                 )
                 with request_context(request_id=req_id, trace_id=trace_id):
                     try:
@@ -245,7 +276,8 @@ class CheckService:
         # replica mode: gate the pin against the applied watermark
         # (FAILED_PRECONDITION above it), then the Watch-invalidated
         # check cache — same semantics as the REST path
-        rep = self.registry.replica_controller()
+        scope = _scope_from(self.registry, context)
+        rep = scope.replica_controller()
         cache = rep.checkcache if rep is not None else None
         key = None
         if rep is not None:
@@ -258,7 +290,7 @@ class CheckService:
                     return check_service_pb2.CheckResponse(
                         allowed=allowed, snaptoken=str(token)
                     )
-        allowed, token = self.registry.check_batcher().check_with_token(
+        allowed, token = scope.check_batcher().check_with_token(
             tuple_, at_least=at_least, latest=request.latest, deadline=deadline,
             lane=lane,
         )
@@ -295,11 +327,12 @@ class ExpandService:
 
     def Expand(self, request, context):
         subject = subject_from_proto(request.subject)
-        rep = self.registry.replica_controller()
+        scope = _scope_from(self.registry, context)
+        rep = scope.replica_controller()
         if rep is not None:
             rep.gate_read(None)  # UNAVAILABLE until the first bootstrap
-        tree = self.registry.expand_engine().build_tree(
-            subject, self.registry.expand_depth(request.max_depth)
+        tree = scope.expand_engine().build_tree(
+            subject, scope.expand_depth(request.max_depth)
         )
         return expand_service_pb2.ExpandResponse(tree=tree_to_proto(tree))
 
@@ -332,7 +365,8 @@ class ReadService:
         if not request.HasField("query"):
             raise ErrBadRequest("invalid request")
         query = query_from_proto(request.query)
-        rep = self.registry.replica_controller()
+        scope = _scope_from(self.registry, context)
+        rep = scope.replica_controller()
         if rep is not None:
             rep.gate_read(None)  # UNAVAILABLE until the first bootstrap
         opts = []
@@ -340,7 +374,7 @@ class ReadService:
             opts.append(with_token(request.page_token))
         if request.page_size:
             opts.append(with_size(request.page_size))
-        rels, next_page = self.registry.relation_tuple_manager().get_relation_tuples(
+        rels, next_page = scope.relation_tuple_manager().get_relation_tuples(
             query, *opts
         )
         from keto_tpu.relationtuple.proto_codec import tuple_to_proto
@@ -399,9 +433,10 @@ class WriteService:
             if k.lower() == "x-idempotency-key" and v:
                 idem_key = v
                 break
-        manager = self.registry.relation_tuple_manager()
+        scope = _scope_from(self.registry, context)
+        manager = scope.relation_tuple_manager()
         # routed through the group-commit coordinator when enabled
-        result = self.registry.transact_writes()(
+        result = scope.transact_writes()(
             insert, delete, idempotency_key=idem_key
         )
         if result is not None:
@@ -415,7 +450,7 @@ class WriteService:
                 from keto_tpu.x.tracing import current_traceparent
 
                 try:
-                    self.registry.watch_hub().note_commit_trace(
+                    scope.watch_hub().note_commit_trace(
                         int(result.snaptoken), current_traceparent()
                     )
                 except Exception:
@@ -505,11 +540,12 @@ class ListService:
         sub = _subject_from_request(request)
         if sub is None:
             raise ErrBadRequest("Subject has to be specified.")
+        scope = _scope_from(self.registry, context)
         at_least, latest = self._consistency(request)
-        rep = self.registry.replica_controller()
+        rep = scope.replica_controller()
         if rep is not None:
             rep.gate_read(at_least, latest)
-        objs, nxt, token = self.registry.list_engine().page_objects(
+        objs, nxt, token = scope.list_engine().page_objects(
             ns, rel, sub,
             page_size=int(request.get("page_size", 0) or 0),
             page_token=str(request.get("page_token", "") or ""),
@@ -527,11 +563,12 @@ class ListService:
             raise ErrBadRequest("object has to be specified")
         if not rel:
             raise ErrBadRequest("relation has to be specified")
+        scope = _scope_from(self.registry, context)
         at_least, latest = self._consistency(request)
-        rep = self.registry.replica_controller()
+        rep = scope.replica_controller()
         if rep is not None:
             rep.gate_read(at_least, latest)
-        subs, nxt, token = self.registry.list_engine().page_subjects(
+        subs, nxt, token = scope.list_engine().page_subjects(
             ns, obj, rel,
             page_size=int(request.get("page_size", 0) or 0),
             page_token=str(request.get("page_token", "") or ""),
@@ -601,7 +638,7 @@ class WatchService:
         self.registry = registry
 
     def Watch(self, request, context):
-        hub = self.registry.watch_hub()
+        hub = _scope_from(self.registry, context).watch_hub()
         raw = str(request.get("snaptoken", "") or "0")
         try:
             since = int(raw)

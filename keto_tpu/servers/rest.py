@@ -44,6 +44,14 @@ Retry-After** (keto_tpu/driver/batch.py). Every overload response (429,
 and 503 while NOT_SERVING) carries a ``Retry-After`` header with the
 server's backoff advice.
 
+Multi-tenant serving: an ``X-Keto-Tenant`` header scopes the request to
+one tenant's engine, batcher, store view, and watch hub (the TenantPool,
+keto_tpu/driver/tenants.py). Absent header → the default tenant, which
+IS the pre-tenancy registry — every existing contract (snaptokens,
+replica gating, idempotency, watch) is untouched. Tenant-scoped sheds
+echo the tenant on ``X-Keto-Tenant`` so clients can attribute 429s, and
+Retry-After reflects THAT tenant's overload run, not the machine's.
+
 Request correlation: every non-health request gets (or echoes) an
 ``X-Request-Id``, joins the caller's trace when a W3C ``traceparent``
 header is present, and binds both ids into the logging context
@@ -107,6 +115,11 @@ def _error_headers(err: KetoError) -> dict[str, str]:
     wm = (getattr(err, "details", None) or {}).get("watermark")
     if wm is not None:
         out["X-Keto-Watermark"] = str(wm)
+    # tenant-scoped sheds name the tenant: a client multiplexing many
+    # tenants over one pool attributes the 429 without parsing the body
+    tn = (getattr(err, "details", None) or {}).get("tenant")
+    if tn:
+        out["X-Keto-Tenant"] = str(tn)
     return out
 
 
@@ -197,6 +210,7 @@ class RestApp:
                 else recorder.begin(
                     f"{method} {route}", trace_id=trace_id,
                     request_id=req_id, surface="http",
+                    tenant=(hdrs.get("x-keto-tenant") or "").strip() or "default",
                 )
             )
             with request_context(request_id=req_id, trace_id=trace_id):
@@ -269,15 +283,15 @@ class RestApp:
                 if route == ("POST", "/check/batch"):
                     return self._post_check_batch(body, query, headers)
                 if route == ("GET", "/expand"):
-                    return self._get_expand(query)
+                    return self._get_expand(query, headers)
                 if route == ("GET", "/relation-tuples"):
-                    return self._get_relation_tuples(query)
+                    return self._get_relation_tuples(query, headers)
                 if route == ("GET", "/relation-tuples/list-objects"):
                     return self._get_list_objects(query, headers)
                 if route == ("GET", "/relation-tuples/list-subjects"):
                     return self._get_list_subjects(query, headers)
                 if route == ("GET", "/watch"):
-                    return self._get_watch(query)
+                    return self._get_watch(query, headers)
                 if route == ("GET", "/snapshot/export"):
                     return self._get_snapshot_export(query)
             else:
@@ -339,15 +353,17 @@ class RestApp:
     def _get_debug_requests(self, query):
         """``GET /debug/requests`` — recent + top-K-slowest request
         timelines from the bounded ring (keto_tpu/x/timeline.py),
-        filterable by ``?trace_id=`` and ``?snaptoken=``; ``?n=`` /
-        ``?slowest=`` bound the result sizes. On a replica the body also
-        carries the per-commit replication timelines."""
+        filterable by ``?trace_id=``, ``?snaptoken=``, and ``?tenant=``
+        (noisy-neighbor forensics: one tenant's requests, isolated);
+        ``?n=`` / ``?slowest=`` bound the result sizes. On a replica the
+        body also carries the per-commit replication timelines."""
         rec = self.registry.timeline_recorder()
         body = rec.snapshot(
             recent=self._int_param(query, "n", 50),
             slowest=self._int_param(query, "slowest", 20),
             trace_id=(query.get("trace_id") or [""])[0] or None,
             snaptoken=(query.get("snaptoken") or [""])[0] or None,
+            tenant=(query.get("tenant") or [""])[0] or None,
         )
         rep = self.registry.replica_controller()
         if rep is not None:
@@ -505,6 +521,7 @@ class RestApp:
             body = {"status": "unavailable", "reason": reason or state.value}
             self._add_replica_health(body)
             self._add_fleet_health(body)
+            self._add_tenant_health(body)
             # backoff advice rides the 503: probes already poll on their
             # own period, but ad-hoc clients should not hammer a server
             # that just told them its snapshot is stale
@@ -513,6 +530,7 @@ class RestApp:
             body = {"status": "ok"}
             self._add_replica_health(body)
             self._add_fleet_health(body)
+            self._add_tenant_health(body)
             return 200, body, {}
         body = {"status": state.value}
         if reason:
@@ -524,7 +542,25 @@ class RestApp:
             body.update(monitor.starting_detail())
         self._add_replica_health(body)
         self._add_fleet_health(body)
+        self._add_tenant_health(body)
         return 200, body, {}
+
+    def _add_tenant_health(self, body: dict) -> None:
+        """Per-tenant health rides readiness WITHOUT flipping it: a
+        ``DEGRADED(tenant=…)`` reason names the hurting tenant so its
+        operator can act, while every other tenant's traffic — and the
+        machine-level status the probes act on — stays untouched."""
+        pool = self.registry.peek("tenants")
+        if pool is None:
+            return
+        out = {
+            "known": pool.known_count(),
+            "resident": pool.resident_count(),
+        }
+        degraded = pool.degraded()
+        if degraded:
+            out["degraded"] = degraded
+        body["tenants"] = out
 
     def _add_replica_health(self, body: dict) -> None:
         """On a replica, every readiness answer carries the replication
@@ -541,6 +577,40 @@ class RestApp:
                 "primary_connected": rep.primary_connected,
             }
         )
+
+    # -- tenancy --------------------------------------------------------------
+
+    @staticmethod
+    def _tenant_from(headers) -> str:
+        """The validated tenant id the request addressed: the
+        ``X-Keto-Tenant`` header, absent/blank → the default tenant;
+        a malformed id is a 400."""
+        from keto_tpu.driver.tenants import validate_tenant_id
+
+        return validate_tenant_id((headers or {}).get("x-keto-tenant", ""))
+
+    def _scope(self, headers):
+        """The registry-shaped object serving this request: the registry
+        itself for the default tenant (every pre-tenancy contract stays
+        byte-identical), or the tenant's pool context — its own engine,
+        batcher, store view, and watch hub — when ``X-Keto-Tenant``
+        addresses another tenant. Tenant-scoped requests are primary-only
+        (replicas mirror only the default tenant's state) and gated on
+        ``serve.tenant_enabled``."""
+        from keto_tpu.driver.tenants import DEFAULT_TENANT
+
+        tenant = self._tenant_from(headers)
+        if tenant == DEFAULT_TENANT:
+            return self.registry
+        if not bool(self.registry.config().get("serve.tenant_enabled", True)):
+            raise ErrBadRequest(
+                "multi-tenant serving is disabled (serve.tenant_enabled)"
+            )
+        if self.registry.is_replica():
+            raise ErrBadRequest(
+                "tenant-scoped requests are served by the primary only"
+            )
+        return self.registry.tenant_pool().get(tenant)
 
     # -- read ----------------------------------------------------------------
 
@@ -592,11 +662,12 @@ class RestApp:
         return at_least, latest
 
     def _check(self, tuple_: RelationTuple, query, headers=None):
+        scope = self._scope(headers)
         at_least, latest = self._consistency_from(query)
         # replica mode: admit the pin against the applied watermark
         # (block-then-412 above it), then try the Watch-invalidated
         # check cache before paying a device dispatch
-        rep = self.registry.replica_controller()
+        rep = scope.replica_controller()
         cache = rep.checkcache if rep is not None else None
         key = None
         if rep is not None:
@@ -619,7 +690,7 @@ class RestApp:
                             "X-Keto-Checkcache": "hit",
                         },
                     )
-        allowed, token = self.registry.check_batcher().check_with_token(
+        allowed, token = scope.check_batcher().check_with_token(
             tuple_, at_least=at_least, latest=latest,
             deadline=self._deadline_from(query, headers),
             lane=self._lane_from(headers),
@@ -650,8 +721,9 @@ class RestApp:
         and dispatch in bounded sub-slices, so they never convoy
         interactive checks; shed with 429 + Retry-After past the
         admission window."""
+        scope = self._scope(headers)
         lane_hint = self._lane_from(headers)
-        batcher = self.registry.check_batcher()
+        batcher = scope.check_batcher()
         if lane_hint != "interactive":
             # pre-parse shed: an over-window batch lane refuses BEFORE
             # paying the JSON decode — during a brownout the 429s must
@@ -671,7 +743,7 @@ class RestApp:
             )
         tuples = [RelationTuple.from_json(t) for t in raw]
         at_least, latest = self._consistency_from(query)
-        rep = self.registry.replica_controller()
+        rep = scope.replica_controller()
         if rep is not None:
             rep.gate_read(at_least, latest)
         results, token = batcher.check_batch_with_token(
@@ -682,30 +754,32 @@ class RestApp:
         resp_headers = {} if token is None else {"X-Keto-Snaptoken": str(token)}
         return 200, {"results": [bool(r) for r in results]}, resp_headers
 
-    def _get_expand(self, query):
+    def _get_expand(self, query, headers=None):
         # the reference parses max-depth unconditionally — absent/invalid
         # is a 400 (tests/test_rest_api.py asserts this). An explicit 0
         # means "use the configured limit.max_read_depth", matching the
         # gRPC path where 0 is the proto default for an omitted field.
+        scope = self._scope(headers)
         raw_depth = (query.get("max-depth") or [""])[0]
         try:
             depth = int(raw_depth)
         except ValueError:
             raise ErrBadRequest(f"invalid max-depth {raw_depth!r}") from None
         subject = subject_set_from_url_query(query)
-        rep = self.registry.replica_controller()
+        rep = scope.replica_controller()
         if rep is not None:
             rep.gate_read(None)  # 503 until the first bootstrap lands
-        tree = self.registry.expand_engine().build_tree(
-            subject, self.registry.expand_depth(depth)
+        tree = scope.expand_engine().build_tree(
+            subject, scope.expand_depth(depth)
         )
         if tree is None:
             return 200, None, {}
         return 200, tree.to_json(), {}
 
-    def _get_relation_tuples(self, query):
+    def _get_relation_tuples(self, query, headers=None):
+        scope = self._scope(headers)
         rq = RelationQuery.from_url_query(query)
-        rep = self.registry.replica_controller()
+        rep = scope.replica_controller()
         if rep is not None:
             rep.gate_read(None)  # 503 until the first bootstrap lands
         opts = []
@@ -718,7 +792,7 @@ class RestApp:
                 opts.append(with_size(int(raw_size)))
             except ValueError:
                 raise ErrBadRequest(f"invalid page_size {raw_size!r}") from None
-        rels, next_page = self.registry.relation_tuple_manager().get_relation_tuples(rq, *opts)
+        rels, next_page = scope.relation_tuple_manager().get_relation_tuples(rq, *opts)
         return (
             200,
             {
@@ -758,12 +832,13 @@ class RestApp:
         sub = rq.subject
         if sub is None:
             raise ErrBadRequest("Subject has to be specified.")
+        scope = self._scope(headers)
         at_least, latest = self._consistency_from(query)
-        rep = self.registry.replica_controller()
+        rep = scope.replica_controller()
         if rep is not None:
             rep.gate_read(at_least, latest)
         size, token = self._page_opts(query)
-        objs, nxt, snaptoken = self.registry.list_engine().page_objects(
+        objs, nxt, snaptoken = scope.list_engine().page_objects(
             rq.namespace, rq.relation, sub,
             page_size=size, page_token=token, at_least=at_least, latest=latest,
         )
@@ -783,12 +858,13 @@ class RestApp:
             raise ErrBadRequest("object has to be specified")
         if rq.relation == "":
             raise ErrBadRequest("relation has to be specified")
+        scope = self._scope(headers)
         at_least, latest = self._consistency_from(query)
-        rep = self.registry.replica_controller()
+        rep = scope.replica_controller()
         if rep is not None:
             rep.gate_read(at_least, latest)
         size, token = self._page_opts(query)
-        subs, nxt, snaptoken = self.registry.list_engine().page_subjects(
+        subs, nxt, snaptoken = scope.list_engine().page_subjects(
             rq.namespace, rq.object, rq.relation,
             page_size=size, page_token=token, at_least=at_least, latest=latest,
         )
@@ -802,14 +878,14 @@ class RestApp:
             {"X-Keto-Snaptoken": str(snaptoken)},
         )
 
-    def _get_watch(self, query):
+    def _get_watch(self, query, headers=None):
         """``GET /watch?snaptoken=N`` — chunked ndjson changefeed: one
         line per committed transaction, ``{"snaptoken", "changes":
         [{"action", "relation_tuple"}]}``, resumable from any retained
         snaptoken (410 past the horizon), ended by server drain."""
         from keto_tpu.x.errors import ErrTooManyRequests
 
-        hub = self.registry.watch_hub()
+        hub = self._scope(headers).watch_hub()
         raw = (query.get("snaptoken") or [""])[0] or "0"
         try:
             since = int(raw)
@@ -854,7 +930,7 @@ class RestApp:
             return None
         return headers.get("x-idempotency-key") or None
 
-    def _note_commit(self, result) -> None:
+    def _note_commit(self, result, scope=None) -> None:
         """Register the committed transaction's trace context with the
         watch hub (replication-aware tracing): the commit group emitted
         at this snaptoken will carry the writer's traceparent, so one
@@ -866,7 +942,7 @@ class RestApp:
         if token is None:
             return
         try:
-            self.registry.watch_hub().note_commit_trace(
+            (scope or self.registry).watch_hub().note_commit_trace(
                 int(token), current_traceparent()
             )
         except Exception:
@@ -895,20 +971,22 @@ class RestApp:
         # routed through the group-commit coordinator when enabled (one
         # durable transaction per batch of concurrent writers, same
         # per-writer snaptoken/replay semantics)
-        result = self.registry.transact_writes()(
+        scope = self._scope(headers)
+        result = scope.transact_writes()(
             [rel], (), idempotency_key=self._idempotency_key_from(headers)
         )
-        self._note_commit(result)
+        self._note_commit(result, scope)
         resp = {"Location": "/relation-tuples?" + rel.to_url_query()}
         resp.update(self._write_headers(result))
         return 201, rel.to_json(), resp
 
     def _delete_relation_tuple(self, query, headers=None):
         rel = RelationTuple.from_url_query(query)
-        result = self.registry.transact_writes()(
+        scope = self._scope(headers)
+        result = scope.transact_writes()(
             (), [rel], idempotency_key=self._idempotency_key_from(headers)
         )
-        self._note_commit(result)
+        self._note_commit(result, scope)
         return 204, None, self._write_headers(result)
 
     def _patch_relation_tuples(self, body: bytes, headers=None):
@@ -930,10 +1008,11 @@ class RestApp:
                 delete.append(RelationTuple.from_json(raw))
             else:
                 raise ErrBadRequest(f"unknown action {action}")
-        result = self.registry.transact_writes()(
+        scope = self._scope(headers)
+        result = scope.transact_writes()(
             insert, delete, idempotency_key=self._idempotency_key_from(headers)
         )
-        self._note_commit(result)
+        self._note_commit(result, scope)
         return 204, None, self._write_headers(result)
 
 

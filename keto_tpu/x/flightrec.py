@@ -57,7 +57,7 @@ REQUIRED_KEYS = ("schema", "reason", "detail", "created_unix", "pid",
 #: the serialized bundle fits — biggest/least-essential first, so the
 #: health picture and the timelines survive the longest
 SHED_ORDER = ("metrics", "lockwatch", "watch", "replica", "slo",
-              "batcher", "hbm", "timelines")
+              "tenants", "batcher", "hbm", "timelines")
 
 
 def validate_bundle(bundle: dict) -> list[str]:

@@ -75,8 +75,8 @@ class Timeline:
 
     __slots__ = (
         "kind", "surface", "trace_id", "parent_span_id", "request_id",
-        "status", "snaptoken", "start_unix", "_t0", "stamps", "truncated",
-        "total_ms",
+        "tenant", "status", "snaptoken", "start_unix", "_t0", "stamps",
+        "truncated", "total_ms",
     )
 
     def __init__(
@@ -86,12 +86,16 @@ class Timeline:
         request_id: str = "",
         surface: str = "http",
         parent_span_id: str = "",
+        tenant: str = "",
     ):
         self.kind = kind
         self.surface = surface
         self.trace_id = trace_id
         self.parent_span_id = parent_span_id
         self.request_id = request_id
+        #: the tenant the request addressed (multi-tenant mode) — "" on
+        #: pre-tenancy surfaces; forensic bundles attribute blame by it
+        self.tenant = tenant
         self.status: Any = None
         self.snaptoken: Optional[str] = None
         self.start_unix = time.time()
@@ -119,6 +123,7 @@ class Timeline:
             "surface": self.surface,
             "trace_id": self.trace_id,
             "request_id": self.request_id,
+            "tenant": self.tenant,
             "status": self.status,
             "snaptoken": self.snaptoken,
             "start_unix": round(self.start_unix, 6),
@@ -183,6 +188,7 @@ class TimelineRecorder:
         trace_id: str = "",
         request_id: str = "",
         surface: str = "http",
+        tenant: str = "",
     ) -> Optional[Timeline]:
         """A new timeline with its arrival stamp, or None when disabled.
         Called inside the request's server span so the child spans
@@ -198,7 +204,7 @@ class TimelineRecorder:
             parent = ids[1]
         tl = Timeline(
             kind, trace_id=trace_id, request_id=request_id, surface=surface,
-            parent_span_id=parent,
+            parent_span_id=parent, tenant=tenant,
         )
         tl.stamp("arrival")
         return tl
@@ -304,9 +310,11 @@ class TimelineRecorder:
         slowest: int = 20,
         trace_id: Optional[str] = None,
         snaptoken: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> dict:
         """The /debug/requests body: newest-first recent timelines and
-        the top-K slowest, both filterable by trace id / snaptoken."""
+        the top-K slowest, filterable by trace id / snaptoken / tenant
+        (noisy-neighbor forensics: one tenant's requests, isolated)."""
         with self._lock:
             ring = list(self._ring)
             slow = sorted(self._slow, key=lambda e: -e[0])
@@ -316,6 +324,8 @@ class TimelineRecorder:
             if trace_id and tl.trace_id != trace_id:
                 return False
             if snaptoken and tl.snaptoken != str(snaptoken):
+                return False
+            if tenant and tl.tenant != tenant:
                 return False
             return True
 

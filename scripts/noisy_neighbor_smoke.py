@@ -1,0 +1,418 @@
+"""noisy-neighbor-smoke: the CI gate on multi-tenant isolation.
+
+Boots a REAL daemon (REST read+write on ephemeral ports), seeds 64
+tenants (1000 with ``BENCH_NN_TENANTS=1000``), then lets one aggressor
+tenant storm the batch lane at ~10× its admitted quota while a victim
+tenant keeps issuing interactive checks — and asserts the properties
+docs/concepts/multitenancy.md promises:
+
+1. the victim's interactive p99 under the storm stays within 2× of its
+   uncontended p99 (plus a small absolute floor for 1–2 core runners),
+   and the victim is NEVER shed — quota is per tenant, not global;
+2. the aggressor sheds (nonzero per-tenant 429s) and EVERY shed carries
+   ``Retry-After`` backoff advice and the ``X-Keto-Tenant`` header
+   naming the aggressor;
+3. a cold tenant (seeded, then evicted by the residency cap) answers
+   its first check in < 500 ms — the snapcache fault-in path, not a
+   rebuild;
+4. the residency ledger reconciles at scrape time: /metrics'
+   ``keto_tenant_*`` families agree with the pool's own accounting and
+   the resident count respects ``serve.tenant_max_resident``;
+5. under ``KETO_TPU_SANITIZE=1`` the whole storm ran on instrumented
+   locks: zero lock-order inversions, zero watchdog trips.
+
+Exit 0 when all hold; 1 with the violations listed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_TENANTS = int(os.environ.get("BENCH_NN_TENANTS", "64"))
+STORM_S = float(os.environ.get("BENCH_NN_STORM_S", "4.0"))
+AGGRESSOR_THREADS = int(os.environ.get("BENCH_NN_AGGRESSOR_THREADS", "4"))
+MAX_RESIDENT = 8
+PROBES = 150
+
+
+def log(msg: str) -> None:
+    print(msg, flush=True)
+
+
+def _req(port, method, path, body=None, tenant=None):
+    """(status, parsed body or None, headers dict)."""
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        r.add_header("Content-Type", "application/json")
+    if tenant:
+        r.add_header("X-Keto-Tenant", tenant)
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None, dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            return e.code, json.loads(raw) if raw else None, dict(e.headers)
+        except json.JSONDecodeError:
+            return e.code, None, dict(e.headers)
+
+
+def _p99(samples_s: list[float]) -> float:
+    vals = sorted(samples_s)
+    return vals[min(len(vals) - 1, int(len(vals) * 0.99))]
+
+
+def _probe(read_port, tenant, n=PROBES) -> tuple[list[float], int]:
+    """n interactive checks for the tenant's own grant; returns
+    (latencies, sheds). Every answer must be 200/allowed — a 429 here is
+    a quota-isolation failure, anything else a correctness failure."""
+    lat, sheds = [], 0
+    for _ in range(n):
+        t0 = time.monotonic()
+        status, body, _ = _req(
+            read_port, "GET",
+            f"/check?namespace=files&object=doc-{tenant}&relation=view"
+            f"&subject_id=user-{tenant}",
+            tenant=tenant,
+        )
+        lat.append(time.monotonic() - t0)
+        if status == 429:
+            sheds += 1
+        elif status != 200 or not (body or {}).get("allowed"):
+            raise AssertionError(f"victim probe broke: {status} {body}")
+    return lat, sheds
+
+
+def main() -> int:
+    from keto_tpu.config.provider import Config
+    from keto_tpu.driver.daemon import Daemon
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        overrides={
+            "namespaces": [{"id": 0, "name": "files"}, {"id": 1, "name": "groups"}],
+            "dsn": "memory",
+            "serve.read.port": 0,
+            "serve.write.port": 0,
+            # small shapes so the aggressor actually overruns ITS quota
+            # on a CPU runner: per-tenant queue = max(64, 8*64*0.25)=128
+            # tuples, admission window floors at 64
+            "engine.batch_size": 64,
+            "serve.tenant_max_resident": MAX_RESIDENT,
+        }
+    )
+    reg = Registry(cfg)
+    daemon = Daemon(reg)
+    daemon.serve_all(block=False)
+    problems: list[str] = []
+    try:
+        read, write = daemon.read_port, daemon.write_port
+        pool = reg.tenant_pool()
+
+        # -- seed one grant per tenant (every tenant beyond the cap goes
+        # cold again as later fault-ins evict it — that's the point),
+        # plus one on the default surface: its device engine is where
+        # the injected device-exec faults land
+        tenants = [f"t-{i}" for i in range(N_TENANTS)]
+        victim, aggressor = "victim", "aggressor"
+        # the default graph contains a group CYCLE (g1 <-> g2): cyclic
+        # interior rows cannot be host-peeled, so these checks genuinely
+        # dispatch device slices — the rows the injected faults hit
+        default_seed = [
+            {"namespace": "files", "object": "doc-default", "relation": "view",
+             "subject_set": {"namespace": "groups", "object": "g1",
+                             "relation": "member"}},
+            {"namespace": "groups", "object": "g1", "relation": "member",
+             "subject_set": {"namespace": "groups", "object": "g2",
+                             "relation": "member"}},
+            {"namespace": "groups", "object": "g2", "relation": "member",
+             "subject_set": {"namespace": "groups", "object": "g1",
+                             "relation": "member"}},
+            {"namespace": "groups", "object": "g2", "relation": "member",
+             "subject_id": "user-default"},
+        ]
+        for body_t in default_seed:
+            status, body, _ = _req(write, "PUT", "/relation-tuples", body_t)
+            if status != 201:
+                raise AssertionError(f"default seed PUT -> {status}: {body}")
+        t0 = time.monotonic()
+        for t in [victim, aggressor] + tenants:
+            status, body, _ = _req(
+                write, "PUT", "/relation-tuples",
+                {"namespace": "files", "object": f"doc-{t}", "relation": "view",
+                 "subject_id": f"user-{t}"},
+                tenant=t,
+            )
+            if status != 201:
+                problems.append(f"seed PUT for {t} -> {status}: {body}")
+                raise AssertionError(problems[-1])
+        log(
+            f"[nn] seeded {N_TENANTS + 2} tenants in "
+            f"{time.monotonic() - t0:.1f}s (resident cap {MAX_RESIDENT})"
+        )
+
+        # -- phase 1a: walk checks across more tenants than the cap so
+        # the tenant-LRU rung actually evicts (whole tenants, coldest
+        # first) before the storm starts
+        for t in tenants[: MAX_RESIDENT + 4]:
+            status, body, _ = _req(
+                read, "GET",
+                f"/check?namespace=files&object=doc-{t}&relation=view"
+                f"&subject_id=user-{t}",
+                tenant=t,
+            )
+            if status != 200 or not body.get("allowed"):
+                problems.append(f"warm-up check for {t} -> {status}: {body}")
+        if pool.evictions < 1:
+            problems.append(
+                f"{MAX_RESIDENT + 4} fault-ins at cap {MAX_RESIDENT} caused "
+                "zero tenant-LRU evictions"
+            )
+
+        # -- phase 1b: uncontended victim baseline
+        unc_lat, unc_sheds = _probe(read, victim)
+        unc_p99 = _p99(unc_lat)
+        log(
+            f"[nn] uncontended victim p99 {unc_p99 * 1e3:.1f} ms "
+            f"(warm-up evictions {pool.evictions})"
+        )
+
+        # -- phase 2: aggressor storms the batch lane at ~10× its quota
+        # while the victim keeps probing
+        stop = threading.Event()
+        shed_stats = {"sheds": 0, "ok": 0, "bad_headers": []}
+        shed_lock = threading.Lock()
+        batch_body = {
+            "tuples": [
+                {"namespace": "files", "object": f"doc-{aggressor}",
+                 "relation": "view", "subject_id": f"user-{aggressor}"}
+            ] * 256  # 2× the whole per-tenant queue, per request
+        }
+
+        small_body = {
+            "tuples": [
+                {"namespace": "files", "object": f"doc-{aggressor}",
+                 "relation": "view", "subject_id": f"user-{aggressor}"}
+            ] * 16  # fits the admitted window: the aggressor still gets
+        }          # service at its quota, the EXCESS is what sheds
+
+        def storm():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                status, _, headers = _req(
+                    read, "POST", "/check/batch",
+                    small_body if i % 5 == 0 else batch_body, tenant=aggressor,
+                )
+                with shed_lock:
+                    if status == 429:
+                        shed_stats["sheds"] += 1
+                        if not headers.get("Retry-After"):
+                            shed_stats["bad_headers"].append("missing Retry-After")
+                        if headers.get("X-Keto-Tenant") != aggressor:
+                            shed_stats["bad_headers"].append(
+                                f"X-Keto-Tenant={headers.get('X-Keto-Tenant')!r}"
+                            )
+                    elif status == 200:
+                        shed_stats["ok"] += 1
+
+        # a third lane of chaos: device-exec faults injected into the
+        # default engine's dispatch mid-storm — the contained CPU
+        # fallback must keep every default-surface answer right while
+        # the victims' p99 stays flat
+        from keto_tpu.x import faults
+
+        fault_stats = {"checks": 0, "wrong": 0}
+
+        def default_churn():
+            while not stop.is_set():
+                status, body, _ = _req(
+                    read, "GET",
+                    "/check?namespace=files&object=doc-default&relation=view"
+                    "&subject_id=user-default",
+                )
+                with shed_lock:
+                    fault_stats["checks"] += 1
+                    if status != 200 or not (body or {}).get("allowed"):
+                        fault_stats["wrong"] += 1
+                time.sleep(0.01)
+
+        workers = [
+            threading.Thread(target=storm, daemon=True)
+            for _ in range(AGGRESSOR_THREADS)
+        ] + [threading.Thread(target=default_churn, daemon=True)]
+        for w in workers:
+            w.start()
+        deadline = time.monotonic() + STORM_S
+        con_lat: list[float] = []
+        con_sheds = 0
+        armed = False
+        while time.monotonic() < deadline:
+            if not armed and time.monotonic() > deadline - 0.75 * STORM_S:
+                faults.inject("device-exec", count=25)
+                armed = True
+            lat, sheds = _probe(read, victim, n=20)
+            con_lat.extend(lat)
+            con_sheds += sheds
+        faults.clear("device-exec")
+        stop.set()
+        for w in workers:
+            w.join(timeout=30)
+            if w.is_alive():
+                problems.append("aggressor worker failed to join (hang)")
+        con_p99 = _p99(con_lat)
+        log(
+            f"[nn] storm: victim p99 {con_p99 * 1e3:.1f} ms "
+            f"({len(con_lat)} probes, {con_sheds} sheds), aggressor "
+            f"{shed_stats['sheds']} sheds / {shed_stats['ok']} served"
+        )
+
+        # (1) victim isolation: never shed, p99 within 2× (+100 ms
+        # absolute floor so 1-core runner jitter can't flake the gate)
+        if con_sheds:
+            problems.append(f"victim was shed {con_sheds}× during the storm")
+        limit = max(2.0 * unc_p99, unc_p99 + 0.100)
+        if con_p99 > limit:
+            problems.append(
+                f"victim p99 {con_p99 * 1e3:.1f} ms exceeds "
+                f"{limit * 1e3:.1f} ms (2x uncontended {unc_p99 * 1e3:.1f} ms)"
+            )
+        # (2) the aggressor actually overran its quota and was told how
+        # long to back off, with its name on every refusal
+        if shed_stats["sheds"] == 0:
+            problems.append("aggressor was never shed at 10x — no quota engaged")
+        if shed_stats["ok"] == 0:
+            problems.append(
+                "aggressor got ZERO service — quota should shed the excess, "
+                "not starve the tenant"
+            )
+        if shed_stats["bad_headers"]:
+            problems.append(
+                f"{len(shed_stats['bad_headers'])} shed responses malformed: "
+                f"{shed_stats['bad_headers'][:3]}"
+            )
+        # the injected device-exec faults actually fired AND every
+        # default-surface answer stayed right through the fallback
+        if faults.hits("device-exec") == 0:
+            problems.append("device-exec fault was armed but never fired")
+        if fault_stats["checks"] == 0:
+            problems.append("default-surface churn never ran during the storm")
+        if fault_stats["wrong"]:
+            problems.append(
+                f"{fault_stats['wrong']}/{fault_stats['checks']} default-surface "
+                "answers wrong under injected device-exec faults"
+            )
+        log(
+            f"[nn] fault phase: {faults.hits('device-exec')} device-exec fires, "
+            f"{fault_stats['checks']} default checks, {fault_stats['wrong']} wrong"
+        )
+
+        # (3) cold-tenant first touch: a tenant evicted by the cap
+        # faults back in under 500 ms
+        cold = next(
+            (t for t in tenants if not (pool.peek(t) and pool.peek(t).resident)),
+            None,
+        )
+        if cold is None:
+            problems.append("no cold tenant after the storm (cap never engaged?)")
+        else:
+            t0 = time.monotonic()
+            status, body, _ = _req(
+                read, "GET",
+                f"/check?namespace=files&object=doc-{cold}&relation=view"
+                f"&subject_id=user-{cold}",
+                tenant=cold,
+            )
+            cold_ms = (time.monotonic() - t0) * 1e3
+            if status != 200 or not body.get("allowed"):
+                problems.append(f"cold tenant {cold} wrong answer: {status} {body}")
+            if cold_ms > 500:
+                problems.append(f"cold-tenant first check took {cold_ms:.0f} ms (> 500)")
+            log(f"[nn] cold tenant {cold} first check {cold_ms:.1f} ms")
+
+        # (4) ledger reconciles at scrape
+        status, _, _ = _req(read, "GET", "/health/ready")
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{read}/metrics", timeout=30
+        ).read().decode()
+        metrics = {}
+        for line in raw.splitlines():
+            if line.startswith("keto_tenant_"):
+                name, _, val = line.rpartition(" ")
+                metrics[name] = float(val)
+        known = metrics.get("keto_tenant_known")
+        resident = metrics.get("keto_tenant_resident")
+        if known != float(pool.known_count()):
+            problems.append(f"keto_tenant_known {known} != pool {pool.known_count()}")
+        if resident != float(pool.resident_count()):
+            problems.append(
+                f"keto_tenant_resident {resident} != pool {pool.resident_count()}"
+            )
+        if pool.resident_count() > MAX_RESIDENT:
+            problems.append(
+                f"{pool.resident_count()} tenants resident, cap {MAX_RESIDENT}"
+            )
+        agg_shed = metrics.get(f'keto_tenant_shed_total{{tenant="{aggressor}"}}', 0.0)
+        if agg_shed < 1:
+            problems.append("keto_tenant_shed_total missing the aggressor's sheds")
+        victim_shed = metrics.get(f'keto_tenant_shed_total{{tenant="{victim}"}}', 0.0)
+        if victim_shed:
+            problems.append(f"victim shows {victim_shed} sheds on the ledger")
+        ledger_sum = sum(pool.ledger().values())
+        scraped_sum = sum(
+            v for k, v in metrics.items() if k.startswith("keto_tenant_resident_bytes")
+        )
+        if scraped_sum != float(ledger_sum):
+            problems.append(
+                f"resident_bytes scrape {scraped_sum} != ledger {ledger_sum}"
+            )
+        log(
+            f"[nn] ledger: known={known:.0f} resident={resident:.0f} "
+            f"evictions={pool.evictions} faultins={pool.faultins} "
+            f"aggressor_sheds={agg_shed:.0f}"
+        )
+
+        # (5) sanitizer, when on for the job
+        from keto_tpu.x import lockwatch
+
+        if lockwatch.installed():
+            problems.extend(lockwatch.violations())
+            rep = lockwatch.report()
+            log(
+                f"[nn] lockwatch: {rep['acquires']} acquires, "
+                f"{len(rep['inversions'])} inversions, "
+                f"{len(rep['watchdog_trips'])} watchdog trips"
+            )
+    finally:
+        daemon.shutdown()
+
+    if problems:
+        log("noisy-neighbor-smoke FAILED:")
+        for p in problems:
+            log(f"  - {p}")
+        return 1
+    log(
+        f"noisy-neighbor-smoke OK: {N_TENANTS + 2} tenants, victim p99 "
+        f"within bounds under the 10x storm, per-tenant sheds with "
+        "Retry-After, cold fault-in < 500 ms, ledger reconciled"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -239,3 +239,57 @@ def test_grpc_error_mapping(channels):
             check_service_pb2.CheckResponse,
         )
     assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def _unary_md(channel, method, req, resp_cls, metadata):
+    resp, call = channel.unary_unary(
+        method,
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString,
+    ).with_call(req, metadata=metadata)
+    return resp, call
+
+
+def test_tenant_metadata_scopes_and_isolates(channels):
+    """x-keto-tenant metadata (the gRPC face of X-Keto-Tenant): a
+    tenant's writes are visible to its own checks only — never to other
+    tenants or the default surface — and a malformed tenant id aborts
+    INVALID_ARGUMENT before any engine work."""
+    read, write = channels
+    md = (("x-keto-tenant", "grpc-acme"),)
+    deltas = [
+        write_service_pb2.RelationTupleDelta(
+            action=write_service_pb2.RelationTupleDelta.INSERT,
+            relation_tuple=T("videos", "tenant-vid", "view", sub_id="tina"),
+        )
+    ]
+    resp, _ = _unary_md(
+        write,
+        "/ory.keto.acl.v1alpha1.WriteService/TransactRelationTuples",
+        write_service_pb2.TransactRelationTuplesRequest(relation_tuple_deltas=deltas),
+        write_service_pb2.TransactRelationTuplesResponse,
+        md,
+    )
+    assert len(resp.snaptokens) == 1
+
+    check_req = check_service_pb2.CheckRequest(
+        namespace="videos", object="tenant-vid", relation="view",
+        subject=acl_pb2.Subject(id="tina"),
+    )
+    call = "/ory.keto.acl.v1alpha1.CheckService/Check"
+    resp, _ = _unary_md(read, call, check_req, check_service_pb2.CheckResponse, md)
+    assert resp.allowed is True
+    resp, _ = _unary_md(
+        read, call, check_req, check_service_pb2.CheckResponse,
+        (("x-keto-tenant", "grpc-rival"),),
+    )
+    assert resp.allowed is False
+    resp = _unary(read, call, check_req, check_service_pb2.CheckResponse)
+    assert resp.allowed is False
+
+    with pytest.raises(grpc.RpcError) as e:
+        _unary_md(
+            read, call, check_req, check_service_pb2.CheckResponse,
+            (("x-keto-tenant", "not/valid"),),
+        )
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT

@@ -538,3 +538,70 @@ def test_server_timing_header_conforms(daemon):
         parts = [p.strip() for p in st.split(",")]
         assert all(entry.match(p) for p in parts), st
         assert parts[-1].startswith("total;dur=")
+
+
+def test_tenant_header_declared_on_all_tenant_routes():
+    """Every tenant-scopable route declares the X-Keto-Tenant request
+    header, and every declared 429 response declares the X-Keto-Tenant
+    response header (a shed must name the tenant it shed for)."""
+    tenant_routes = (
+        "/check", "/check/batch", "/expand", "/relation-tuples",
+        "/relation-tuples/list-objects", "/relation-tuples/list-subjects",
+        "/watch",
+    )
+    for path in tenant_routes:
+        for method, op in SPEC["paths"][path].items():
+            assert any(
+                p.get("name") == "X-Keto-Tenant" for p in op.get("parameters", [])
+            ), f"{method.upper()} {path} does not declare X-Keto-Tenant"
+    for path, ops in SPEC["paths"].items():
+        for method, op in ops.items():
+            resp = op.get("responses", {}).get("429")
+            if resp is None:
+                continue
+            assert "X-Keto-Tenant" in resp.get("headers", {}), (
+                f"{method.upper()} {path} declares 429 without the "
+                "X-Keto-Tenant response header"
+            )
+
+
+def test_tenant_scoped_requests_conform(daemon):
+    """Requests carrying X-Keto-Tenant answer the SAME declared shapes
+    as the default surface: tenant writes 201, owner check 200, another
+    tenant 403 (isolation), malformed tenant id 400 — all validating
+    against the untenanted schemas."""
+    put = {
+        "namespace": "files", "object": "spec-doc", "relation": "view",
+        "subject_id": "tenant-user",
+    }
+    status, body, _ = _request_h(
+        daemon.write_port, "PUT", "/relation-tuples", body=put,
+        headers={"X-Keto-Tenant": "spec-acme"},
+    )
+    assert status == 201
+    _validate("/relation-tuples", "PUT", status, body)
+
+    query = {
+        "namespace": "files", "object": "spec-doc", "relation": "view",
+        "subject_id": "tenant-user",
+    }
+    for tenant, want in (("spec-acme", 200), ("spec-rival", 403)):
+        status, body, _ = _request_h(
+            daemon.read_port, "GET", "/check", query=query,
+            headers={"X-Keto-Tenant": tenant},
+        )
+        assert status == want, f"tenant {tenant}: {body}"
+        _validate("/check", "GET", status, body)
+        assert body["allowed"] is (want == 200)
+
+    # the default surface never sees the tenant's tuple
+    status, body, _ = _request_h(daemon.read_port, "GET", "/check", query=query)
+    assert status == 403
+    _validate("/check", "GET", status, body)
+
+    status, body, _ = _request_h(
+        daemon.read_port, "GET", "/check", query=query,
+        headers={"X-Keto-Tenant": "not/valid"},
+    )
+    assert status == 400
+    _validate("/check", "GET", status, body)
