@@ -349,6 +349,29 @@ def label_step(
     )
 
 
+def label_step_witness(
+    out_lab: jnp.ndarray,  # int32 [n_int+1, Wo], OUT_PAD-padded
+    in_lab: jnp.ndarray,  # int32 [n_int+1, Wi], IN_PAD-padded
+    pa: jnp.ndarray,  # int32 [P] pair a-rows
+    pb: jnp.ndarray,  # int32 [P] pair b-rows
+) -> jnp.ndarray:
+    """Explain path (keto_tpu/explain): the WINNING entry of each pair's
+    label intersection — argmin over the same packed compare ``label_step``
+    reduces to one decision bit, one extra output word per pair. The
+    distinct pad sentinels keep padded slots out of the argmin exactly as
+    they keep them out of the hit reduction. Dispatched only by
+    ``label_witness_info`` on explain requests — never on the check hot
+    path."""
+    oa = out_lab[pa]  # [P, Wo]
+    ib = in_lab[pb]  # [P, Wi]
+    entry_hit = jnp.any(oa[:, :, None] == ib[:, None, :], axis=2)  # [P, Wo]
+    big = jnp.int32(np.iinfo(np.int32).max)
+    lm = jnp.min(jnp.where(entry_hit, oa, big), axis=1)
+    return jnp.where(jnp.any(entry_hit, axis=1), lm, jnp.int32(-1))
+
+
+_label_witness_kernel = jax.jit(label_step_witness)
+
 _label_kernel = partial(jax.jit, static_argnames=("n_pairs", "B"))(label_step)
 
 #: donated variant (see _check_kernel_donated): the pair-entry staging
@@ -1333,6 +1356,10 @@ class TpuCheckEngine:
         self._audit_pending: collections.deque = collections.deque(maxlen=4096)
         self._audit_checks = 0
         self._audit_mismatches = 0
+        #: evidence for recent shadow-parity divergences — both witnesses
+        #: (the store-closure back-trace vs the CPU oracle's traversal);
+        #: read by the flight recorder's ``audit_divergences`` section
+        self.audit_divergences: collections.deque = collections.deque(maxlen=8)
         self._audit_task = SupervisedTask(
             "audit", self._audit_pass, stats=self.maintenance
         )
@@ -1817,6 +1844,14 @@ class TpuCheckEngine:
             except IndexError:
                 return
             try:
+                # resilience seam: arming ``audit-flip`` (x/faults.py)
+                # corrupts the device's recorded decision, forcing the
+                # auditor to see a divergence — how the witness-diff
+                # capture below is regression-tested without a real bug
+                faults.check("audit-flip")
+            except faults.FaultInjected:
+                decision = not decision
+            try:
                 wm = self._store.watermark()
             except Exception:
                 continue  # store unreadable: the health machine owns that
@@ -1829,11 +1864,44 @@ class TpuCheckEngine:
             if got != decision:
                 self._audit_mismatches += 1
                 self.maintenance.incr("audit_mismatches")
+                self._note_audit_divergence(rt, decision, got, token)
                 _log.error(
                     "shadow-parity audit MISMATCH: %r decided %s on device, "
                     "%s on the CPU oracle (snaptoken %d) — flipping DEGRADED",
                     rt, decision, got, token,
                 )
+
+    def _note_audit_divergence(
+        self, rt: RelationTuple, device: bool, oracle: bool, token: int
+    ) -> None:
+        """Capture the evidence for one shadow-parity divergence: the
+        store-closure back-trace the device route should have witnessed
+        (BFS shortest path) next to the CPU oracle's own traversal. The
+        deque rides into flight-recorder bundles (driver/registry.py
+        ``audit_divergences`` section) — the debugging artifact for the
+        one alarm that must never be rationalized away."""
+        try:
+            from keto_tpu.explain.witness import build_witness, oracle_witness
+
+            _, dev_path, certificate = build_witness(self._store, rt)
+            orc_path = oracle_witness(self._store, rt)
+            self.audit_divergences.append(
+                {
+                    "tuple": str(rt),
+                    "device_decision": device,
+                    "oracle_decision": oracle,
+                    "snaptoken": token,
+                    "device_witness": (
+                        [str(t) for t in dev_path] if dev_path else None
+                    ),
+                    "oracle_witness": (
+                        [str(t) for t in orc_path] if orc_path else None
+                    ),
+                    "certificate": certificate,
+                }
+            )
+        except Exception:  # keto-analyze: ignore[KTA401] evidence capture is best-effort; the mismatch counter + DEGRADED flip above already raised the alarm
+            pass
 
     # -- degraded mode (CPU fallback) ----------------------------------------
 
@@ -3731,6 +3799,72 @@ class TpuCheckEngine:
             ordered=ordered, with_info=with_info,
         )
         return self._guard_stream(gen), snap.snapshot_id
+
+    def label_witness_info(
+        self, rt: RelationTuple, *, at_least: Optional[int] = None,
+        mode: str = "latest",
+    ) -> Optional[dict]:
+        """Explain-path enrichment (keto_tpu/explain): the winning landmark
+        of the 2-hop label intersection for ``rt``'s (start, target) pair —
+        the hub node the label route's proof went through — or None when
+        the pair isn't label-resolvable (labels off/dirty, wildcard query,
+        non-interior endpoint). Reads the device arrays through the
+        ``label_step_witness`` argmin kernel when they are resident, else
+        the host index — entry-identical by construction. Only the explain
+        endpoint calls this; the check hot path never does."""
+        if not self._labels_enabled:
+            return None
+        try:
+            snap = self._snapshot_for(at_least, mode)
+        except Exception:
+            return None
+        idx = snap.labels
+        if idx is None:
+            return None
+        try:
+            sd, tg, multi = self._resolve_bulk(snap, [rt])
+        except Exception:
+            return None
+        if 0 in multi:
+            return None  # wildcard pattern: no single (start, target) pair
+        a, b = int(sd[0]), int(tg[0])
+        ni = snap.num_int
+        if a < 0 or b < 0 or a >= ni or b >= ni:
+            return None
+        lm: Optional[int] = None
+        dl = self._labels_dev(snap)
+        if dl is not None and not self._sharded:
+            try:
+                got = int(
+                    np.asarray(
+                        _label_witness_kernel(
+                            dl[0], dl[1],
+                            jnp.asarray(np.array([a], np.int32)),
+                            jnp.asarray(np.array([b], np.int32)),
+                        )
+                    )[0]
+                )
+                lm = got if got >= 0 else None
+            except Exception:
+                lm = None
+        if lm is None:
+            lm = idx.witness_landmark(a, b)
+        if lm is None:
+            return None
+        info: dict = {"kind": "2-hop-label", "pair": [a, b], "landmark_dev": int(lm)}
+        try:
+            kind, key = snap.key_of_dev(int(lm))
+            if kind == "set":
+                ns_id, obj, rel = key
+                name = next(
+                    (n.name for n in self._nm().namespaces() if n.id == ns_id), ""
+                )
+                info["landmark"] = f"{name}:{obj}#{rel}"
+            else:
+                info["landmark"] = str(key)
+        except Exception:  # keto-analyze: ignore[KTA401] landmark naming is best-effort enrichment; the numeric id in landmark_dev already carries the answer
+            pass
+        return info
 
     @staticmethod
     def _slice_ready(dev) -> bool:

@@ -205,6 +205,31 @@ CONFIG_SCHEMA = {
                     "default": 0.0,
                     "description": "Sampled shadow-parity auditor: the fraction of live check decisions re-verified against the CPU reference oracle in a supervised background worker (0 disables). Samples whose snaptoken the store has moved past are skipped; any real divergence increments keto_audit_mismatches_total and flips health to DEGRADED — continuous proof that HBM eviction rungs (and everything else) never change answers. Costs one oracle traversal per sampled check, off the serving path.",
                 },
+                "explain_enabled": {
+                    "type": "boolean",
+                    "default": True,
+                    "description": "Decision provenance (keto_tpu/explain): GET /check/explain + gRPC ExplainService reconstruct, for any Check, a concrete witness path (grant) or frontier-exhaustion certificate (deny), report the route that decided (label/hybrid/bfs/host/cpu) and — on label-route grants — the winning 2-hop landmark, and verify every witness edge-by-edge against the Manager before returning it. false answers the endpoints 404 and adds zero work anywhere (the check hot path never touches the explain subsystem either way).",
+                },
+                "decision_log_sample": {
+                    "type": "number",
+                    "default": 0.0,
+                    "description": "Durable decision-audit log sampling: the fraction of live check decisions appended to the decision log (keto_tpu/explain/decision_log.py) as {tuple, decision, route, snaptoken, trace_id, tenant} records — witness-free on the hot path; the snaptoken makes any sampled decision re-explainable later via GET /check/explain?snaptoken=... (docs/concepts/explain.md). 0 disables sampling; explain requests themselves are always recorded (witness included) when the log is configured. Costs one RNG draw plus, on sampled requests, one buffered JSON append — bench.py's explain_overhead section gates a 1% sample at <= 5% check p99 impact.",
+                },
+                "decision_log_dir": {
+                    "type": "string",
+                    "default": "",
+                    "description": "Decision-audit log root directory: tenant-scoped subdirectories each holding an append-only active segment plus sealed segments (atomic fsync-then-rename rotation like the snapshot cache, so sealed segments are never torn; a SIGKILL can at worst leave a partial final line in the active segment, which readers tolerate). Empty disables the decision log entirely.",
+                },
+                "decision_log_segment_bytes": {
+                    "type": "integer",
+                    "default": 1048576,
+                    "description": "Decision-log segment size: the active segment is sealed (fsync + atomic rename) and a fresh one started once it crosses this many bytes.",
+                },
+                "decision_log_retention": {
+                    "type": "integer",
+                    "default": 8,
+                    "description": "Decision-log retention: newest sealed segments kept per tenant; older ones are deleted after each rotation.",
+                },
                 "watch_poll_ms": {
                     "type": "number",
                     "default": 100.0,

@@ -787,6 +787,56 @@ class Registry:
 
         return self._memo("expand_engine", build)
 
+    def explain_enabled(self) -> bool:
+        return bool(self._config.get("serve.explain_enabled", True))
+
+    def decision_log(self):
+        """The durable decision-audit log (keto_tpu/explain/decision_log.py),
+        or None when ``serve.decision_log_dir`` is unset — the hot path's
+        entire cost in that case is this None check."""
+        d = str(self._config.get("serve.decision_log_dir", "") or "")
+        if not d:
+            return None
+
+        def build():
+            from keto_tpu.explain.decision_log import DecisionLog
+
+            return DecisionLog(
+                d,
+                sample=float(self._config.get("serve.decision_log_sample", 0.0)),
+                segment_bytes=int(
+                    self._config.get("serve.decision_log_segment_bytes", 1 << 20)
+                ),
+                retention=int(self._config.get("serve.decision_log_retention", 8)),
+            )
+
+        return self._memo("decision_log", build)
+
+    def explain_engine(self):
+        """The decision-provenance engine (keto_tpu/explain): decides
+        through the serving check engine (so the reported route is the one
+        that actually answered), back-traces the witness against the
+        Manager, verifies it edge-by-edge, and records to the decision
+        log. Verify failures — each one a bug in the producing route —
+        fire the flight recorder with the failing witness attached."""
+
+        def build():
+            from keto_tpu.explain.engine import ExplainEngine
+
+            def on_verify_failure(note):
+                fr = self.flight_recorder()
+                if fr is not None:
+                    fr.trigger("witness-verify-failure", detail=note.get("tuple", ""))
+
+            return ExplainEngine(
+                self.permission_engine(),
+                self.relation_tuple_manager(),
+                decision_log=self.decision_log(),
+                on_verify_failure=on_verify_failure,
+            )
+
+        return self._memo("explain_engine", build)
+
     def list_engine(self):
         """The reverse-query engine (keto_tpu/list/): snapshot-backed
         (sharing the TPU check engine's device snapshots, transposed
@@ -1224,6 +1274,22 @@ class Registry:
             # totals, spike counts, and degradation reasons — who was
             # storming and who paid, at the moment of anomaly
             sec("tenants", pool.snapshot)
+        ex = self.peek("explain_engine")
+        if ex is not None and ex.recent_failures:
+            # witnesses that failed edge-by-edge verification — each one
+            # is a bug in the producing route; the failing path is the
+            # evidence triage starts from
+            sec("explain", lambda: {
+                "verify_failures": ex.verify_failures,
+                "recent": list(ex.recent_failures),
+            })
+        eng = self.peek("permission_engine")
+        divs = getattr(eng, "audit_divergences", None)
+        if divs:
+            # shadow-parity divergences WITH both witnesses (device
+            # route's vs the CPU oracle's) — triage starts from the
+            # disagreeing edge, not a bare mismatch counter
+            sec("audit_divergences", lambda: list(divs))
         sections["config"] = {
             "role": str(self._config.get("serve.role", "primary")),
             "version": VERSION,
@@ -1296,9 +1362,10 @@ class Registry:
             # request families are declared eagerly (the serving layers
             # re-declare idempotently) so a scrape before first traffic
             # already exposes the full documented family set
-            from keto_tpu.servers.grpc_api import _request_metrics
+            from keto_tpu.servers.grpc_api import _expand_metrics, _request_metrics
 
             _request_metrics(m)
+            _expand_metrics(m)
             self._register_metric_bridges(m)
             return m
 
@@ -1903,6 +1970,57 @@ class Registry:
             "nonzero value flips health to DEGRADED (continuous proof "
             "that eviction rungs never change answers).",
             audit_counter("audit_mismatches"),
+        )
+
+        # decision provenance (keto_tpu/explain): explain requests by the
+        # route that decided them, witnesses that failed edge-by-edge
+        # verification (each one a bug), and the durable decision log's
+        # append totals
+        def explain_requests():
+            ex = self.peek("explain_engine")
+            totals = getattr(ex, "requests_by_route", {}) if ex is not None else {}
+            out = [((r,), float(v)) for r, v in sorted(totals.items())]
+            return out or [(("bfs",), 0.0)]
+
+        m.register_callback(
+            "keto_explain_requests_total", "counter",
+            "Check-explain requests served, by the route that decided "
+            "them (label / hybrid / bfs / host / cpu — the stream's own "
+            "route label, not a re-derivation).",
+            explain_requests, ("route",),
+        )
+
+        def explain_verify_failures():
+            ex = self.peek("explain_engine")
+            yield (), float(getattr(ex, "verify_failures", 0) if ex is not None else 0)
+
+        m.register_callback(
+            "keto_witness_verify_failures_total", "counter",
+            "Witnesses that FAILED edge-by-edge verification against the "
+            "Manager before return — each one is a bug in the producing "
+            "route; the response fell back to the CPU oracle's witness "
+            "and the flight recorder captured the failing path.",
+            explain_verify_failures,
+        )
+
+        def decision_log_attr(attr):
+            def read():
+                dl = self.peek("decision_log")
+                yield (), float(getattr(dl, attr, 0) if dl is not None else 0)
+
+            return read
+
+        m.register_callback(
+            "keto_decision_log_records_total", "counter",
+            "Records appended to the durable decision-audit log (sampled "
+            "hot-path checks plus every explain request), all tenants.",
+            decision_log_attr("records_total"),
+        )
+        m.register_callback(
+            "keto_decision_log_bytes_total", "counter",
+            "Bytes appended to the decision-audit log across active and "
+            "sealed segments, all tenants.",
+            decision_log_attr("bytes_total"),
         )
 
         # reverse-query subsystem (keto_tpu/list/): request counters per

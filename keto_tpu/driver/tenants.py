@@ -138,6 +138,7 @@ class TenantContext:
         self._engine = None
         self._batcher = None
         self._expand = None
+        self._explain = None
         self._list = None
         self._watch_hub = None
         self._dispatching = 0
@@ -254,6 +255,46 @@ class TenantContext:
 
                 self._list = ListEngine(self.relation_tuple_manager())
             return self._list
+
+    def decision_log(self):
+        """The shared decision log (one per process, tenant-scoped
+        subdirectories — this context's records carry its tenant name)."""
+        return self._registry.decision_log()
+
+    def explain_engine(self):
+        """The tenant's decision-provenance engine: decides through the
+        tenant's own engine UNDER THE DISPATCH GUARD (so eviction can
+        close and re-fault the engine between explains, never during
+        one) and back-traces witnesses against the tenant's store view,
+        sharing the process-wide decision log."""
+        with self._lock:
+            if self._explain is None:
+                from keto_tpu.explain.engine import ExplainEngine
+
+                store = self.relation_tuple_manager()
+
+                def decide(rt, at_least):
+                    with self.dispatch() as engine:
+                        got = ExplainEngine.decide_with(engine, store, rt, at_least)
+                    self.checks_total += 1
+                    return got
+
+                def on_verify_failure(note):
+                    fr = self._registry.flight_recorder()
+                    if fr is not None:
+                        fr.trigger(
+                            "witness-verify-failure",
+                            detail=note.get("tuple", ""),
+                        )
+
+                self._explain = ExplainEngine(
+                    None,
+                    store,
+                    decision_log=self._registry.decision_log(),
+                    on_verify_failure=on_verify_failure,
+                    decide=decide,
+                )
+            return self._explain
 
     def watch_hub(self):
         with self._lock:

@@ -179,6 +179,24 @@ class LabelIndex:
             return False
         return bool(np.isin(oa, ib, assume_unique=True).any())
 
+    def witness_landmark(self, a: int, b: int) -> Optional[int]:
+        """The winning entry of the reach0 intersection for ``(a, b)``:
+        the minimum common landmark id, or None on miss. Every stored
+        entry witnesses a real path, so a returned landmark sits on a
+        genuine a→…→landmark→…→b chain — the 2-hop witness the explain
+        subsystem surfaces. The device path
+        (tpu_engine.label_step_witness) is argmin over the same compare."""
+        if a >= self.n or b >= self.n:
+            return None
+        oa = self.out_lab[a]
+        ib = self.in_lab[b]
+        oa = oa[oa != OUT_PAD]
+        ib = ib[ib != IN_PAD]
+        if not oa.size or not ib.size:
+            return None
+        common = oa[np.isin(oa, ib, assume_unique=True)]
+        return int(common.min()) if common.size else None
+
 
 def _finalize(
     n: int,

@@ -45,6 +45,7 @@ from keto_tpu.relationtuple.proto_codec import (
 from keto_tpu.x.errors import ErrBadRequest, KetoError
 from keto_tpu.x.logging import request_context
 from keto_tpu.x.pagination import with_size, with_token
+from keto_tpu.x.timeline import current_timeline
 from keto_tpu.x.tracing import parse_traceparent
 
 READ = "read"
@@ -119,6 +120,38 @@ def _request_metrics(m):
             ("method",),
         ),
     )
+
+
+def _expand_metrics(m):
+    """The expand request counter + build-latency histogram (idempotent
+    by name; both serving surfaces share the pair, labeled by surface,
+    and the driver registry pre-declares them for pre-traffic scrapes)."""
+    return (
+        m.counter(
+            "keto_expand_requests_total",
+            "Expand trees built, by serving surface (http/grpc).",
+            ("surface",),
+        ),
+        m.histogram(
+            "keto_expand_duration_seconds",
+            "Expand tree construction latency (host-side recursion over "
+            "the device snapshot or the Manager).",
+            ("surface",),
+        ),
+    )
+
+
+def _tenant_of(context) -> str:
+    """The validated tenant the call addressed (x-keto-tenant metadata,
+    absent -> the default tenant)."""
+    from keto_tpu.driver.tenants import validate_tenant_id
+
+    raw = ""
+    for k, v in context.invocation_metadata() or ():
+        if k.lower() == "x-keto-tenant" and v:
+            raw = v
+            break
+    return validate_tenant_id(raw)
 
 
 class _TrailingMergeContext:
@@ -331,9 +364,16 @@ class ExpandService:
         rep = scope.replica_controller()
         if rep is not None:
             rep.gate_read(None)  # UNAVAILABLE until the first bootstrap
-        tree = scope.expand_engine().build_tree(
-            subject, scope.expand_depth(request.max_depth)
-        )
+        counter, latency = _expand_metrics(self.registry.metrics())
+        depth = scope.expand_depth(request.max_depth)
+        t0 = time.perf_counter()
+        tree = scope.expand_engine().build_tree(subject, depth)
+        dur_s = time.perf_counter() - t0
+        counter.inc(("grpc",))
+        latency.observe(("grpc",), dur_s)
+        tl = current_timeline()
+        if tl is not None:
+            tl.stamp("expand", depth=depth)
         return expand_service_pb2.ExpandResponse(tree=tree_to_proto(tree))
 
     def register(self, server):
@@ -604,6 +644,66 @@ class ListService:
         )
 
 
+class ExplainService:
+    """keto.tpu.explain.v1.ExplainService — the gRPC face of
+    ``GET /check/explain`` (keto_tpu/explain). Like ListService, the
+    upstream acl.v1alpha1 contract has no provenance surface, so the
+    method frames requests/responses as UTF-8 JSON mirroring the REST
+    payloads exactly: the request is a relation tuple (``subject_id``
+    XOR ``subject_set``) plus optional ``snaptoken``/``latest``; the
+    response carries the decision, the route that made it, the
+    Manager-verified witness path or frontier-exhaustion certificate,
+    and — on label-route grants — the winning landmark."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def Explain(self, request, context):
+        scope = _scope_from(self.registry, context)
+        if not bool(scope.config().get("serve.explain_enabled", True)):
+            from keto_tpu.x.errors import ErrNotFound
+
+            raise ErrNotFound("explain disabled by configuration")
+        from keto_tpu.relationtuple.model import RelationTuple
+
+        rt = RelationTuple.from_json(request)
+        at_least, latest = ListService._consistency(request)
+        rep = scope.replica_controller()
+        if rep is not None:
+            rep.gate_read(at_least, latest)
+        tl = current_timeline()
+        resp = scope.explain_engine().explain(
+            rt,
+            at_least=at_least,
+            trace_id=tl.trace_id if tl is not None else "",
+            tenant=_tenant_of(context),
+        )
+        if tl is not None:
+            tl.stamp(
+                "explain",
+                route=resp.get("route", ""),
+                verified=bool(resp.get("verified")),
+            )
+        return resp
+
+    def register(self, server):
+        server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "keto.tpu.explain.v1.ExplainService",
+                    {
+                        "Explain": grpc.unary_unary_rpc_method_handler(
+                            _wrap(self.Explain, self.registry,
+                                  "ExplainService/Explain"),
+                            request_deserializer=_json_de,
+                            response_serializer=_json_ser,
+                        ),
+                    },
+                ),
+            )
+        )
+
+
 def _wrap_stream(fn, registry, name: str):
     """The server-streaming analog of ``_wrap``: KetoError → status
     codes, request counter + latency on stream end."""
@@ -772,6 +872,7 @@ def build_grpc_server(registry, role: str, address: str = "127.0.0.1:0"):
         ExpandService(registry).register(server)
         ReadService(registry).register(server)
         ListService(registry).register(server)
+        ExplainService(registry).register(server)
         WatchService(registry).register(server)
     else:
         WriteService(registry).register(server)

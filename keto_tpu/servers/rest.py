@@ -282,6 +282,8 @@ class RestApp:
                     return self._post_check(body, query, headers)
                 if route == ("POST", "/check/batch"):
                     return self._post_check_batch(body, query, headers)
+                if route == ("GET", "/check/explain"):
+                    return self._get_explain(query, headers)
                 if route == ("GET", "/expand"):
                     return self._get_expand(query, headers)
                 if route == ("GET", "/relation-tuples"):
@@ -682,6 +684,9 @@ class RestApp:
                     tl = current_timeline()
                     if tl is not None:
                         tl.stamp("cache_hit")
+                    dl = scope.decision_log()
+                    if dl is not None and dl.sampled():
+                        self._record_decision(dl, tuple_, allowed, token, headers)
                     return (
                         (200 if allowed else 403),
                         {"allowed": allowed},
@@ -697,8 +702,89 @@ class RestApp:
         )
         if cache is not None and key is not None:
             cache.put(key, allowed, token)
+        # sampled decision-audit record: one None check when the log is
+        # off, one RNG draw when on — witness-free either way (the
+        # snaptoken makes the decision re-explainable later)
+        dl = scope.decision_log()
+        if dl is not None and dl.sampled():
+            self._record_decision(dl, tuple_, allowed, token, headers)
         resp_headers = {} if token is None else {"X-Keto-Snaptoken": str(token)}
         return (200 if allowed else 403), {"allowed": allowed}, resp_headers
+
+    def _record_decision(self, dl, tuple_, allowed, token, headers):
+        """Append one hot-path check decision to the decision log
+        (keto_tpu/explain/decision_log.py). The route is read off the
+        request timeline's device stamp when timelines are on; "" when
+        they are off — the record stays re-explainable either way."""
+        from keto_tpu.x.timeline import current_timeline
+
+        route = ""
+        trace_id = ""
+        tl = current_timeline()
+        if tl is not None:
+            # the trace id when a traceparent joined us; the always-minted
+            # request id otherwise — the record stays correlatable
+            trace_id = tl.trace_id or tl.request_id
+            for stage, _t, attrs in reversed(tl.stamps):
+                if stage == "device" and attrs and "route" in attrs:
+                    route = str(attrs["route"])
+                    break
+                if stage == "cache_hit":
+                    route = "cache"
+                    break
+        dl.record(
+            self._tenant_from(headers),
+            {
+                "kind": "check",
+                "tuple": tuple_.to_json(),
+                "decision": bool(allowed),
+                "route": route,
+                "witness": None,
+                "snaptoken": str(token) if token is not None else "",
+                "trace_id": trace_id,
+            },
+        )
+
+    def _get_explain(self, query, headers=None):
+        """``GET /check/explain``: the Check decision plus its provenance
+        — a Manager-verified witness path (grant) or frontier-exhaustion
+        certificate (deny), the route that decided it, and the label
+        route's winning landmark (docs/concepts/explain.md). Always 200
+        (the body carries ``allowed``); same 400 tuple contract and
+        412 replica snaptoken gate as ``/check``; 404 when
+        ``serve.explain_enabled`` is false."""
+        scope = self._scope(headers)
+        if not bool(scope.config().get("serve.explain_enabled", True)):
+            err = KetoError("explain disabled by configuration")
+            err.status_code = 404
+            return 404, err.to_json(), {}
+        try:
+            tuple_ = RelationTuple.from_url_query(query)
+        except ErrNilSubject:
+            raise ErrBadRequest("Subject has to be specified.") from None
+        at_least, latest = self._consistency_from(query)
+        rep = scope.replica_controller()
+        if rep is not None:
+            rep.gate_read(at_least, latest)
+        from keto_tpu.x.timeline import current_timeline
+
+        tl = current_timeline()
+        resp = scope.explain_engine().explain(
+            tuple_,
+            at_least=at_least,
+            trace_id=tl.trace_id if tl is not None else "",
+            tenant=self._tenant_from(headers),
+        )
+        if tl is not None:
+            tl.stamp(
+                "explain",
+                route=resp.get("route", ""),
+                verified=bool(resp.get("verified")),
+            )
+        resp_headers = {}
+        if resp.get("snaptoken"):
+            resp_headers["X-Keto-Snaptoken"] = resp["snaptoken"]
+        return 200, resp, resp_headers
 
     def _get_check(self, query, headers=None):
         try:
@@ -769,9 +855,19 @@ class RestApp:
         rep = scope.replica_controller()
         if rep is not None:
             rep.gate_read(None)  # 503 until the first bootstrap lands
-        tree = scope.expand_engine().build_tree(
-            subject, scope.expand_depth(depth)
-        )
+        from keto_tpu.servers.grpc_api import _expand_metrics
+        from keto_tpu.x.timeline import current_timeline
+
+        counter, latency = _expand_metrics(self.registry.metrics())
+        eff_depth = scope.expand_depth(depth)
+        t0 = time.perf_counter()
+        tree = scope.expand_engine().build_tree(subject, eff_depth)
+        dur_s = time.perf_counter() - t0
+        counter.inc(("http",))
+        latency.observe(("http",), dur_s)
+        tl = current_timeline()
+        if tl is not None:
+            tl.stamp("expand", depth=eff_depth)
         if tree is None:
             return 200, None, {}
         return 200, tree.to_json(), {}

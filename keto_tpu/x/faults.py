@@ -14,6 +14,10 @@ read when not. The canonical points:
 - ``cache-save``    — background snapshot-cache serialization
 - ``compaction``    — overlay compaction
 - ``check-dispatch``— the check batcher's collector, before dispatch
+- ``audit-flip``    — the shadow-parity auditor, per queued sample: when
+  armed, the device's recorded decision is FLIPPED instead of raising,
+  forcing a divergence so the witness-diff capture path is testable
+  without a real device bug
 
 Arming is programmatic (``inject`` / the ``injected`` context manager,
 used by tests/test_faults.py) or environmental: ``KETO_TPU_FAULTS`` is a
@@ -81,6 +85,7 @@ POINTS = (
     "cache-save",
     "compaction",
     "check-dispatch",
+    "audit-flip",
     "transact-commit",
     "transact-ack",
     "group-commit",

@@ -57,7 +57,8 @@ REQUIRED_KEYS = ("schema", "reason", "detail", "created_unix", "pid",
 #: the serialized bundle fits — biggest/least-essential first, so the
 #: health picture and the timelines survive the longest
 SHED_ORDER = ("metrics", "lockwatch", "watch", "replica", "slo",
-              "tenants", "batcher", "hbm", "timelines")
+              "tenants", "batcher", "hbm", "explain", "audit_divergences",
+              "timelines")
 
 
 def validate_bundle(bundle: dict) -> list[str]:

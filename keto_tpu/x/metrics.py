@@ -60,6 +60,7 @@ KNOWN_ROUTES = frozenset(
     {
         "/check",
         "/check/batch",
+        "/check/explain",
         "/expand",
         "/relation-tuples",
         "/relation-tuples/list-objects",

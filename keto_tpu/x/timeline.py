@@ -49,6 +49,8 @@ STAGES = (
     "dispatch",   # handed to the engine's streaming pipeline
     "device",     # one device slice landed (repeats per slice; carries attrs)
     "land",       # every tuple of the request has its decision
+    "expand",     # expand tree built (host; carries depth / node count)
+    "explain",    # witness reconstructed + verified (carries route/verified)
     "deliver",    # response handed back to the serving layer
 )
 

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Bench trend extraction: headline series across bench rounds.
+
+``bench.py`` prints one JSON line per metric family
+(``{"metric": ..., "value": ..., "detail": {...}}``); full-shape runs
+are archived as ``BENCH_r*.json`` round files (``{"n", "cmd", "rc",
+"tail", "parsed"}`` with the metric lines inside the ``tail`` string).
+This script extracts the headline series from every round it can find —
+checks/s per config, slice-tail p50/p99, label hit rate, write-path
+ack latencies, replication delta latencies — into ``BENCH_TREND.json``
+and flags any series whose latest point regressed more than
+``--threshold`` (default 10%) against the previous round:
+
+    python scripts/bench_trend.py                         # BENCH_r*.json rounds
+    python scripts/bench_trend.py --log bench_out.log     # one raw bench log
+    python scripts/bench_trend.py --fail-on-regression    # CI gate mode
+
+Direction is inferred from the series name: throughput-like series
+(checks/s, writes/s, rates) regress when they DROP; latency-like series
+(``*_ms``, ``*_s``, percentiles) regress when they RISE. Unrecognized
+series are tracked but never flagged.
+
+CI (bench-smoke) runs the ``--log`` form on the tiny-shape bench output
+and uploads the trend file as an artifact — the cross-run dashboard
+without any external infrastructure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: detail keys promoted into series wherever they appear (any nesting)
+HEADLINE_KEYS = (
+    "checks_per_s",
+    "stream_checks_per_s",
+    "oracle_checks_per_s",
+    "writes_per_s",
+    "objects_per_s",
+    "label_hit_rate",
+    "label_speedup",
+    "hit_rate",
+    "single_check_p50_ms",
+    "stream_slice_p50_ms",
+    "stream_slice_p99_ms",
+    "ack_p50_ms",
+    "ack_p99_ms",
+    "delta_p50_ms",
+    "delta_p99_ms",
+    "p50_ms",
+    "p99_ms",
+)
+
+#: lower-is-better markers — a rise past threshold flags these
+_LATENCY = re.compile(r"(_ms|_s|_seconds|p50|p99)$")
+#: higher-is-better markers — a drop past threshold flags these
+_THROUGHPUT = re.compile(r"(per_s|/s|_rate|speedup|throughput)")
+
+
+def _metric_lines(text: str):
+    """Yield every parsed ``{"metric": ...}`` object in ``text`` —
+    tolerant of log prefixes (``[c5] {...}``) and junk lines."""
+    for line in text.splitlines():
+        i = line.find('{"metric"')
+        if i < 0:
+            continue
+        try:
+            obj = json.loads(line[i:])
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            yield obj
+
+
+def _walk(prefix: str, node, points: dict):
+    """Collect headline keys from ``node`` into ``points`` under
+    ``prefix/…`` series names, recursing into sub-config dicts."""
+    if not isinstance(node, dict):
+        return
+    for key, val in node.items():
+        if key in HEADLINE_KEYS and isinstance(val, (int, float)):
+            points[f"{prefix}/{key}"] = float(val)
+        elif isinstance(val, dict):
+            _walk(f"{prefix}/{key}", val, points)
+
+
+def extract_round(text: str) -> dict:
+    """All headline series points from one bench run's output."""
+    points: dict[str, float] = {}
+    for m in _metric_lines(text):
+        name = str(m["metric"])
+        if isinstance(m.get("value"), (int, float)):
+            points[name] = float(m["value"])
+        _walk(name, m.get("detail"), points)
+    return points
+
+
+def direction(series: str) -> int:
+    """+1 = higher is better, -1 = lower is better, 0 = untracked."""
+    leaf = series.rsplit("/", 1)[-1]
+    if _THROUGHPUT.search(leaf):
+        return 1
+    if _LATENCY.search(leaf):
+        return -1
+    return 0
+
+
+def load_rounds(root: str) -> list[tuple[int, dict]]:
+    rounds = []
+    for fn in sorted(os.listdir(root)):
+        if not (fn.startswith("BENCH_r") and fn.endswith(".json")):
+            continue
+        try:
+            doc = json.load(open(os.path.join(root, fn)))
+        except ValueError:
+            continue
+        n = int(doc.get("n", 0) or re.sub(r"\D", "", fn) or 0)
+        if int(doc.get("rc", 1)) != 0:
+            continue  # a failed round carries no comparable numbers
+        rounds.append((n, extract_round(str(doc.get("tail", "")))))
+    rounds.sort(key=lambda r: r[0])
+    return rounds
+
+
+def build_trend(rounds: list[tuple[int, dict]], threshold: float) -> dict:
+    series: dict[str, list[dict]] = {}
+    for n, points in rounds:
+        for name, value in points.items():
+            series.setdefault(name, []).append({"round": n, "value": value})
+    regressions = []
+    for name, pts in sorted(series.items()):
+        d = direction(name)
+        if d == 0 or len(pts) < 2:
+            continue
+        prev, last = pts[-2]["value"], pts[-1]["value"]
+        if prev <= 0:
+            continue
+        change = (last - prev) / prev
+        if d * change < -threshold:
+            regressions.append(
+                {
+                    "series": name,
+                    "round": pts[-1]["round"],
+                    "previous": prev,
+                    "latest": last,
+                    "change_pct": round(change * 100.0, 2),
+                }
+            )
+    return {
+        "threshold_pct": round(threshold * 100.0, 2),
+        "rounds": [n for n, _ in rounds],
+        "series": series,
+        "regressions": regressions,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=ROOT, help="directory holding BENCH_r*.json")
+    ap.add_argument(
+        "--log",
+        action="append",
+        default=[],
+        help="raw bench output file(s) to treat as the latest round(s) "
+        "(each one round, numbered after the archived rounds)",
+    )
+    ap.add_argument("--out", default=os.path.join(ROOT, "BENCH_TREND.json"))
+    ap.add_argument("--threshold", type=float, default=0.10)
+    ap.add_argument(
+        "--fail-on-regression",
+        action="store_true",
+        help="exit 1 when any tracked series regressed past the threshold",
+    )
+    args = ap.parse_args(argv)
+
+    rounds = load_rounds(args.root)
+    next_n = (rounds[-1][0] + 1) if rounds else 1
+    for path in args.log:
+        with open(path) as f:
+            rounds.append((next_n, extract_round(f.read())))
+        next_n += 1
+
+    if not rounds:
+        print("bench-trend: no rounds found", file=sys.stderr)
+        return 0
+
+    trend = build_trend(rounds, args.threshold)
+    with open(args.out, "w") as f:
+        json.dump(trend, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+    print(
+        f"bench-trend: {len(trend['series'])} series over rounds "
+        f"{trend['rounds']} -> {os.path.relpath(args.out, ROOT)}"
+    )
+    for r in trend["regressions"]:
+        print(
+            f"  REGRESSION {r['series']}: {r['previous']} -> {r['latest']} "
+            f"({r['change_pct']:+.1f}% at round {r['round']})"
+        )
+    if trend["regressions"] and args.fail_on_regression:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
